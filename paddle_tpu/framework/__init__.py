@@ -1,0 +1,30 @@
+from . import autograd, dtype, place, random  # noqa: F401
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad  # noqa: F401
+from .dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .place import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .random import default_generator, seed  # noqa: F401
+from .tensor import Parameter, Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,253 @@
+"""Eager autograd engine.
+
+Reference parity: paddle/fluid/imperative/tracer.cc:46 (TraceOp: record a
+grad node per executed op) and imperative/basic_engine.cc:161 (dependency-
+counted reverse sweep). TPU-native design: instead of per-op hand-written
+grad kernels, each executed op captures a `jax.vjp` closure of its pure JAX
+kernel — gradients are exact by construction and trace cleanly under
+`jax.jit` (the whole tape, forward and backward, composes into one XLA
+module when run inside a functionalized train step; see framework/jit.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from functools import partial
+
+import jax
+import numpy as np
+
+_state = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled()
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _grad_enabled()
+    _state.grad_enabled = False
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _grad_enabled()
+    _state.grad_enabled = True
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+class GradNode:
+    """One executed op on the tape."""
+
+    __slots__ = (
+        "op_type",
+        "vjp_fn",
+        "inputs",
+        "out_avals",
+        "out_grads",
+        "weak_outputs",
+    )
+
+    def __init__(self, op_type, vjp_fn, inputs, out_avals):
+        self.op_type = op_type
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[Tensor] (strong refs keep graph alive)
+        self.out_avals = out_avals  # list[(shape, dtype)]
+        self.out_grads = [None] * len(out_avals)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = ()
+        self.out_grads = [None] * len(self.out_avals)
+
+
+def _is_floating(dtype) -> bool:
+    return jax.numpy.issubdtype(dtype, np.floating)
+
+
+def apply_op(op_type, fn, tensors, attrs, num_outputs=None):
+    """Execute a registered op kernel on Tensor inputs, recording a grad node.
+
+    `fn(*arrays, **attrs)` must be a pure JAX function returning an array or
+    a tuple of arrays. Returns a Tensor or tuple of Tensors.
+    """
+    from .tensor import Tensor  # circular-safe at call time
+
+    arrays = [t._array for t in tensors]
+    requires_grad = _grad_enabled() and any(
+        (not t.stop_gradient) and _is_floating(t.dtype) for t in tensors
+    )
+
+    bound = partial(fn, **attrs) if attrs else fn
+    if requires_grad:
+        outs, vjp_fn = jax.vjp(bound, *arrays)
+    else:
+        outs = bound(*arrays)
+
+    multi = isinstance(outs, (tuple, list))
+    out_list = list(outs) if multi else [outs]
+
+    # Only track if at least one output can carry gradient.
+    if requires_grad and any(_is_floating(o.dtype) for o in out_list):
+        node = GradNode(
+            op_type,
+            vjp_fn,
+            list(tensors),
+            [(o.shape, o.dtype) for o in out_list],
+        )
+        out_tensors = [
+            Tensor._from_array(o, stop_gradient=not _is_floating(o.dtype))
+            for o in out_list
+        ]
+        for i, t in enumerate(out_tensors):
+            if not t.stop_gradient:
+                t._node = node
+                t._out_index = i
+    else:
+        out_tensors = [Tensor._from_array(o, stop_gradient=True) for o in out_list]
+
+    return tuple(out_tensors) if multi else out_tensors[0]
+
+
+def _zero_cotangent(shape, dtype):
+    import jax.numpy as jnp
+
+    if _is_floating(dtype):
+        return jnp.zeros(shape, dtype)
+    # Non-differentiable output: JAX expects a float0 cotangent.
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def backward(tensor, grad=None, retain_graph=False):
+    """Reverse sweep from `tensor`, accumulating `.grad` on leaf tensors.
+
+    Mirrors BasicEngine::Execute (imperative/basic_engine.cc:161): topological
+    traversal with per-node pending-gradient accumulation.
+    """
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    root_node = tensor._node
+    if root_node is None:
+        if not tensor.stop_gradient:
+            seed = (
+                grad._array if grad is not None else jnp.ones(tensor.shape, tensor.dtype)
+            )
+            _accumulate_leaf(tensor, seed)
+        return
+
+    if root_node.vjp_fn is None:
+        raise RuntimeError(
+            "trying to backward through the graph a second time; "
+            "set retain_graph=True on the first backward"
+        )
+
+    # Seed the root output gradient.
+    if grad is None:
+        if tensor.size != 1:
+            raise RuntimeError(
+                "grad can be implicitly created only for scalar outputs; "
+                f"got shape {tensor.shape}"
+            )
+        seed = jnp.ones(tensor.shape, tensor.dtype)
+    else:
+        seed = grad._array if isinstance(grad, Tensor) else jnp.asarray(grad)
+    _add_out_grad(root_node, tensor._out_index, seed)
+
+    # Topological order (DFS post-order over nodes).
+    order = []
+    seen = set()
+    stack = [(root_node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for t in node.inputs:
+            if t._node is not None and id(t._node) not in seen:
+                stack.append((t._node, False))
+
+    # Reverse sweep.
+    for node in reversed(order):
+        if all(g is None for g in node.out_grads):
+            continue
+        cotangents = [
+            g if g is not None else _zero_cotangent(shape, dtype)
+            for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
+        ]
+        cot = tuple(cotangents) if len(cotangents) > 1 else cotangents[0]
+        in_grads = node.vjp_fn(cot)
+        node.out_grads = [None] * len(node.out_avals)  # reset for any next pass
+        for t, g in zip(node.inputs, in_grads):
+            if t.stop_gradient or g is None:
+                continue
+            if g.dtype == jax.dtypes.float0:
+                continue
+            if t._node is not None:
+                _add_out_grad(t._node, t._out_index, g)
+            else:
+                _accumulate_leaf(t, g)
+        if not retain_graph:
+            node.release()
+
+
+def _add_out_grad(node, index, g):
+    cur = node.out_grads[index]
+    node.out_grads[index] = g if cur is None else cur + g
+
+
+def _accumulate_leaf(tensor, g):
+    from .tensor import Tensor
+
+    if tensor.grad is None:
+        tensor.grad = Tensor._from_array(g, stop_gradient=True)
+    else:
+        tensor.grad = Tensor._from_array(tensor.grad._array + g, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, allow_unused=False):
+    """paddle.grad equivalent (imperative/partial_grad_engine.cc)."""
+    from .tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [t.grad for t in inputs]
+    for t in inputs:
+        t.grad = None
+    try:
+        for i, o in enumerate(outputs):
+            go = None
+            if grad_outputs is not None and grad_outputs[i] is not None:
+                go = grad_outputs[i]
+            backward(o, grad=go, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(
+                        "one of the inputs has no gradient; pass allow_unused=True"
+                    )
+                results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for t, s in zip(inputs, saved):
+            t.grad = s

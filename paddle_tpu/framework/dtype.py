@@ -1,0 +1,91 @@
+"""Dtype system.
+
+Reference parity: paddle/fluid/framework/framework.proto:104 (VarType.Type
+dtype enum) and python/paddle/fluid/data_feeder.py dtype conversion. On TPU
+the canonical compute dtype is bfloat16-first (MXU native); float32 remains
+the default user-facing dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype
+_NAME_TO_DTYPE = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bf16": "bfloat16",
+    "fp16": "float16",
+    "fp32": "float32",
+    "fp64": "float64",
+}
+
+# Expose dtype singletons at module level (paddle.float32 style).
+bool_ = jnp.dtype(jnp.bool_)
+uint8 = jnp.dtype(jnp.uint8)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+
+_DEFAULT_DTYPE = float32
+
+
+def convert_dtype(dtype) -> jnp.dtype:
+    """Normalize any dtype spec (str, np dtype, jnp dtype) to a jnp.dtype."""
+    if dtype is None:
+        return _DEFAULT_DTYPE
+    if isinstance(dtype, str):
+        name = _ALIASES.get(dtype, dtype)
+        if name in _NAME_TO_DTYPE:
+            return jnp.dtype(_NAME_TO_DTYPE[name])
+        raise ValueError(f"unsupported dtype string: {dtype!r}")
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def set_default_dtype(dtype):
+    global _DEFAULT_DTYPE
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise ValueError("default dtype must be a floating dtype")
+    _DEFAULT_DTYPE = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), np.integer)

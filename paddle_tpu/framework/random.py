@@ -1,0 +1,70 @@
+"""RNG state.
+
+Reference parity: paddle/fluid/framework/generator.h + pybind/generator_py.cc
+(global generator with seed control). TPU-native design: state is a JAX PRNG
+key. Eager ops split the global key statefully; functionalized/jitted train
+steps swap the key for a traced one so randomness threads through the
+compiled step as data (see framework/jit.py).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+class Generator:
+    """Stateful wrapper over a jax PRNG key."""
+
+    def __init__(self, seed: int = 0):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+
+    def manual_seed(self, seed: int):
+        self._key = jax.random.key(seed)
+        self._seed = seed
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def split(self):
+        """Return a fresh subkey, advancing internal state."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- functionalization hooks (used by jit/train-step capture) ----------
+    def get_state(self):
+        return self._key
+
+    def set_state(self, key):
+        self._key = key
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int):
+    """Set the global RNG seed (paddle.seed)."""
+    _default_generator.manual_seed(int(value))
+    return _default_generator
+
+
+def split_key():
+    return _default_generator.split()
+
+
+@contextlib.contextmanager
+def fork_rng(seed_value: int | None = None):
+    """Temporarily fork RNG state (deterministic scope)."""
+    saved = _default_generator.get_state()
+    if seed_value is not None:
+        _default_generator.manual_seed(seed_value)
+    try:
+        yield
+    finally:
+        _default_generator.set_state(saved)

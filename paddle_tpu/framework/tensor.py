@@ -1,0 +1,451 @@
+"""Eager Tensor.
+
+Reference parity: paddle/fluid/framework/tensor.h:37 (dense tensor over an
+Allocation) + imperative VarBase (imperative/layer.cc). TPU-native design:
+storage IS a jax.Array — XLA owns device memory (SURVEY.md §7 step 1), so
+there is no separate Allocation; a Tensor adds autograd metadata
+(stop_gradient/grad/tape node), Paddle tensor-method surface, and place
+handling on top. Tensors transparently wrap JAX tracers, which is what lets
+the whole eager API run under jax.jit when functionalized.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .dtype import convert_dtype, get_default_dtype, is_floating
+from .place import CPUPlace, Place, TPUPlace, _default_place
+
+_tensor_id = [0]
+
+
+class Tensor:
+    __slots__ = (
+        "_array",
+        "stop_gradient",
+        "grad",
+        "name",
+        "persistable",
+        "_node",
+        "_out_index",
+        "__weakref__",
+    )
+
+    def __init__(self, data=None, dtype=None, place=None, stop_gradient=True):
+        if data is None:
+            arr = jnp.zeros((), convert_dtype(dtype))
+        else:
+            arr = _to_array(data, dtype, place)
+        self._array = arr
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.persistable = False
+        _tensor_id[0] += 1
+        self.name = f"generated_tensor_{_tensor_id[0]}"
+        self._node = None
+        self._out_index = 0
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def _from_array(cls, arr, stop_gradient=True, name=None):
+        t = cls.__new__(cls)
+        t._array = arr
+        t.stop_gradient = stop_gradient
+        t.grad = None
+        t.persistable = False
+        _tensor_id[0] += 1
+        t.name = name or f"generated_tensor_{_tensor_id[0]}"
+        t._node = None
+        t._out_index = 0
+        return t
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._array.shape)
+
+    @property
+    def ndim(self):
+        return self._array.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._array.shape)) if self._array.shape else 1
+
+    @property
+    def dtype(self):
+        return jnp.dtype(self._array.dtype)
+
+    @property
+    def place(self) -> Place:
+        try:
+            dev = next(iter(self._array.devices()))
+            return CPUPlace() if dev.platform == "cpu" else TPUPlace(dev.id)
+        except Exception:
+            return _default_place()
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    @property
+    def T(self):
+        from .. import ops
+
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    # -- data access --------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self._array)
+
+    def item(self):
+        return self._array.item()
+
+    def tolist(self):
+        return np.asarray(self._array).tolist()
+
+    def astype(self, dtype):
+        from .. import ops
+
+        return ops.cast(self, dtype)
+
+    cast = astype
+
+    def detach(self):
+        t = Tensor._from_array(self._array, stop_gradient=True, name=self.name)
+        t.persistable = self.persistable
+        return t
+
+    def clone(self):
+        from .. import ops
+
+        return ops.assign(self)
+
+    def to(self, place):
+        if isinstance(place, str):
+            name, _, idx = place.partition(":")
+            idx = int(idx) if idx else 0
+            place = CPUPlace() if name == "cpu" else TPUPlace(idx)
+        arr = jax.device_put(self._array, place.jax_device())
+        t = Tensor._from_array(arr, stop_gradient=self.stop_gradient, name=self.name)
+        t.persistable = self.persistable
+        return t
+
+    def cpu(self):
+        return self.to(CPUPlace())
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward(self, grad=grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        raise NotImplementedError("tensor hooks land with the hook subsystem")
+
+    # -- in-place-ish mutation (parameter updates) --------------------------
+    def set_value(self, value):
+        """Replace underlying storage (used by optimizers / state loading)."""
+        arr = value._array if isinstance(value, Tensor) else _to_array(value, self.dtype, None)
+        if tuple(arr.shape) != tuple(self._array.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self._array.shape}"
+            )
+        self._array = arr
+
+    def copy_(self, value):
+        self.set_value(value)
+        return self
+
+    def fill_(self, value):
+        self._array = jnp.full(self._array.shape, value, self._array.dtype)
+        return self
+
+    def zero_(self):
+        return self.fill_(0)
+
+    # -- operator sugar (dispatch to ops layer) -----------------------------
+    def _binary(self, op, other, reverse=False):
+        from .. import ops
+
+        fn = getattr(ops, op)
+        other = other if isinstance(other, Tensor) else to_tensor_like(other, self)
+        return fn(other, self) if reverse else fn(self, other)
+
+    def __add__(self, o):
+        return self._binary("add", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binary("subtract", o)
+
+    def __rsub__(self, o):
+        return self._binary("subtract", o, reverse=True)
+
+    def __mul__(self, o):
+        return self._binary("multiply", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binary("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binary("divide", o, reverse=True)
+
+    def __pow__(self, o):
+        return self._binary("elementwise_pow", o)
+
+    def __rpow__(self, o):
+        return self._binary("elementwise_pow", o, reverse=True)
+
+    def __mod__(self, o):
+        return self._binary("remainder", o)
+
+    def __floordiv__(self, o):
+        return self._binary("floor_divide", o)
+
+    def __matmul__(self, o):
+        from .. import ops
+
+        return ops.matmul(self, o)
+
+    def __neg__(self):
+        from .. import ops
+
+        return ops.scale(self, scale=-1.0)
+
+    def __abs__(self):
+        from .. import ops
+
+        return ops.abs(self)
+
+    # comparisons (non-differentiable)
+    def __eq__(self, o):
+        return self._binary("equal", o)
+
+    def __ne__(self, o):
+        return self._binary("not_equal", o)
+
+    def __lt__(self, o):
+        return self._binary("less_than", o)
+
+    def __le__(self, o):
+        return self._binary("less_equal", o)
+
+    def __gt__(self, o):
+        return self._binary("greater_than", o)
+
+    def __ge__(self, o):
+        return self._binary("greater_equal", o)
+
+    __hash__ = object.__hash__
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._array.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("truth value of a multi-element Tensor is ambiguous")
+        return bool(self._array)
+
+    def __float__(self):
+        return float(self._array)
+
+    def __int__(self):
+        return int(self._array)
+
+    def __getitem__(self, idx):
+        from .. import ops
+
+        return ops.getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        value = value if isinstance(value, Tensor) else to_tensor_like(value, self)
+        self._array = self._array.at[idx].set(value._array.astype(self._array.dtype))
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+            f"{grad_info},\n       {np.asarray(self._array)})"
+        )
+
+    # -- reduction / method sugar ------------------------------------------
+    def sum(self, axis=None, keepdim=False):
+        from .. import ops
+
+        return ops.sum(self, axis=axis, keepdim=keepdim)
+
+    def mean(self, axis=None, keepdim=False):
+        from .. import ops
+
+        return ops.mean(self, axis=axis, keepdim=keepdim)
+
+    def max(self, axis=None, keepdim=False):
+        from .. import ops
+
+        return ops.max(self, axis=axis, keepdim=keepdim)
+
+    def min(self, axis=None, keepdim=False):
+        from .. import ops
+
+        return ops.min(self, axis=axis, keepdim=keepdim)
+
+    def prod(self, axis=None, keepdim=False):
+        from .. import ops
+
+        return ops.prod(self, axis=axis, keepdim=keepdim)
+
+    def reshape(self, shape):
+        from .. import ops
+
+        return ops.reshape(self, shape)
+
+    def transpose(self, perm):
+        from .. import ops
+
+        return ops.transpose(self, perm)
+
+    def flatten(self, start_axis=0, stop_axis=-1):
+        from .. import ops
+
+        return ops.flatten(self, start_axis, stop_axis)
+
+    def squeeze(self, axis=None):
+        from .. import ops
+
+        return ops.squeeze(self, axis)
+
+    def unsqueeze(self, axis):
+        from .. import ops
+
+        return ops.unsqueeze(self, axis)
+
+    def argmax(self, axis=None, keepdim=False):
+        from .. import ops
+
+        return ops.argmax(self, axis=axis, keepdim=keepdim)
+
+    def matmul(self, o, transpose_x=False, transpose_y=False):
+        from .. import ops
+
+        return ops.matmul(self, o, transpose_x, transpose_y)
+
+    def exp(self):
+        from .. import ops
+
+        return ops.exp(self)
+
+    def log(self):
+        from .. import ops
+
+        return ops.log(self)
+
+    def sqrt(self):
+        from .. import ops
+
+        return ops.sqrt(self)
+
+    def tanh(self):
+        from .. import ops
+
+        return ops.tanh(self)
+
+    def abs(self):
+        from .. import ops
+
+        return ops.abs(self)
+
+    def clip(self, min=None, max=None):
+        from .. import ops
+
+        return ops.clip(self, min, max)
+
+    def pow(self, y):
+        return self.__pow__(y)
+
+    def norm(self, p=2, axis=None, keepdim=False):
+        from .. import ops
+
+        return ops.p_norm(self, p, axis, keepdim)
+
+
+def _to_array(data, dtype, place):
+    if isinstance(data, Tensor):
+        arr = data._array
+    elif isinstance(data, jax.Array):
+        arr = data
+    else:
+        npd = np.asarray(data)
+        if dtype is None and npd.dtype == np.float64:
+            npd = npd.astype(np.float32)  # paddle default: fp32
+        arr = npd
+    target_dtype = convert_dtype(dtype) if dtype is not None else None
+    dev = (place or _default_place()).jax_device()
+    arr = jax.device_put(jnp.asarray(arr), dev)
+    if target_dtype is not None and arr.dtype != target_dtype:
+        arr = arr.astype(target_dtype)
+    return arr
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def to_tensor_like(value, ref: Tensor):
+    """Convert a python scalar / ndarray to a Tensor matching ref's dtype
+    promotion rules (scalars adopt ref dtype when ref is floating)."""
+    if isinstance(value, Tensor):
+        return value
+    if isinstance(value, (int, float, bool)) and is_floating(ref.dtype):
+        return Tensor._from_array(jnp.asarray(value, ref.dtype))
+    if isinstance(value, float):
+        return Tensor._from_array(jnp.asarray(value, jnp.float32))
+    return Tensor(value)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (python/paddle/fluid/framework.py Parameter)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "need_clip")
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable)
+        self.persistable = True
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+        if name:
+            self.name = name
+
+    @classmethod
+    def from_array(cls, arr, name=None, trainable=True):
+        p = cls.__new__(cls)
+        p._array = jnp.asarray(arr)
+        p.stop_gradient = not trainable
+        p.grad = None
+        p.persistable = True
+        _tensor_id[0] += 1
+        p.name = name or f"param_{_tensor_id[0]}"
+        p._node = None
+        p._out_index = 0
+        p.trainable = trainable
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        p.need_clip = True
+        return p

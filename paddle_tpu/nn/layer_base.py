@@ -1,0 +1,265 @@
+"""Layer base class.
+
+Reference parity: python/paddle/fluid/dygraph/layers.py (Layer) — parameter/
+sublayer/buffer registration, train/eval mode, state_dict, hooks. TPU note:
+parameters are plain Tensors over jax arrays; functionalization for jitted
+train steps extracts them as a pytree (framework/jit.py).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ..framework.dtype import get_default_dtype
+from ..framework.tensor import Parameter, Tensor
+from . import initializer as I
+
+_layer_name_count = {}
+
+
+def _unique_layer_name(prefix):
+    idx = _layer_name_count.get(prefix, 0)
+    _layer_name_count[prefix] = idx + 1
+    return f"{prefix}_{idx}"
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        object.__setattr__(self, "_parameters", OrderedDict())
+        object.__setattr__(self, "_sub_layers", OrderedDict())
+        object.__setattr__(self, "_buffers", OrderedDict())
+        self.training = True
+        self._dtype = dtype
+        self._full_name = _unique_layer_name(
+            name_scope or self.__class__.__name__.lower()
+        )
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call Layer.__init__ before assigning parameters")
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                del params[name]
+            if layers is not None and name in layers:
+                del layers[name]
+            if buffers is not None and name in buffers:
+                if isinstance(value, Tensor):
+                    buffers[name] = value
+                    return
+                del buffers[name]
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}"
+        )
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None:
+            tensor.persistable = persistable
+        self._buffers[name] = tensor
+        return tensor
+
+    def create_parameter(
+        self,
+        shape,
+        dtype=None,
+        is_bias=False,
+        default_initializer=None,
+        attr=None,
+    ):
+        """LayerHelper.create_parameter equivalent (fluid/layer_helper.py)."""
+        init = default_initializer
+        name = None
+        trainable = True
+        if attr is not None and attr is not False:
+            # ParamAttr-like: accept dict or ParamAttr
+            init = getattr(attr, "initializer", None) or init
+            name = getattr(attr, "name", None)
+            trainable = getattr(attr, "trainable", True)
+        init = I._resolve(init, is_bias=is_bias)
+        arr = init(shape, dtype or self._dtype or get_default_dtype())
+        return Parameter.from_array(arr, name=name, trainable=trainable)
+
+    # -- traversal ----------------------------------------------------------
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, p in self._parameters.items():
+            if p is not None and id(p) not in seen:
+                seen.add(id(p))
+                yield (f"{prefix}{name}", p)
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                yield from layer.named_parameters(prefix=f"{prefix}{lname}.")
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        for name, b in self._buffers.items():
+            if b is not None:
+                yield (f"{prefix}{name}", b)
+        if include_sublayers:
+            for lname, layer in self._sub_layers.items():
+                if layer is None:
+                    continue
+                yield from layer.named_buffers(prefix=f"{prefix}{lname}.")
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def children(self):
+        return iter(self._sub_layers.values())
+
+    def named_children(self):
+        return iter(self._sub_layers.items())
+
+    def sublayers(self, include_self=False):
+        out = [self] if include_self else []
+        for layer in self._sub_layers.values():
+            if layer is not None:
+                out.extend(layer.sublayers(include_self=True))
+        return out
+
+    def named_sublayers(self, prefix="", include_self=False):
+        if include_self:
+            yield prefix.rstrip("."), self
+        for name, layer in self._sub_layers.items():
+            if layer is None:
+                continue
+            full = f"{prefix}{name}"
+            yield full, layer
+            yield from layer.named_sublayers(prefix=f"{full}.")
+
+    def apply(self, fn):
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def full_name(self):
+        return self._full_name
+
+    # -- modes --------------------------------------------------------------
+    def train(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = True
+        return self
+
+    def eval(self):
+        for layer in self.sublayers(include_self=True):
+            layer.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, include_sublayers=True):
+        out = OrderedDict()
+        for name, p in self.named_parameters(include_sublayers=include_sublayers):
+            out[name] = p
+        for name, b in self.named_buffers(include_sublayers=include_sublayers):
+            if b is not None and b.persistable:
+                out[name] = b
+        return out
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing = []
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            value = state_dict[name]
+            arr = value.numpy() if isinstance(value, Tensor) else np.asarray(value)
+            target.set_value(arr.astype(target.numpy().dtype))
+        unexpected = [k for k in state_dict if k not in own]
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- hooks --------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook):
+        handle = _HookHandle(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call ---------------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, layer in self._sub_layers.items():
+            sub = repr(layer).split("\n")
+            sub = [sub[0]] + ["  " + s for s in sub[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub))
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            return main + "\n" + "\n".join(lines) + "\n)"
+        return main + ")"
+
+    def extra_repr(self):
+        return ""
+
+
+class _HookHandle:
+    _next_id = [0]
+
+    def __init__(self, store):
+        self.id = _HookHandle._next_id[0]
+        _HookHandle._next_id[0] += 1
+        self._store = store
+
+    def remove(self):
+        self._store.pop(self.id, None)

@@ -1,0 +1,548 @@
+"""Core nn layers.
+
+Reference parity: python/paddle/nn/layer/common.py, conv.py, norm.py,
+pooling.py + fluid/dygraph/nn.py. Layers hold Parameters and dispatch to the
+functional ops; everything composes under jit via functionalization.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import ops
+from ..framework.tensor import Parameter, Tensor
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+
+class Linear(Layer):
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr, default_initializer=I.XavierUniform()
+        )
+        if bias_attr is not False:
+            self.bias = self.create_parameter([out_features], attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, training=self.training, mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0) if weight_attr is None else None,
+        )
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis = start_axis
+        self.stop_axis = stop_axis
+
+    def forward(self, x):
+        return ops.flatten(x, self.start_axis, self.stop_axis)
+
+
+# -- conv --------------------------------------------------------------------
+
+
+class Conv2D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size, kernel_size)
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation, groups=groups)
+        self.data_format = data_format
+        fan_in = in_channels // groups * ks[0] * ks[1]
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, ks[0], ks[1]], attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in),
+        )
+        if bias_attr is not False:
+            bound = 1.0 / np.sqrt(fan_in)
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound) if bias_attr is None else None,
+            )
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, data_format=self.data_format, **self._attrs)
+
+
+class Conv1D(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self._attrs = dict(stride=stride, padding=padding, dilation=dilation, groups=groups)
+        fan_in = in_channels // groups * kernel_size
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kernel_size], attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in),
+        )
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, **self._attrs)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) else (kernel_size, kernel_size)
+        self._attrs = dict(stride=stride, padding=padding, output_padding=output_padding,
+                           dilation=dilation, groups=groups)
+        fan_in = in_channels * ks[0] * ks[1] // groups
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, ks[0], ks[1]], attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in),
+        )
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.conv2d_transpose(x, self.weight, self.bias, **self._attrs)
+
+
+# -- pooling -----------------------------------------------------------------
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False):
+        super().__init__()
+        self._attrs = dict(kernel_size=kernel_size, stride=stride, padding=padding, ceil_mode=ceil_mode)
+
+    def forward(self, x):
+        return F.max_pool2d(x, **self._attrs)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True):
+        super().__init__()
+        self._attrs = dict(kernel_size=kernel_size, stride=stride, padding=padding,
+                           ceil_mode=ceil_mode, exclusive=exclusive)
+
+    def forward(self, x):
+        return F.avg_pool2d(x, **self._attrs)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)
+
+
+# -- normalization -----------------------------------------------------------
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = "NCHW" if data_format in ("NCHW", "NCL", "NCDHW") else "NHWC"
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(np.zeros(num_features, np.float32)))
+        self.register_buffer("_variance", Tensor(np.ones(num_features, np.float32)))
+
+    def forward(self, x):
+        return F.batch_norm(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format,
+        )
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+BatchNorm = BatchNorm2D  # fluid.dygraph.BatchNorm compat
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Under pjit/shard_map data parallelism the batch statistics are computed
+    over the global (sharded) batch automatically when the reduction axes are
+    replicated — matching nccl SyncBatchNorm semantics without extra comms
+    code. Standalone eager use equals BatchNorm."""
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, weight_attr=None, bias_attr=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self.normalized_shape = list(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape, self.weight, self.bias, self.epsilon)
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}"
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-5, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_channels], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, self.weight, self.bias, self.epsilon)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = None if weight_attr is False else self.create_parameter(
+            [num_features], attr=weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, self.epsilon)
+
+
+# -- activations as layers ---------------------------------------------------
+
+
+def _act_layer(name, fn_name, **defaults):
+    def forward(self, x):
+        fn = getattr(F, fn_name)
+        return fn(x, **{k: getattr(self, k) for k in defaults})
+
+    def __init__(self, **kwargs):
+        Layer.__init__(self)
+        for k, v in defaults.items():
+            setattr(self, k, kwargs.get(k, v))
+
+    return type(name, (Layer,), {"__init__": __init__, "forward": forward})
+
+
+ReLU = _act_layer("ReLU", "relu")
+ReLU6 = _act_layer("ReLU6", "relu6")
+LeakyReLU = _act_layer("LeakyReLU", "leaky_relu", negative_slope=0.01)
+ELU = _act_layer("ELU", "elu", alpha=1.0)
+CELU = _act_layer("CELU", "celu", alpha=1.0)
+SELU = _act_layer("SELU", "selu")
+GELU = _act_layer("GELU", "gelu", approximate=False)
+Sigmoid = _act_layer("Sigmoid", "sigmoid")
+LogSigmoid = _act_layer("LogSigmoid", "log_sigmoid")
+Tanh = _act_layer("Tanh", "tanh")
+Hardsigmoid = _act_layer("Hardsigmoid", "hardsigmoid")
+Hardswish = _act_layer("Hardswish", "hardswish")
+Hardtanh = _act_layer("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Hardshrink = _act_layer("Hardshrink", "hardshrink", threshold=0.5)
+Softshrink = _act_layer("Softshrink", "softshrink", threshold=0.5)
+Softplus = _act_layer("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softsign = _act_layer("Softsign", "softsign")
+Swish = _act_layer("Swish", "swish")
+Silu = _act_layer("Silu", "silu")
+Mish = _act_layer("Mish", "mish")
+Tanhshrink = _act_layer("Tanhshrink", "tanh_shrink")
+Softmax = _act_layer("Softmax", "softmax", axis=-1)
+LogSoftmax = _act_layer("LogSoftmax", "log_softmax", axis=-1)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [num_parameters], attr=weight_attr, default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        w = self.weight
+        if w.size > 1:
+            shape = [1] * x.ndim
+            shape[1] = w.size
+            w = ops.reshape(w, shape)
+        return F.prelu(x, w)
+
+
+# -- containers (fluid/dygraph/container.py) --------------------------------
+
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)) and not isinstance(layers[0], Layer):
+            layers = layers[0]
+        for i, item in enumerate(layers):
+            if isinstance(item, tuple):
+                name, layer = item
+            else:
+                name, layer = str(i), item
+            self.add_sublayer(name, layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers=None):
+        super().__init__()
+        for i, layer in enumerate(sublayers or []):
+            self.add_sublayer(str(i), layer)
+
+    def append(self, layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def extend(self, layers):
+        for layer in layers:
+            self.append(layer)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx % len(self._sub_layers))]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError("LayerList is a container; call sublayers directly")
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        for i, p in enumerate(parameters or []):
+            self.add_parameter(str(i), p)
+
+    def append(self, p):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx % len(self._parameters))]
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters.values())
+
+
+# -- losses (paddle/nn/layer/loss.py) ---------------------------------------
+
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index=-100, reduction="mean",
+                 soft_label=False, axis=-1, use_softmax=True):
+        super().__init__()
+        self.weight = weight
+        self.ignore_index = ignore_index
+        self.reduction = reduction
+        self.soft_label = soft_label
+        self.axis = axis
+        self.use_softmax = use_softmax
+
+    def forward(self, input, label):
+        return F.cross_entropy(
+            input, label, weight=self.weight, soft_label=self.soft_label,
+            axis=self.axis, ignore_index=self.ignore_index,
+            reduction=self.reduction, use_softmax=self.use_softmax,
+        )
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, reduction=self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, reduction=self.reduction)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, reduction=self.reduction, delta=self.delta)
+
+
+class BCELoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.binary_cross_entropy(input, label, reduction=self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, reduction="mean", pos_weight=None):
+        super().__init__()
+        self.reduction = reduction
+        self.pos_weight = pos_weight
+
+    def forward(self, logits, label):
+        return F.binary_cross_entropy_with_logits(
+            logits, label, reduction=self.reduction, pos_weight=self.pos_weight)
+
+
+class NLLLoss(Layer):
+    def __init__(self, reduction="mean", ignore_index=-100):
+        super().__init__()
+        self.reduction = reduction
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, reduction=self.reduction, ignore_index=self.ignore_index)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, reduction=self.reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean"):
+        super().__init__()
+        self.margin = margin
+        self.reduction = reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, margin=self.margin, reduction=self.reduction)
+
+
+# -- misc --------------------------------------------------------------------
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest", align_corners=False):
+        super().__init__()
+        self._attrs = dict(size=size, scale_factor=scale_factor, mode=mode, align_corners=align_corners)
+
+    def forward(self, x):
+        return F.interpolate(x, **self._attrs)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor)
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * 4
+        # paddle Pad2D: [left, right, top, bottom] over NCHW spatial dims
+        l, r, t, b = padding
+        self.paddings = [0, 0, 0, 0, t, b, l, r]
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        return ops.pad(x, self.paddings, mode=self.mode, value=self.value)

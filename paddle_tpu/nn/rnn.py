@@ -1,0 +1,251 @@
+"""Recurrent layers.
+
+Reference parity: operators/lstm_op.cc, gru_op.cc, recurrent_op.cc and
+python/paddle/fluid/dygraph/rnn.py. TPU-native: the time loop is a
+`lax.scan` (static trip count, compiles to one fused XLA while-loop);
+gates are computed as one big matmul per step so the MXU stays busy.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import ops
+from ..framework.autograd import apply_op
+from ..ops.registry import register_op
+from . import initializer as I
+from .layer_base import Layer
+
+
+@register_op("rnn_lstm_layer", num_outputs=3)
+def _lstm_layer_kernel(x, h0, c0, w_ih, w_hh, b_ih, b_hh, *, reverse=False):
+    """x: [B, T, I]; returns (y [B, T, H], h [B, H], c [B, H])."""
+    xs = jnp.swapaxes(x, 0, 1)  # [T, B, I]
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    # precompute input projections for all steps in one matmul
+    gates_x = jnp.einsum("tbi,gi->tbg", xs, w_ih) + b_ih
+
+    def step(carry, gx):
+        h, c = carry
+        gates = gx + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * c + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    (h, c), ys = lax.scan(step, (h0, c0), gates_x)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return jnp.swapaxes(ys, 0, 1), h, c
+
+
+@register_op("rnn_gru_layer", num_outputs=2)
+def _gru_layer_kernel(x, h0, w_ih, w_hh, b_ih, b_hh, *, reverse=False):
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    gates_x = jnp.einsum("tbi,gi->tbg", xs, w_ih) + b_ih
+
+    def step(h, gx):
+        gr_x, gz_x, gn_x = jnp.split(gx, 3, axis=-1)
+        hh = h @ w_hh.T
+        gr_h, gz_h, gn_h = jnp.split(hh + b_hh, 3, axis=-1)
+        r = jax.nn.sigmoid(gr_x + gr_h)
+        z = jax.nn.sigmoid(gz_x + gz_h)
+        n = jnp.tanh(gn_x + r * gn_h)
+        h = (1 - z) * n + z * h
+        return h, h
+
+    h, ys = lax.scan(step, h0, gates_x)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return jnp.swapaxes(ys, 0, 1), h
+
+
+@register_op("rnn_simple_layer", num_outputs=2)
+def _simple_rnn_layer_kernel(x, h0, w_ih, w_hh, b_ih, b_hh, *, activation="tanh", reverse=False):
+    xs = jnp.swapaxes(x, 0, 1)
+    if reverse:
+        xs = jnp.flip(xs, 0)
+    gates_x = jnp.einsum("tbi,hi->tbh", xs, w_ih) + b_ih
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+
+    def step(h, gx):
+        h = act(gx + h @ w_hh.T + b_hh)
+        return h, h
+
+    h, ys = lax.scan(step, h0, gates_x)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return jnp.swapaxes(ys, 0, 1), h
+
+
+class RNNBase(Layer):
+    MODE = "LSTM"
+    GATES = 4
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 dropout=0.0, time_major=False, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.num_directions = 2 if direction in ("bidirect", "bidirectional") else 1
+        self.dropout = dropout
+        self.time_major = time_major
+        g = self.GATES
+        std = 1.0 / (hidden_size**0.5)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                suffix = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter(
+                    f"weight_ih{suffix}",
+                    self.create_parameter([g * hidden_size, in_sz],
+                                          default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    f"weight_hh{suffix}",
+                    self.create_parameter([g * hidden_size, hidden_size],
+                                          default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    f"bias_ih{suffix}",
+                    self.create_parameter([g * hidden_size], is_bias=True,
+                                          default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    f"bias_hh{suffix}",
+                    self.create_parameter([g * hidden_size], is_bias=True,
+                                          default_initializer=I.Uniform(-std, std)))
+
+    def _weights(self, layer, d):
+        suffix = f"_l{layer}" + ("_reverse" if d else "")
+        return (self._parameters[f"weight_ih{suffix}"],
+                self._parameters[f"weight_hh{suffix}"],
+                self._parameters[f"bias_ih{suffix}"],
+                self._parameters[f"bias_hh{suffix}"])
+
+    def forward(self, inputs, initial_states=None):
+        if self.time_major:
+            inputs = ops.transpose(inputs, [1, 0, 2])
+        b = inputs.shape[0]
+        nd = self.num_directions
+
+        if self.MODE == "LSTM":
+            if initial_states is None:
+                h0 = ops.zeros([self.num_layers * nd, b, self.hidden_size], inputs.dtype)
+                c0 = ops.zeros_like(h0)
+            else:
+                h0, c0 = initial_states
+        else:
+            h0 = initial_states if initial_states is not None else ops.zeros(
+                [self.num_layers * nd, b, self.hidden_size], inputs.dtype)
+
+        out = inputs
+        last_h, last_c = [], []
+        for layer in range(self.num_layers):
+            outs_d = []
+            for d in range(nd):
+                idx = layer * nd + d
+                w_ih, w_hh, b_ih, b_hh = self._weights(layer, d)
+                if self.MODE == "LSTM":
+                    y, h, c = apply_op(
+                        "rnn_lstm_layer", _lstm_layer_kernel,
+                        [out, h0[idx], c0[idx], w_ih, w_hh, b_ih, b_hh],
+                        {"reverse": bool(d)}, )
+                    last_c.append(c)
+                elif self.MODE == "GRU":
+                    y, h = apply_op(
+                        "rnn_gru_layer", _gru_layer_kernel,
+                        [out, h0[idx], w_ih, w_hh, b_ih, b_hh], {"reverse": bool(d)})
+                else:
+                    y, h = apply_op(
+                        "rnn_simple_layer", _simple_rnn_layer_kernel,
+                        [out, h0[idx], w_ih, w_hh, b_ih, b_hh],
+                        {"activation": "tanh", "reverse": bool(d)})
+                outs_d.append(y)
+                last_h.append(h)
+            out = outs_d[0] if nd == 1 else ops.concat(outs_d, axis=-1)
+            if self.dropout and layer < self.num_layers - 1:
+                from . import functional as F
+
+                out = F.dropout(out, p=self.dropout, training=self.training)
+
+        final_h = ops.stack(last_h, axis=0)
+        if self.time_major:
+            out = ops.transpose(out, [1, 0, 2])
+        if self.MODE == "LSTM":
+            return out, (final_h, ops.stack(last_c, axis=0))
+        return out, final_h
+
+
+class LSTM(RNNBase):
+    MODE = "LSTM"
+    GATES = 4
+
+
+class GRU(RNNBase):
+    MODE = "GRU"
+    GATES = 3
+
+
+class SimpleRNN(RNNBase):
+    MODE = "RNN"
+    GATES = 1
+
+
+class LSTMCell(Layer):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        std = 1.0 / (hidden_size**0.5)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter([4 * hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([4 * hidden_size], is_bias=True)
+        self.hidden_size = hidden_size
+
+    def forward(self, x, states=None):
+        if states is None:
+            h = ops.zeros([x.shape[0], self.hidden_size], x.dtype)
+            c = ops.zeros_like(h)
+        else:
+            h, c = states
+        gates = ops.matmul(x, self.weight_ih, transpose_y=True) + self.bias_ih \
+            + ops.matmul(h, self.weight_hh, transpose_y=True) + self.bias_hh
+        i, f, g, o = ops.split(gates, 4, axis=-1)
+        i, f, o = ops.sigmoid(i), ops.sigmoid(f), ops.sigmoid(o)
+        g = ops.tanh(g)
+        c = f * c + i * g
+        h = o * ops.tanh(c)
+        return h, (h, c)
+
+
+class GRUCell(Layer):
+    def __init__(self, input_size, hidden_size):
+        super().__init__()
+        std = 1.0 / (hidden_size**0.5)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter([3 * hidden_size], is_bias=True)
+        self.bias_hh = self.create_parameter([3 * hidden_size], is_bias=True)
+        self.hidden_size = hidden_size
+
+    def forward(self, x, states=None):
+        h = states if states is not None else ops.zeros([x.shape[0], self.hidden_size], x.dtype)
+        gx = ops.matmul(x, self.weight_ih, transpose_y=True) + self.bias_ih
+        gh = ops.matmul(h, self.weight_hh, transpose_y=True) + self.bias_hh
+        rx, zx, nx = ops.split(gx, 3, axis=-1)
+        rh, zh, nh = ops.split(gh, 3, axis=-1)
+        r = ops.sigmoid(rx + rh)
+        z = ops.sigmoid(zx + zh)
+        n = ops.tanh(nx + r * nh)
+        h = (1 - z) * n + z * h
+        return h, h

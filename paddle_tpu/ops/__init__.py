@@ -1,0 +1,876 @@
+"""Eager op API.
+
+Reference parity: the generated `core.ops.*` fast functions
+(paddle/fluid/pybind/op_function_generator.cc:204) + the python
+paddle.tensor/* wrappers. Each wrapper coerces inputs to Tensor, pulls a
+PRNG key for stochastic ops, and dispatches through the autograd tracer
+(framework/autograd.py apply_op), which records the vjp tape node.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from ..framework.autograd import apply_op, no_grad  # noqa: F401
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.tensor import Tensor, to_tensor
+from . import kernels as _k  # registers all kernels  # noqa: F401
+from .registry import all_ops, get_op, has_op, kernel  # noqa: F401
+
+
+def _t(x, dtype=None):
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(x, dtype=dtype)
+
+
+def _run(name, *tensors, **attrs):
+    # Mode-aware dispatch (paddle 2.0 unified API): under enable_static(),
+    # ops append OpDescs to the default program instead of executing.
+    from ..static.program import Variable, in_static_mode
+
+    if in_static_mode() and (
+        any(isinstance(t, Variable) for t in tensors) or not tensors
+    ):
+        from ..static.op_append import append_static_op
+
+        return append_static_op(name, tensors, attrs)
+    return apply_op(name, kernel(name), tensors, attrs)
+
+
+# -- binary math -------------------------------------------------------------
+
+
+def _binary(name):
+    def fn(x, y, name_=None):
+        x = _t(x)
+        y = y if isinstance(y, Tensor) else _t(y, dtype=x.dtype if x.dtype.kind == "f" else None)
+        return _run(name, x, y)
+
+    fn.__name__ = name
+    return fn
+
+
+add = _binary("elementwise_add")
+subtract = _binary("elementwise_sub")
+multiply = _binary("elementwise_mul")
+divide = _binary("elementwise_div")
+elementwise_pow = _binary("elementwise_pow")
+remainder = _binary("elementwise_mod")
+mod = remainder
+floor_divide = _binary("elementwise_floordiv")
+maximum = _binary("elementwise_max")
+minimum = _binary("elementwise_min")
+atan2 = _binary("atan2")
+equal = _binary("equal")
+not_equal = _binary("not_equal")
+less_than = _binary("less_than")
+less_equal = _binary("less_equal")
+greater_than = _binary("greater_than")
+greater_equal = _binary("greater_equal")
+logical_and = _binary("logical_and")
+logical_or = _binary("logical_or")
+logical_xor = _binary("logical_xor")
+bitwise_and = _binary("bitwise_and")
+bitwise_or = _binary("bitwise_or")
+bitwise_xor = _binary("bitwise_xor")
+
+
+def logical_not(x):
+    return _run("logical_not", _t(x))
+
+
+def bitwise_not(x):
+    return _run("bitwise_not", _t(x))
+
+
+# -- unary math --------------------------------------------------------------
+
+
+def _unary(name):
+    def fn(x, name_=None):
+        return _run(name, _t(x))
+
+    fn.__name__ = name
+    return fn
+
+
+abs = _unary("abs")
+exp = _unary("exp")
+expm1 = _unary("expm1")
+log = _unary("log")
+log2 = _unary("log2")
+log10 = _unary("log10")
+log1p = _unary("log1p")
+sqrt = _unary("sqrt")
+rsqrt = _unary("rsqrt")
+square = _unary("square")
+sin = _unary("sin")
+cos = _unary("cos")
+tan = _unary("tan")
+asin = _unary("asin")
+acos = _unary("acos")
+atan = _unary("atan")
+sinh = _unary("sinh")
+cosh = _unary("cosh")
+asinh = _unary("asinh")
+acosh = _unary("acosh")
+atanh = _unary("atanh")
+tanh = _unary("tanh")
+floor = _unary("floor")
+ceil = _unary("ceil")
+round = _unary("round")
+sign = _unary("sign")
+reciprocal = _unary("reciprocal")
+erf = _unary("erf")
+erfinv = _unary("erfinv")
+digamma = _unary("digamma")
+lgamma = _unary("lgamma")
+sigmoid = _unary("sigmoid")
+log_sigmoid = _unary("logsigmoid")
+isnan = _unary("isnan")
+isinf = _unary("isinf")
+isfinite = _unary("isfinite")
+trunc = _unary("trunc")
+
+
+def neg(x):
+    return scale(_t(x), scale=-1.0)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = _run("scale", _t(x), scale=float(scale), bias=float(bias), bias_after_scale=bias_after_scale)
+    if act:
+        out = globals()[act](out)
+    return out
+
+
+def clip(x, min=None, max=None):
+    min = float(min) if min is not None and not isinstance(min, Tensor) else min
+    max = float(max) if max is not None and not isinstance(max, Tensor) else max
+    if isinstance(min, Tensor):
+        min = min.item()
+    if isinstance(max, Tensor):
+        max = max.item()
+    return _run("clip", _t(x), min=min, max=max)
+
+
+def pow(x, y):
+    if isinstance(y, (int, float)):
+        return _run("pow", _t(x), factor=float(y))
+    return elementwise_pow(x, y)
+
+
+# -- matrix ------------------------------------------------------------------
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _run("matmul", _t(x), _t(y), transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    return _run("mul", _t(x), _t(y), x_num_col_dims=x_num_col_dims, y_num_col_dims=y_num_col_dims)
+
+
+def bmm(x, y):
+    return _run("bmm", _t(x), _t(y))
+
+
+def dot(x, y):
+    return _run("dot", _t(x), _t(y))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return _run("addmm", _t(input), _t(x), _t(y), beta=beta, alpha=alpha)
+
+
+def cross(x, y, axis=-1):
+    return _run("cross", _t(x), _t(y), axis=axis)
+
+
+def cholesky(x, upper=False):
+    return _run("cholesky", _t(x), upper=upper)
+
+
+def matrix_power(x, n):
+    return _run("matrix_power", _t(x), n=n)
+
+
+def inverse(x):
+    return _run("inverse", _t(x))
+
+
+def einsum(equation, *operands):
+    return _run("einsum", *[_t(o) for o in operands], equation=equation)
+
+
+def t(x):
+    x = _t(x)
+    if x.ndim < 2:
+        return x
+    return transpose(x, [1, 0])
+
+
+# -- reductions --------------------------------------------------------------
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    out = _run("reduce_sum", _t(x), dim=axis, keep_dim=keepdim)
+    if dtype is not None:
+        out = cast(out, dtype)
+    return out
+
+
+def mean(x, axis=None, keepdim=False):
+    return _run("reduce_mean", _t(x), dim=axis, keep_dim=keepdim)
+
+
+def max(x, axis=None, keepdim=False):
+    return _run("reduce_max", _t(x), dim=axis, keep_dim=keepdim)
+
+
+def min(x, axis=None, keepdim=False):
+    return _run("reduce_min", _t(x), dim=axis, keep_dim=keepdim)
+
+
+def prod(x, axis=None, keepdim=False):
+    return _run("reduce_prod", _t(x), dim=axis, keep_dim=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return _run("reduce_any", _t(x), dim=axis, keep_dim=keepdim)
+
+
+def all(x, axis=None, keepdim=False):
+    return _run("reduce_all", _t(x), dim=axis, keep_dim=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return _run("logsumexp", _t(x), axis=axis, keepdim=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return _run("arg_max", _t(x), axis=axis, keepdims=keepdim, dtype=str(convert_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return _run("arg_min", _t(x), axis=axis, keepdims=keepdim, dtype=str(convert_dtype(dtype)))
+
+
+def p_norm(x, p=2, axis=None, keepdim=False, epsilon=1e-12):
+    return _run("p_norm", _t(x), porder=float(p), axis=axis, keepdim=keepdim, epsilon=epsilon)
+
+
+norm = p_norm
+
+
+def cumsum(x, axis=None):
+    return _run("cumsum", _t(x), axis=axis)
+
+
+def cumprod(x, dim=None):
+    return _run("cumprod", _t(x), dim=dim)
+
+
+# -- manipulation ------------------------------------------------------------
+
+
+def cast(x, dtype):
+    return _run("cast", _t(x), dtype=str(convert_dtype(dtype)))
+
+
+def reshape(x, shape):
+    return _run("reshape", _t(x), shape=tuple(shape))
+
+
+def transpose(x, perm):
+    return _run("transpose", _t(x), perm=tuple(perm))
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    return _run("flatten", _t(x), start_axis=start_axis, stop_axis=stop_axis)
+
+
+def squeeze(x, axis=None):
+    return _run("squeeze", _t(x), axes=axis)
+
+
+def unsqueeze(x, axis):
+    return _run("unsqueeze", _t(x), axes=axis)
+
+
+def concat(xs, axis=0):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _run("concat", *[_t(x) for x in xs], axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    outs = _run("split", _t(x), num_or_sections=num_or_sections if isinstance(num_or_sections, int) else tuple(num_or_sections), axis=axis)
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis)
+
+
+def stack(xs, axis=0):
+    return _run("stack", *[_t(x) for x in xs], axis=axis)
+
+
+def unstack(x, axis=0, num=None):
+    return list(_run("unstack", _t(x), axis=axis, num=num))
+
+
+def unbind(x, axis=0):
+    return list(_run("unbind", _t(x), axis=axis))
+
+
+def slice(x, axes, starts, ends):
+    return _run("slice", _t(x), axes=tuple(axes), starts=tuple(starts), ends=tuple(ends))
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    return _run("strided_slice", _t(x), axes=tuple(axes), starts=tuple(starts), ends=tuple(ends), strides=tuple(strides))
+
+
+def getitem(x, idx):
+    # Tensor indices route to gather/gather_nd to stay static-shape friendly
+    if isinstance(idx, Tensor):
+        if idx.dtype == jnp.bool_:
+            raise NotImplementedError(
+                "boolean mask indexing produces dynamic shapes; use paddle_tpu.where/masked_select"
+            )
+        return index_select(x, idx.flatten(), axis=0) if idx.ndim == 1 else gather(x, idx, axis=0)
+    if isinstance(idx, tuple):
+        idx = tuple(i._array if isinstance(i, Tensor) else i for i in idx)
+    return _run("getitem", _t(x), idx=idx)
+
+
+def gather(x, index, axis=0):
+    return _run("gather", _t(x), _t(index), axis=axis)
+
+
+def gather_nd(x, index):
+    return _run("gather_nd", _t(x), _t(index))
+
+
+def scatter(x, index, updates, overwrite=True):
+    return _run("scatter", _t(x), _t(index), _t(updates), overwrite=overwrite)
+
+
+def scatter_nd_add(x, index, updates):
+    return _run("scatter_nd_add", _t(x), _t(index), _t(updates))
+
+
+def index_select(x, index, axis=0):
+    return _run("index_select", _t(x), _t(index), axis=axis)
+
+
+def index_sample(x, index):
+    return _run("index_sample", _t(x), _t(index))
+
+
+def take_along_axis(x, index, axis):
+    return _run("take_along_axis", _t(x), _t(index), axis=axis)
+
+
+def masked_select(x, mask):
+    # dynamic-shape op: executes on host values (not jittable) — paddle parity
+    arr = np.asarray(_t(x)._array)[np.asarray(_t(mask)._array)]
+    return to_tensor(arr)
+
+
+def masked_fill(x, mask, value):
+    return _run("masked_fill", _t(x), _t(mask), value=float(value))
+
+
+def tile(x, repeat_times):
+    return _run("tile", _t(x), repeat_times=tuple(repeat_times))
+
+
+def expand(x, shape):
+    return _run("expand", _t(x), shape=tuple(shape))
+
+
+def expand_as(x, y):
+    return _run("broadcast_to", _t(x), shape=tuple(_t(y).shape))
+
+
+def broadcast_to(x, shape):
+    return _run("broadcast_to", _t(x), shape=tuple(shape))
+
+
+def where(cond, x=None, y=None):
+    if x is None and y is None:
+        idx = np.argwhere(np.asarray(_t(cond)._array))
+        return to_tensor(idx.astype(np.int64))
+    return _run("where", _t(cond), _t(x), _t(y))
+
+
+def pad(x, paddings, mode="constant", value=0.0):
+    return _run("pad", _t(x), paddings=tuple(paddings), mode=mode, value=float(value))
+
+
+def roll(x, shifts, axis=None):
+    return _run("roll", _t(x), shifts=shifts, axis=axis)
+
+
+def flip(x, axis):
+    return _run("flip", _t(x), axis=axis)
+
+
+def tril(x, diagonal=0):
+    return _run("tril", _t(x), diagonal=diagonal)
+
+
+def triu(x, diagonal=0):
+    return _run("triu", _t(x), diagonal=diagonal)
+
+
+def diag(x, offset=0, padding_value=0.0):
+    return _run("diag", _t(x), offset=offset, padding_value=padding_value)
+
+
+def assign(x, output=None):
+    out = _run("assign", _t(x))
+    if output is not None:
+        output.set_value(out)
+        return output
+    return out
+
+
+def one_hot(x, num_classes):
+    return _run("one_hot", _t(x), num_classes=num_classes)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    vals, idx = _run("top_k", _t(x), k=k, axis=axis, largest=largest, sorted=sorted)
+    return vals, idx
+
+
+def argsort(x, axis=-1, descending=False):
+    _, idx = _run("argsort", _t(x), axis=axis, descending=descending)
+    return idx
+
+
+def sort(x, axis=-1, descending=False):
+    return _run("sort", _t(x), axis=axis, descending=descending)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    return _run("kthvalue", _t(x), k=k, axis=axis, keepdim=keepdim)
+
+
+def meshgrid(*xs):
+    return list(_run("meshgrid", *[_t(x) for x in xs]))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return _run("repeat_interleave", _t(x), repeats=repeats, axis=axis)
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    return _run("shard_index", _t(x), index_num=index_num, nshards=nshards, shard_id=shard_id, ignore_value=ignore_value)
+
+
+def numel(x):
+    return to_tensor(np.int64(_t(x).size))
+
+
+def shape(x):
+    return to_tensor(np.array(_t(x).shape, dtype=np.int32))
+
+
+# -- activations -------------------------------------------------------------
+
+relu = _unary("relu")
+selu = _unary("selu")
+softsign = _unary("softsign")
+tanh_shrink = _unary("tanh_shrink")
+swish = _unary("swish")
+silu = _unary("swish")
+mish = _unary("mish")
+
+
+def relu6(x, threshold=6.0):
+    return _run("relu6", _t(x), threshold=threshold)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _run("leaky_relu", _t(x), alpha=negative_slope)
+
+
+def elu(x, alpha=1.0):
+    return _run("elu", _t(x), alpha=alpha)
+
+
+def celu(x, alpha=1.0):
+    return _run("celu", _t(x), alpha=alpha)
+
+
+def gelu(x, approximate=False):
+    return _run("gelu", _t(x), approximate=approximate)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return _run("hard_sigmoid", _t(x), slope=slope, offset=offset)
+
+
+def hardswish(x):
+    return _run("hard_swish", _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return _run("hard_tanh", _t(x), min=min, max=max)
+
+
+def hardshrink(x, threshold=0.5):
+    return _run("hard_shrink", _t(x), threshold=threshold)
+
+
+def softshrink(x, threshold=0.5):
+    return _run("softshrink", _t(x), lambda_=threshold)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return _run("softplus", _t(x), beta=beta, threshold=threshold)
+
+
+def prelu(x, weight):
+    return _run("prelu", _t(x), _t(weight))
+
+
+def softmax(x, axis=-1):
+    return _run("softmax", _t(x), axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return _run("log_softmax", _t(x), axis=axis)
+
+
+def maxout(x, groups, axis=1):
+    return _run("maxout", _t(x), groups=groups, axis=axis)
+
+
+# -- nn ops ------------------------------------------------------------------
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    out = _run("conv2d", _t(x), _t(weight), stride=stride, padding=padding,
+               dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        caxis = 1 if data_format == "NCHW" else out.ndim - 1
+        shape = [1] * out.ndim
+        shape[caxis] = -1
+        out = add(out, reshape(_t(bias), shape))
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    out = _run("conv1d", _t(x), _t(weight), stride=stride, padding=padding, dilation=dilation, groups=groups)
+    if bias is not None:
+        out = add(out, reshape(_t(bias), [1, -1, 1]))
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, data_format="NCHW"):
+    out = _run("conv2d_transpose", _t(x), _t(weight), stride=stride, padding=padding,
+               output_padding=output_padding, dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        out = add(out, reshape(_t(bias), [1, -1, 1, 1]))
+    return out
+
+
+def linear(x, weight, bias=None):
+    if bias is None:
+        return _run("linear", _t(x), _t(weight))
+    return _run("linear", _t(x), _t(weight), _t(bias))
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
+    return _run("pool2d", _t(x), kernel_size=kernel_size, stride=stride, padding=padding,
+                pooling_type="max", ceil_mode=ceil_mode, data_format=data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    return _run("pool2d", _t(x), kernel_size=kernel_size, stride=stride, padding=padding,
+                pooling_type="avg", ceil_mode=ceil_mode, exclusive=exclusive, data_format=data_format)
+
+
+def adaptive_avg_pool2d(x, output_size):
+    return _run("adaptive_pool2d", _t(x), output_size=output_size, pooling_type="avg")
+
+
+def adaptive_max_pool2d(x, output_size):
+    return _run("adaptive_pool2d", _t(x), output_size=output_size, pooling_type="max")
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW"):
+    from ..static.program import Variable, in_static_mode
+
+    if in_static_mode() and isinstance(x, Variable):
+        # static: alias the stat outputs back onto the running-stat vars so
+        # the executor's persistable write-back updates them in the scope
+        from ..static.op_append import append_static_op
+
+        alias = {1: running_mean.name, 2: running_var.name} if training else None
+        y, _, _ = append_static_op(
+            "batch_norm",
+            [x, weight, bias, running_mean, running_var],
+            dict(momentum=momentum, epsilon=epsilon, training=training,
+                 data_format=data_format),
+            alias_outputs=alias,
+        )
+        return y
+    y, new_mean, new_var = _run(
+        "batch_norm", _t(x), _t(weight), _t(bias), _t(running_mean), _t(running_var),
+        momentum=momentum, epsilon=epsilon, training=training, data_format=data_format,
+    )
+    if training:
+        with no_grad():
+            running_mean.set_value(new_mean.detach())
+            running_var.set_value(new_var.detach())
+    return y
+
+
+def layer_norm(x, normalized_shape=None, weight=None, bias=None, epsilon=1e-5):
+    if normalized_shape is not None:
+        n = len(normalized_shape) if isinstance(normalized_shape, (list, tuple)) else 1
+        begin_norm_axis = -n
+    else:
+        begin_norm_axis = -1
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        if weight is None:
+            raise ValueError("bias without weight unsupported; pass both")
+        args.append(_t(bias))
+    return _run("layer_norm", *args, epsilon=epsilon, begin_norm_axis=begin_norm_axis)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5):
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return _run("group_norm", *args, groups=num_groups, epsilon=epsilon)
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-5):
+    args = [_t(x)]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return _run("instance_norm", *args, epsilon=epsilon)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False):
+    return _run("lookup_table", _t(weight), _t(x), padding_idx=-1 if padding_idx is None else padding_idx)
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        return _t(x)
+    key = _random.split_key()
+    return _run("dropout", _t(x), p=p, training=training, mode=mode, key=key)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
+    if size is not None and not isinstance(size, (list, tuple)):
+        size = (int(size), int(size))
+    return _run("interpolate", _t(x), size=tuple(size) if size else None,
+                scale_factor=scale_factor, mode=mode, align_corners=align_corners, data_format=data_format)
+
+
+def pixel_shuffle(x, upscale_factor):
+    return _run("pixel_shuffle", _t(x), upscale_factor=upscale_factor)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    return _run("unfold", _t(x), kernel_sizes=kernel_sizes, strides=strides, paddings=paddings, dilations=dilations)
+
+
+# -- losses ------------------------------------------------------------------
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1, ignore_index=-100):
+    return _run("softmax_with_cross_entropy", _t(logits), _t(label),
+                soft_label=soft_label, axis=axis, ignore_index=ignore_index)
+
+
+def cross_entropy(input, label, weight=None, soft_label=False, axis=-1,
+                  ignore_index=-100, reduction="mean", use_softmax=True):
+    tensors = [_t(input), _t(label)]
+    attrs = dict(soft_label=soft_label, axis=axis, ignore_index=ignore_index,
+                 reduction=reduction, use_softmax=use_softmax)
+    if weight is not None:
+        attrs["weight"] = _t(weight)._array
+    return _run("cross_entropy", *tensors, **attrs)
+
+
+def mse_loss(input, label, reduction="mean"):
+    return _run("mse_loss", _t(input), _t(label), reduction=reduction)
+
+
+def l1_loss(input, label, reduction="mean"):
+    return _run("l1_loss", _t(input), _t(label), reduction=reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    return _run("smooth_l1_loss", _t(input), _t(label), reduction=reduction, delta=delta)
+
+
+def binary_cross_entropy(input, label, reduction="mean"):
+    return _run("bce_loss", _t(input), _t(label), reduction=reduction)
+
+
+def binary_cross_entropy_with_logits(logits, label, reduction="mean", pos_weight=None):
+    attrs = dict(reduction=reduction)
+    if pos_weight is not None:
+        attrs["pos_weight"] = _t(pos_weight)._array
+    return _run("bce_with_logits", _t(logits), _t(label), **attrs)
+
+
+def nll_loss(input, label, reduction="mean", ignore_index=-100):
+    return _run("nll_loss", _t(input), _t(label), reduction=reduction, ignore_index=ignore_index)
+
+
+def kl_div(input, label, reduction="mean"):
+    return _run("kl_div", _t(input), _t(label), reduction=reduction)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return _run("log_loss", _t(input), _t(label), epsilon=epsilon)
+
+
+def square_error_cost(input, label):
+    return _run("square_error_cost", _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return _run("margin_ranking_loss", _t(input), _t(other), _t(label), margin=margin, reduction=reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _run("cosine_similarity", _t(x1), _t(x2), axis=axis, eps=eps)
+
+
+def accuracy(input, label, k=1):
+    _, idx = topk(_t(input), k)
+    return _run("accuracy", idx, _t(label))
+
+
+# -- creation ----------------------------------------------------------------
+
+
+def zeros(shape, dtype=None):
+    return to_tensor(jnp.zeros(tuple(shape), convert_dtype(dtype)))
+
+
+def ones(shape, dtype=None):
+    return to_tensor(jnp.ones(tuple(shape), convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    if dtype is None and isinstance(fill_value, bool):
+        dtype = "bool"
+    elif dtype is None and isinstance(fill_value, int):
+        dtype = "int64"
+    return to_tensor(jnp.full(tuple(shape), fill_value, convert_dtype(dtype)))
+
+
+def zeros_like(x, dtype=None):
+    x = _t(x)
+    return to_tensor(jnp.zeros(x._array.shape, convert_dtype(dtype) if dtype else x._array.dtype))
+
+
+def ones_like(x, dtype=None):
+    x = _t(x)
+    return to_tensor(jnp.ones(x._array.shape, convert_dtype(dtype) if dtype else x._array.dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    x = _t(x)
+    return to_tensor(jnp.full(x._array.shape, fill_value, convert_dtype(dtype) if dtype else x._array.dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if _is_int(start) and _is_int(end) and _is_int(step) else "float32"
+    return to_tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def _is_int(v):
+    return isinstance(v, (int, np.integer))
+
+
+def linspace(start, stop, num, dtype=None):
+    return to_tensor(jnp.linspace(start, stop, num, dtype=convert_dtype(dtype or "float32")))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return to_tensor(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype)))
+
+
+def diag_embed(x, offset=0):
+    arr = _t(x)._array
+    n = arr.shape[-1] + (offset if offset >= 0 else -offset)
+    out = jnp.zeros(arr.shape[:-1] + (n, n), arr.dtype)
+    idx = jnp.arange(arr.shape[-1])
+    if offset >= 0:
+        out = out.at[..., idx, idx + offset].set(arr)
+    else:
+        out = out.at[..., idx - offset, idx].set(arr)
+    return to_tensor(out)
+
+
+# -- RNG ---------------------------------------------------------------------
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0):
+    return _run("uniform_random", shape=tuple(shape), min=float(min), max=float(max),
+                dtype=str(convert_dtype(dtype)), key=_random.split_key())
+
+
+def rand(shape, dtype=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None):
+    return _run("gaussian_random", shape=tuple(shape), mean=0.0, std=1.0,
+                dtype=str(convert_dtype(dtype)), key=_random.split_key())
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    return _run("gaussian_random", shape=tuple(shape), mean=float(mean), std=float(std),
+                dtype="float32", key=_random.split_key())
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    return _run("randint", low=int(low), high=int(high), shape=tuple(shape),
+                dtype=str(convert_dtype(dtype)), key=_random.split_key())
+
+
+def randperm(n, dtype="int64"):
+    return _run("randperm", n=n, dtype=str(convert_dtype(dtype)), key=_random.split_key())
+
+
+def bernoulli(x):
+    return _run("bernoulli", _t(x), key=_random.split_key())
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    return _run("multinomial", _t(x), num_samples=num_samples, replacement=replacement,
+                key=_random.split_key())

@@ -1,0 +1,53 @@
+"""Op registry.
+
+Reference parity: paddle/fluid/framework/op_registry.h (REGISTER_OPERATOR /
+REGISTER_OP_*_KERNEL) + op_info.h OpInfoMap. TPU-native design: a "kernel"
+is a pure JAX function `fn(*arrays, **attrs) -> array | tuple` — place/dtype
+dispatch collapses because XLA compiles one kernel for every place/dtype;
+there is exactly one registry keyed by op type. Gradient kernels are never
+registered by hand: the executor and eager tracer derive them via jax.vjp
+(see framework/autograd.py, static/backward.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, NamedTuple
+
+
+class OpDef(NamedTuple):
+    name: str
+    fn: Callable
+    num_outputs: int  # -1 = variadic (depends on attrs)
+
+
+_REGISTRY: Dict[str, OpDef] = {}
+
+
+def register_op(name: str, num_outputs: int = 1):
+    """Decorator: register a pure-JAX kernel under a fluid op type name."""
+
+    def deco(fn):
+        if name in _REGISTRY:
+            raise ValueError(f"op {name!r} registered twice")
+        _REGISTRY[name] = OpDef(name, fn, num_outputs)
+        return fn
+
+    return deco
+
+
+def get_op(name: str) -> OpDef:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise NotImplementedError(f"op {name!r} has no TPU kernel") from None
+
+
+def kernel(name: str) -> Callable:
+    return get_op(name).fn
+
+
+def has_op(name: str) -> bool:
+    return name in _REGISTRY
+
+
+def all_ops():
+    return dict(_REGISTRY)

@@ -1,0 +1,22 @@
+"""paddle_tpu.static — static graph mode (reference: python/paddle/static/ +
+python/paddle/fluid/ Program/Executor surface)."""
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .backward import append_backward, gradients  # noqa: F401
+from .executor import Executor, Scope, global_scope  # noqa: F401
+from .program import (  # noqa: F401
+    Block,
+    OpDesc,
+    Program,
+    VarDesc,
+    Variable,
+    data,
+    default_main_program,
+    default_startup_program,
+    disable_static,
+    enable_static,
+    in_dynamic_mode,
+    in_static_mode,
+    program_guard,
+    reset_default_programs,
+)

@@ -1,0 +1,127 @@
+"""Static autodiff: append_backward.
+
+Reference parity: python/paddle/fluid/backward.py:1215 (append_backward) and
+:862 (_append_backward_ops_). Walks the op list in reverse, appending one
+"grad::<fwd_type>" op per forward op; the executor evaluates it with
+jax.vjp of the forward kernel — replacing the reference's per-op C++
+GradOpMaker registry (framework/grad_op_desc_maker.h) with derivation that
+is exact by construction. Multi-consumer gradient accumulation inserts
+sum_n ops exactly like fluid/backward.py's _addup_repetitive_outputs_.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .program import default_main_program
+
+
+def _is_float_var(block, name):
+    try:
+        v = block.var(name)
+    except KeyError:
+        return False
+    return jnp.issubdtype(v.dtype, np.floating)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Appends grad ops for `loss`; returns [(param, grad_var)] pairs."""
+    prog = default_main_program()
+    block = loss.block if hasattr(loss, "block") else prog.global_block()
+    ops = block.ops
+    no_grad_set = set(no_grad_set or [])
+
+    # forward pass: which vars require grad
+    requires = set()
+    for v in block.vars.values():
+        if not v.stop_gradient and _is_float_var(block, v.name):
+            requires.add(v.name)
+    for op in ops:
+        ins = op.inputs.get("X", [])
+        outs = op.outputs.get("Out", [])
+        if any(n in requires for n in ins):
+            for n in outs:
+                if _is_float_var(block, n) and n not in no_grad_set:
+                    requires.add(n)
+
+    if loss.name not in requires:
+        raise RuntimeError(
+            f"loss {loss.name!r} does not depend on any trainable variable")
+
+    # grad map: var name -> current grad var name
+    grad_map: dict[str, str] = {}
+    loss_grad = block.create_var(name=loss.name + "@GRAD", shape=loss.shape,
+                                 dtype=str(loss.dtype))
+    block.append_op("fill_any_like", {"X": [loss.name]}, {"Out": [loss_grad.name]},
+                    {"value": 1.0})
+    grad_map[loss.name] = loss_grad.name
+
+    n_fwd_ops = len(ops)
+    for i in range(n_fwd_ops - 1, -1, -1):
+        op = ops[i]
+        if op.type in ("fill_any_like", "fill_constant") and i >= n_fwd_ops:
+            continue
+        in_names = op.inputs.get("X", [])
+        out_names = op.outputs.get("Out", [])
+        out_grads = [grad_map.get(n) for n in out_names]
+        if all(g is None for g in out_grads):
+            continue
+        if not any(n in requires for n in in_names):
+            continue
+
+        grad_in = list(in_names) + [g or "" for g in out_grads]
+        grad_out = []
+        accum_jobs = []  # (var, existing_grad, new_grad)
+        for n in in_names:
+            if n not in requires or n in no_grad_set:
+                grad_out.append("")
+                continue
+            base = n + "@GRAD"
+            if n in grad_map:
+                fresh = prog._unique_name(base)
+                accum_jobs.append((n, grad_map[n], fresh))
+                gname = fresh
+            else:
+                gname = base if not block.has_var(base) else prog._unique_name(base)
+                grad_map[n] = gname
+            if not block.has_var(gname):
+                src = block.var(n)
+                gv = block.create_var(name=gname, shape=src.shape, dtype=str(src.dtype))
+                gv.stop_gradient = True
+            grad_out.append(gname)
+
+        attrs = dict(op.attrs)
+        attrs["__n_fwd_in__"] = len(in_names)
+        # grad ops whose out_grad inputs include "" placeholders are resolved
+        # by the executor (zero cotangent)
+        block.append_op("grad::" + op.type, {"X": [g for g in grad_in if g]},
+                        {"Out": grad_out}, attrs)
+        # fix input list: executor slices by __n_fwd_in__, so keep placeholders
+        block.ops[-1].inputs["X"] = grad_in
+
+        for n, old, fresh in accum_jobs:
+            acc = prog._unique_name(n + "@GRAD@ACC")
+            src = block.var(n)
+            av = block.create_var(name=acc, shape=src.shape, dtype=str(src.dtype))
+            av.stop_gradient = True
+            block.append_op("sum_n", {"X": [old, fresh]}, {"Out": [acc]}, {})
+            grad_map[n] = acc
+
+    params = parameter_list or [v.name for v in block.vars.values()
+                                if getattr(v, "is_parameter", False)]
+    result = []
+    for p in params:
+        pname = p if isinstance(p, str) else p.name
+        if pname in grad_map:
+            result.append((block.var(pname), block.var(grad_map[pname])))
+    return result
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """paddle.static.gradients (fluid/backward.py:1665 calc_gradient)."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    pairs = append_backward(targets[0], parameter_list=[v.name for v in inputs])
+    by_name = {p.name: g for p, g in pairs}
+    return [by_name.get(v.name) for v in inputs]

@@ -1,0 +1,92 @@
+"""Static-graph layer helpers.
+
+Reference parity: python/paddle/fluid/layers/nn.py (fc, conv2d, …) via
+LayerHelper (fluid/layer_helper.py): create parameter vars + append ops.
+Most of fluid.layers is covered by the mode-aware paddle_tpu.ops API; these
+helpers add the parameter-creating layers.
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import initializer as I
+from .program import default_main_program, default_startup_program
+
+
+def create_parameter(shape, dtype="float32", name=None, initializer=None,
+                     is_bias=False, trainable=True):
+    prog = default_main_program()
+    block = prog.global_block()
+    name = name or prog._unique_name("param")
+    init = I._resolve(initializer, is_bias=is_bias)
+    var = block.create_parameter(name, shape, dtype, initializer=init,
+                                 trainable=trainable)
+    sblock = default_startup_program().global_block()
+    sblock.append_op("init_param", {"X": []}, {"Out": [name]},
+                     {"initializer": init, "shape": list(shape), "dtype": dtype})
+    return var
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None, activation=None,
+       name=None):
+    """fluid.layers.fc (fluid/layers/nn.py) — flatten + mul + bias + act."""
+    in_features = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_features *= d
+    w = create_parameter([in_features, size], str(x.dtype), initializer=weight_attr)
+    out = ops.mul(x, w, x_num_col_dims=num_flatten_dims)
+    if bias_attr is not False:
+        b = create_parameter([size], str(x.dtype), initializer=bias_attr, is_bias=True)
+        out = ops.add(out, b)
+    if activation:
+        out = getattr(ops, activation)(out)
+    return out
+
+
+def conv2d(x, num_filters, filter_size, stride=1, padding=0, dilation=1, groups=1,
+           weight_attr=None, bias_attr=None, activation=None, name=None):
+    ks = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size, filter_size)
+    in_channels = x.shape[1]
+    fan_in = in_channels // groups * ks[0] * ks[1]
+    w = create_parameter(
+        [num_filters, in_channels // groups, ks[0], ks[1]], str(x.dtype),
+        initializer=weight_attr or I.KaimingUniform(fan_in=fan_in))
+    out = ops.conv2d(x, w, None, stride=stride, padding=padding,
+                     dilation=dilation, groups=groups)
+    if bias_attr is not False:
+        b = create_parameter([num_filters], str(x.dtype), initializer=bias_attr, is_bias=True)
+        out = ops.add(out, ops.reshape(b, [1, num_filters, 1, 1]))
+    if activation:
+        out = getattr(ops, activation)(out)
+    return out
+
+
+def batch_norm(x, momentum=0.9, epsilon=1e-5, weight_attr=None, bias_attr=None,
+               is_test=False, name=None):
+    c = x.shape[1]
+    scale = create_parameter([c], str(x.dtype), initializer=weight_attr or I.Constant(1.0))
+    bias = create_parameter([c], str(x.dtype), initializer=bias_attr, is_bias=True)
+    mean = create_parameter([c], str(x.dtype), initializer=I.Constant(0.0), trainable=False)
+    var = create_parameter([c], str(x.dtype), initializer=I.Constant(1.0), trainable=False)
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    return ops.batch_norm(x, mean, var, scale, bias, training=not is_test,
+                          momentum=momentum, epsilon=epsilon)
+
+
+def embedding(x, size, padding_idx=None, weight_attr=None, name=None):
+    w = create_parameter(list(size), "float32",
+                         initializer=weight_attr or I.Normal(0.0, 1.0))
+    return ops.embedding(x, w, padding_idx=padding_idx)
+
+
+def layer_norm(x, begin_norm_axis=-1, epsilon=1e-5, weight_attr=None, bias_attr=None):
+    if begin_norm_axis < 0:
+        begin_norm_axis = len(x.shape) + begin_norm_axis
+    shape = list(x.shape[begin_norm_axis:])
+    scale = create_parameter(shape, str(x.dtype), initializer=weight_attr or I.Constant(1.0))
+    bias = create_parameter(shape, str(x.dtype), initializer=bias_attr, is_bias=True)
+    return ops.layer_norm(x, shape, scale, bias, epsilon)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False):
+    return ops.dropout(x, p=dropout_prob, training=not is_test)

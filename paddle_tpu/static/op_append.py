@@ -1,0 +1,103 @@
+"""Static-graph op appending.
+
+Reference parity: fluid/layer_helper.py append_op + framework.py
+Block.append_op. The mode-aware eager wrappers (paddle_tpu.ops._run) call
+append_static_op when static mode is active, so the entire paddle_tpu.*
+tensor API doubles as the static-graph layer API (the reference needed a
+separate fluid/layers/ for this; the 2.0 unified API is what we mirror).
+
+Output shapes/dtypes come from jax.eval_shape over the registered kernel —
+there are no hand-written InferShape rules to drift out of sync.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..ops.registry import kernel
+from .program import Variable, default_main_program
+
+# Dim placeholder for -1 (batch) dims during abstract eval; prime & unusual
+# so we can recognize it in outputs and restore -1.
+_DYN = 83
+
+RNG_OPS = {
+    "dropout", "uniform_random", "gaussian_random", "randint", "randperm",
+    "bernoulli", "multinomial", "truncated_gaussian_random",
+}
+
+
+def _spec_of(t):
+    if isinstance(t, Variable):
+        shape = [_DYN if d in (-1, None) else d for d in (t.shape or [])]
+        return jax.ShapeDtypeStruct(tuple(shape), t.dtype)
+    return jax.ShapeDtypeStruct(tuple(t._array.shape), t._array.dtype)
+
+
+def append_static_op(op_type, tensors, attrs, alias_outputs=None):
+    """Append an OpDesc to the current block; returns output Variable(s)."""
+    block = default_main_program().current_block()
+    prog = default_main_program()
+
+    in_names = []
+    for t in tensors:
+        if isinstance(t, Variable):
+            in_names.append(t.name)
+        else:
+            # eager Tensor constant captured into the program
+            cname = prog._unique_name("const")
+            cvar = block.create_var(name=cname, shape=list(t._array.shape),
+                                    dtype=str(t._array.dtype), persistable=True)
+            if not hasattr(prog, "_constants"):
+                prog._constants = {}
+            prog._constants[cname] = np.asarray(t._array)
+            in_names.append(cname)
+
+    run_attrs = dict(attrs)
+    is_rng = op_type in RNG_OPS or "key" in run_attrs
+    if is_rng:
+        run_attrs.pop("key", None)
+
+    # abstract eval for output specs
+    fn = kernel(op_type)
+    specs = [_spec_of(t) for t in tensors]
+
+    def absfn(*xs):
+        kw = dict(run_attrs)
+        if is_rng:
+            kw["key"] = jax.random.key(0)
+        return fn(*xs, **kw)
+
+    out_shape = jax.eval_shape(absfn, *specs)
+    multi = isinstance(out_shape, (tuple, list))
+    out_specs = list(out_shape) if multi else [out_shape]
+
+    any_dynamic = any(
+        isinstance(t, Variable) and t.shape and any(d in (-1, None) for d in t.shape)
+        for t in tensors
+    )
+
+    out_vars = []
+    out_names = []
+    for i, sp in enumerate(out_specs):
+        shape = [(-1 if (any_dynamic and d == _DYN) else d) for d in sp.shape]
+        if alias_outputs and i in alias_outputs:
+            name = alias_outputs[i]
+            var = block.var(name)
+        else:
+            name = prog._unique_name(op_type)
+            var = block.create_var(name=name, shape=shape, dtype=str(sp.dtype))
+            var.stop_gradient = all(
+                (not isinstance(t, Variable)) or t.stop_gradient for t in tensors
+            ) or not jnp.issubdtype(sp.dtype, np.floating)
+        out_names.append(name)
+        out_vars.append(var)
+
+    desc_attrs = dict(run_attrs)
+    if is_rng:
+        desc_attrs["__rng__"] = True
+    block.append_op(op_type, {"X": in_names}, {"Out": out_names}, desc_attrs)
+    return tuple(out_vars) if multi else out_vars[0]

@@ -1,0 +1,127 @@
+"""Static-graph optimizers.
+
+Reference parity: python/paddle/fluid/optimizer.py:56 — minimize() appends
+backward + parameter-update ops to the program (operators/optimizers/*.cc
+equivalents are the *_update kernels in ops/kernels.py). The learning rate
+is a persistable scalar in the scope (a traced input), so host-side LR
+schedules never retrigger XLA compilation.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .backward import append_backward
+from .executor import global_scope
+from .nn import create_parameter
+from .program import default_main_program
+from ..nn import initializer as I
+
+
+class StaticOptimizer:
+    def __init__(self, learning_rate=0.001, grad_clip=None):
+        self._lr = learning_rate
+        self._grad_clip = grad_clip
+        self._lr_name = None
+
+    def _lr_var(self, prog):
+        if self._lr_name is None:
+            var = create_parameter([], "float32", name=prog._unique_name("learning_rate"),
+                                   initializer=I.Constant(self._get_lr_value()),
+                                   trainable=False)
+            var.stop_gradient = True
+            self._lr_name = var.name
+        return prog.global_block().var(self._lr_name)
+
+    def _get_lr_value(self):
+        lr = self._lr
+        return float(lr() if callable(lr) else lr)
+
+    def set_lr(self, value):
+        self._lr = float(value)
+        if self._lr_name is not None and global_scope().has(self._lr_name):
+            global_scope().set(self._lr_name, np.float32(value))
+
+    def sync_lr(self):
+        """Push the current (possibly scheduled) lr into the scope."""
+        if self._lr_name is not None:
+            global_scope().set(self._lr_name, np.float32(self._get_lr_value()))
+
+    def minimize(self, loss, startup_program=None, parameter_list=None, no_grad_set=None):
+        prog = default_main_program()
+        params_grads = append_backward(loss, parameter_list, no_grad_set)
+        if self._grad_clip is not None:
+            params_grads = self._append_clip(prog, params_grads)
+        lr = self._lr_var(prog)
+        self._append_update_ops(prog, params_grads, lr)
+        return None, params_grads
+
+    def _append_clip(self, prog, params_grads):
+        # ClipGradByGlobalNorm-style clipping as graph ops
+        from .. import ops
+
+        grads = [g for _, g in params_grads]
+        sq = None
+        for g in grads:
+            s = ops.sum(ops.square(g))
+            sq = s if sq is None else sq + s
+        gnorm = ops.sqrt(sq)
+        clip_norm = self._grad_clip.clip_norm
+        factor = ops.minimum(
+            ops.full([], 1.0), ops.full([], float(clip_norm)) / ops.maximum(
+                gnorm, ops.full([], 1e-12)))
+        return [(p, g * factor) for p, g in params_grads]
+
+    def _append_update_ops(self, prog, params_grads, lr):
+        raise NotImplementedError
+
+
+class SGD(StaticOptimizer):
+    def _append_update_ops(self, prog, params_grads, lr):
+        block = prog.global_block()
+        for p, g in params_grads:
+            block.append_op("sgd", {"X": [p.name, g.name, lr.name]},
+                            {"Out": [p.name]}, {})
+
+
+class Momentum(StaticOptimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, use_nesterov=False,
+                 grad_clip=None):
+        super().__init__(learning_rate, grad_clip)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_update_ops(self, prog, params_grads, lr):
+        block = prog.global_block()
+        for p, g in params_grads:
+            vel = create_parameter(p.shape, str(p.dtype), name=p.name + "@velocity",
+                                   initializer=I.Constant(0.0), trainable=False)
+            block.append_op(
+                "momentum_update",
+                {"X": [p.name, g.name, vel.name, lr.name]},
+                {"Out": [p.name, vel.name]},
+                {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class Adam(StaticOptimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 grad_clip=None):
+        super().__init__(learning_rate, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_update_ops(self, prog, params_grads, lr):
+        block = prog.global_block()
+        step = create_parameter([], "float32", name=prog._unique_name("adam_step"),
+                                initializer=I.Constant(0.0), trainable=False)
+        step.stop_gradient = True
+        block.append_op("increment", {"X": [step.name]}, {"Out": [step.name]},
+                        {"value": 1.0})
+        for p, g in params_grads:
+            m1 = create_parameter(p.shape, str(p.dtype), name=p.name + "@moment1",
+                                  initializer=I.Constant(0.0), trainable=False)
+            m2 = create_parameter(p.shape, str(p.dtype), name=p.name + "@moment2",
+                                  initializer=I.Constant(0.0), trainable=False)
+            block.append_op(
+                "adam_update",
+                {"X": [p.name, g.name, m1.name, m2.name, lr.name, step.name]},
+                {"Out": [p.name, m1.name, m2.name]},
+                {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon})

@@ -1,0 +1,27 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (SURVEY.md §4: the reference tests
+multi-device via localhost subprocesses; JAX lets us do it in-process with
+xla_force_host_platform_device_count). Must set env before jax imports.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "true")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    import paddle_tpu
+
+    paddle_tpu.seed(2024)
+    np.random.seed(2024)
+    yield

@@ -1,0 +1,93 @@
+"""Declarative op-test base.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py:170 — a test
+declares op_type + numpy inputs/attrs/expected outputs; check_output compares
+the kernel against the numpy oracle, check_grad compares analytic (vjp)
+gradients against finite differences.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu
+from paddle_tpu.framework.autograd import apply_op
+from paddle_tpu.ops.registry import kernel
+
+
+class OpTest:
+    op_type: str = ""
+    inputs: dict = {}
+    attrs: dict = {}
+    outputs: dict = {}
+
+    def _run_op(self, input_tensors):
+        fn = kernel(self.op_type)
+        return apply_op(self.op_type, fn, input_tensors, self.attrs)
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        tensors = [
+            paddle_tpu.to_tensor(v) for v in self.inputs.values()
+        ]
+        out = self._run_op(tensors)
+        outs = out if isinstance(out, tuple) else (out,)
+        expected = list(self.outputs.values())
+        assert len(outs) >= len(expected), (
+            f"{self.op_type}: got {len(outs)} outputs, expected >= {len(expected)}"
+        )
+        for got, exp in zip(outs, expected):
+            np.testing.assert_allclose(
+                got.numpy().astype(np.float64)
+                if got.dtype != np.bool_
+                else got.numpy(),
+                np.asarray(exp).astype(np.float64)
+                if np.asarray(exp).dtype != np.bool_
+                else np.asarray(exp),
+                atol=atol,
+                rtol=rtol,
+                err_msg=f"op {self.op_type} output mismatch",
+            )
+
+    def check_grad(self, inputs_to_check=None, output_index=0, eps=1e-3, atol=5e-3, rtol=5e-3):
+        """Analytic grad (tape vjp) vs central finite differences."""
+        names = list(self.inputs.keys())
+        inputs_to_check = inputs_to_check or [
+            n for n in names if np.issubdtype(np.asarray(self.inputs[n]).dtype, np.floating)
+        ]
+        tensors = {}
+        for n in names:
+            arr = np.asarray(self.inputs[n])
+            if np.issubdtype(arr.dtype, np.floating):
+                t = paddle_tpu.to_tensor(arr.astype(np.float64), dtype="float64")
+            else:
+                t = paddle_tpu.to_tensor(arr)
+            t.stop_gradient = n not in inputs_to_check
+            tensors[n] = t
+
+        def fwd():
+            out = self._run_op(list(tensors.values()))
+            out0 = out[output_index] if isinstance(out, tuple) else out
+            return out0
+
+        loss = fwd().sum()
+        loss.backward()
+        analytic = {n: tensors[n].grad.numpy() for n in inputs_to_check}
+
+        for n in inputs_to_check:
+            base = np.asarray(self.inputs[n]).astype(np.float64)
+            numeric = np.zeros_like(base)
+            flat = base.reshape(-1)
+            num_flat = numeric.reshape(-1)
+            for i in range(flat.size):
+                for s, sgn in ((eps, 1.0), (-eps, -1.0)):
+                    perturbed = flat.copy()
+                    perturbed[i] += s
+                    tensors[n]._array = paddle_tpu.to_tensor(
+                        perturbed.reshape(base.shape), dtype="float64"
+                    )._array
+                    val = float(fwd().sum().numpy())
+                    num_flat[i] += sgn * val / (2 * eps)
+                tensors[n]._array = paddle_tpu.to_tensor(base, dtype="float64")._array
+            np.testing.assert_allclose(
+                analytic[n], numeric, atol=atol, rtol=rtol,
+                err_msg=f"op {self.op_type} grad wrt {n} mismatch",
+            )

@@ -1,0 +1,118 @@
+"""Tape autograd engine tests (reference: imperative/basic_engine.cc paths)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_simple_chain():
+    x = pt.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * 2 + 1).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0, 2.0])
+
+
+def test_grad_accumulation_multiple_uses():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x * 3  # dy/dx = 2x + 3 = 7
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_second_backward_accumulates():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_no_grad_blocks_tape():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    with pt.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_stop_gradient_leaf_gets_no_grad():
+    x = pt.to_tensor([1.0], stop_gradient=True)
+    w = pt.to_tensor([2.0], stop_gradient=False)
+    (x * w).sum().backward()
+    assert x.grad is None
+    np.testing.assert_allclose(w.grad.numpy(), [1.0])
+
+
+def test_retain_graph():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])  # 6 + 6
+
+
+def test_double_backward_without_retain_raises():
+    x = pt.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_backward_nonscalar_needs_grad():
+    x = pt.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(pt.to_tensor([1.0, 1.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_paddle_grad_api():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = pt.to_tensor([3.0], stop_gradient=False)
+    z = (x * x * y).sum()
+    gx, gy = pt.grad(z, [x, y])
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    np.testing.assert_allclose(gy.numpy(), [4.0])
+    # .grad untouched by pt.grad
+    assert x.grad is None
+
+
+def test_detach_cuts_graph():
+    x = pt.to_tensor([2.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * 3
+    assert z.stop_gradient
+
+
+def test_multi_output_op_grad():
+    x = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+    a, b, c = pt.split(x, 3, axis=1)
+    loss = (a * 1 + b * 2 + c * 3).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [[1, 2, 3], [1, 2, 3]])
+
+
+def test_branching_graph():
+    x = pt.to_tensor([1.0], stop_gradient=False)
+    a = x * 2
+    b = a * 3
+    c = a * 4
+    (b + c).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [14.0])
+
+
+def test_grad_through_reduction_and_broadcast():
+    x = pt.to_tensor(np.ones((3, 4), np.float32), stop_gradient=False)
+    b = pt.to_tensor(np.ones((4,), np.float32), stop_gradient=False)
+    y = (x + b).mean()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 4), 1 / 12))
+    np.testing.assert_allclose(b.grad.numpy(), np.full((4,), 0.25))
+
+
+def test_int_tensor_not_tracked():
+    x = pt.to_tensor([1, 2, 3])
+    assert x.stop_gradient
+    y = x + 1
+    assert y._node is None

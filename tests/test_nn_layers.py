@@ -1,0 +1,200 @@
+"""Layer tests (reference: tests/unittests/test_layers.py style)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+
+
+def test_linear_matches_numpy():
+    layer = nn.Linear(4, 3)
+    x = np.random.randn(2, 4).astype(np.float32)
+    out = layer(pt.to_tensor(x))
+    expected = x @ layer.weight.numpy() + layer.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), expected, rtol=1e-5, atol=1e-5)
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.act = nn.ReLU()
+
+        def forward(self, x):
+            return self.fc2(self.act(self.fc1(x)))
+
+    net = Net()
+    params = dict(net.named_parameters())
+    assert set(params) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    sd = net.state_dict()
+    net2 = Net()
+    net2.set_state_dict({k: v.numpy() for k, v in sd.items()})
+    x = pt.to_tensor(np.random.randn(3, 4).astype(np.float32))
+    np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_dropout_train_eval():
+    d = nn.Dropout(0.5)
+    x = pt.ones([100, 100])
+    d.train()
+    y = d(x)
+    assert 0.1 < float((y == 0).astype("float32").mean().item()) < 0.9
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+
+def test_batchnorm_running_stats_update():
+    bn = nn.BatchNorm2D(3)
+    x = pt.to_tensor(np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 5)
+    bn.train()
+    _ = bn(x)
+    assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+    bn.eval()
+    mean_before = bn._mean.numpy().copy()
+    _ = bn(x)
+    np.testing.assert_array_equal(bn._mean.numpy(), mean_before)
+
+
+def test_batchnorm_normalizes():
+    bn = nn.BatchNorm2D(2, momentum=0.0)
+    x = pt.to_tensor(np.random.randn(16, 2, 5, 5).astype(np.float32) * 3 + 7)
+    bn.train()
+    y = bn(x)
+    got = y.numpy()
+    assert abs(got.mean()) < 1e-4
+    assert abs(got.std() - 1) < 1e-2
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    out = emb(pt.to_tensor([[0, 1]]))
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+    assert not np.allclose(out.numpy()[0, 1], np.zeros(4))
+
+
+def test_sequential_and_layerlist():
+    seq = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(seq) == 3
+    x = pt.to_tensor(np.random.randn(2, 4).astype(np.float32))
+    assert seq(x).shape == [2, 2]
+
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll)) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    # params visible to parent
+    parent = nn.Layer()
+    parent.blocks = ll
+    assert len(parent.parameters()) == 8
+
+
+def test_conv_pool_shapes():
+    conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = pt.to_tensor(np.random.randn(2, 3, 16, 16).astype(np.float32))
+    y = conv(x)
+    assert y.shape == [2, 8, 8, 8]
+    pool = nn.MaxPool2D(2)
+    assert pool(y).shape == [2, 8, 4, 4]
+    ap = nn.AdaptiveAvgPool2D(1)
+    assert ap(y).shape == [2, 8, 1, 1]
+
+
+def test_conv2d_groups():
+    conv = nn.Conv2D(4, 8, 3, groups=2, padding=1)
+    x = pt.to_tensor(np.random.randn(1, 4, 8, 8).astype(np.float32))
+    assert conv(x).shape == [1, 8, 8, 8]
+
+
+def test_layernorm_matches_numpy():
+    ln = nn.LayerNorm(6)
+    x = np.random.randn(3, 6).astype(np.float32)
+    y = ln(pt.to_tensor(x)).numpy()
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    expected = (x - mean) / np.sqrt(var + 1e-5) * ln.weight.numpy() + ln.bias.numpy()
+    np.testing.assert_allclose(y, expected, rtol=1e-4, atol=1e-5)
+
+
+def test_mha_self_attention_shapes_and_mask():
+    mha = nn.MultiHeadAttention(16, 4)
+    mha.eval()
+    x = pt.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+    out = mha(x)
+    assert out.shape == [2, 5, 16]
+    # causal mask changes output
+    mask = np.triu(np.full((5, 5), -1e9, np.float32), k=1)
+    out_masked = mha(x, attn_mask=pt.to_tensor(mask))
+    assert not np.allclose(out.numpy(), out_masked.numpy())
+
+
+def test_transformer_full():
+    model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=2,
+                           num_decoder_layers=2, dim_feedforward=32)
+    model.eval()
+    src = pt.to_tensor(np.random.randn(2, 6, 16).astype(np.float32))
+    tgt = pt.to_tensor(np.random.randn(2, 4, 16).astype(np.float32))
+    out = model(src, tgt)
+    assert out.shape == [2, 4, 16]
+
+
+def test_lstm_shapes_and_grad():
+    lstm = nn.LSTM(4, 8)
+    x = pt.to_tensor(np.random.randn(2, 5, 4).astype(np.float32), stop_gradient=False)
+    y, (h, c) = lstm(x)
+    assert y.shape == [2, 5, 8]
+    assert h.shape == [1, 2, 8]
+    y.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+    assert x.grad is not None
+
+
+def test_gru_matches_manual_cell():
+    gru = nn.GRU(3, 4)
+    cell = nn.GRUCell(3, 4)
+    for name in ["weight_ih", "weight_hh", "bias_ih", "bias_hh"]:
+        getattr(cell, name).set_value(getattr(gru, name + "_l0"))
+    x = np.random.randn(2, 3, 3).astype(np.float32)
+    y, h = gru(pt.to_tensor(x))
+    hc = None
+    for t in range(3):
+        out, hc = cell(pt.to_tensor(x[:, t]), hc)
+    np.testing.assert_allclose(h.numpy()[0], hc.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_loss_layers():
+    ce = nn.CrossEntropyLoss()
+    logits = pt.to_tensor(np.random.randn(4, 3).astype(np.float32))
+    label = pt.to_tensor([0, 1, 2, 1])
+    loss = ce(logits, label)
+    assert loss.shape == []
+    # oracle
+    lg = logits.numpy()
+    logp = lg - np.log(np.exp(lg - lg.max(-1, keepdims=True)).sum(-1, keepdims=True)) - lg.max(-1, keepdims=True)
+    expected = -logp[np.arange(4), [0, 1, 2, 1]].mean()
+    np.testing.assert_allclose(loss.item(), expected, rtol=1e-5)
+
+    mse = nn.MSELoss()
+    a = pt.to_tensor([1.0, 2.0])
+    b = pt.to_tensor([2.0, 4.0])
+    np.testing.assert_allclose(mse(a, b).item(), 2.5)
+
+
+def test_train_eval_propagates():
+    seq = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    seq.eval()
+    assert not seq[1].training
+    seq.train()
+    assert seq[1].training
+
+
+def test_hooks():
+    layer = nn.Linear(2, 2)
+    calls = []
+    h = layer.register_forward_post_hook(lambda l, inp, out: calls.append(1))
+    layer(pt.ones([1, 2]))
+    assert calls == [1]
+    h.remove()
+    layer(pt.ones([1, 2]))
+    assert calls == [1]

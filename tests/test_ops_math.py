@@ -1,0 +1,402 @@
+"""Op unit tests, OpTest-style (reference: tests/unittests/test_*_op.py)."""
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+
+def _rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float64)
+
+
+class TestAdd(OpTest):
+    def setup_method(self, _):
+        self.op_type = "elementwise_add"
+        x, y = _rand(3, 4), _rand(3, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestAddBroadcast(OpTest):
+    def setup_method(self, _):
+        self.op_type = "elementwise_add"
+        x, y = _rand(3, 4), _rand(4)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x + y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestMul(OpTest):
+    def setup_method(self, _):
+        self.op_type = "elementwise_mul"
+        x, y = _rand(2, 5), _rand(2, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x * y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestDiv(OpTest):
+    def setup_method(self, _):
+        self.op_type = "elementwise_div"
+        x = _rand(3, 3)
+        y = np.random.uniform(0.5, 2.0, (3, 3))
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x / y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestMatmul(OpTest):
+    def setup_method(self, _):
+        self.op_type = "matmul"
+        x, y = _rand(3, 4), _rand(4, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestMatmulTranspose(OpTest):
+    def setup_method(self, _):
+        self.op_type = "matmul"
+        x, y = _rand(4, 3), _rand(5, 4)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_x": True, "transpose_y": True}
+        self.outputs = {"Out": x.T @ y.T}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestBatchedMatmul(OpTest):
+    def setup_method(self, _):
+        self.op_type = "matmul"
+        x, y = _rand(2, 3, 4), _rand(2, 4, 5)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestSoftmax(OpTest):
+    def setup_method(self, _):
+        self.op_type = "softmax"
+        x = _rand(3, 5)
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestLayerNorm(OpTest):
+    def setup_method(self, _):
+        self.op_type = "layer_norm"
+        x = _rand(4, 6)
+        scale, bias = _rand(6), _rand(6)
+        mean = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        out = (x - mean) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"epsilon": 1e-5, "begin_norm_axis": -1}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestReduceSum(OpTest):
+    def setup_method(self, _):
+        self.op_type = "reduce_sum"
+        x = _rand(3, 4, 5)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": (1,), "keep_dim": False}
+        self.outputs = {"Out": x.sum(1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestReduceMean(OpTest):
+    def setup_method(self, _):
+        self.op_type = "reduce_mean"
+        x = _rand(3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"dim": None, "keep_dim": False}
+        self.outputs = {"Out": x.mean()}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftmaxWithCE(OpTest):
+    def setup_method(self, _):
+        self.op_type = "softmax_with_cross_entropy"
+        logits = _rand(4, 5)
+        label = np.random.randint(0, 5, (4, 1)).astype(np.int64)
+        logp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
+        loss = -np.take_along_axis(logp, label, axis=1)
+        self.inputs = {"Logits": logits, "Label": label}
+        self.attrs = {}
+        self.outputs = {"Loss": loss}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(inputs_to_check=["Logits"])
+
+
+class TestConv2D(OpTest):
+    def setup_method(self, _):
+        self.op_type = "conv2d"
+        x = _rand(1, 2, 5, 5)
+        w = _rand(3, 2, 3, 3)
+        out = np.zeros((1, 3, 3, 3))
+        for o in range(3):
+            for c in range(2):
+                for i in range(3):
+                    for j in range(3):
+                        out[0, o, i, j] += np.sum(x[0, c, i : i + 3, j : j + 3] * w[o, c])
+        self.inputs = {"X": x, "W": w}
+        self.attrs = {"stride": 1, "padding": 0}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(atol=2e-2, rtol=2e-2)
+
+
+class TestBatchNormTrain(OpTest):
+    def setup_method(self, _):
+        self.op_type = "batch_norm"
+        x = _rand(4, 3, 2, 2)
+        scale, bias = _rand(3), _rand(3)
+        mean, var = np.zeros(3), np.ones(3)
+        bm = x.mean(axis=(0, 2, 3))
+        bv = x.var(axis=(0, 2, 3))
+        y = (x - bm.reshape(1, 3, 1, 1)) / np.sqrt(bv + 1e-5).reshape(1, 3, 1, 1)
+        y = y * scale.reshape(1, 3, 1, 1) + bias.reshape(1, 3, 1, 1)
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias, "Mean": mean, "Var": var}
+        self.attrs = {"training": True, "epsilon": 1e-5, "momentum": 0.9}
+        self.outputs = {"Y": y, "MeanOut": 0.9 * mean + 0.1 * bm, "VarOut": 0.9 * var + 0.1 * bv}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTranspose(OpTest):
+    def setup_method(self, _):
+        self.op_type = "transpose"
+        x = _rand(2, 3, 4)
+        self.inputs = {"X": x}
+        self.attrs = {"perm": (2, 0, 1)}
+        self.outputs = {"Out": x.transpose(2, 0, 1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestConcat(OpTest):
+    def setup_method(self, _):
+        self.op_type = "concat"
+        x, y = _rand(2, 3), _rand(2, 2)
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([x, y], axis=1)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestLookupTable(OpTest):
+    def setup_method(self, _):
+        self.op_type = "lookup_table"
+        w = _rand(10, 4)
+        ids = np.array([[1, 2], [3, 9]], dtype=np.int64)
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {}
+        self.outputs = {"Out": w[ids]}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(inputs_to_check=["W"])
+
+
+class TestGelu(OpTest):
+    def setup_method(self, _):
+        self.op_type = "gelu"
+        import math
+
+        x = _rand(3, 4)
+        cdf = 0.5 * (1 + np.vectorize(math.erf)(x / math.sqrt(2)))
+        self.inputs = {"X": x}
+        self.attrs = {}
+        self.outputs = {"Out": x * cdf}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestPool2D(OpTest):
+    def setup_method(self, _):
+        self.op_type = "pool2d"
+        x = _rand(1, 2, 4, 4)
+        out = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"kernel_size": 2, "stride": 2, "pooling_type": "max"}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestAvgPool2D(OpTest):
+    def setup_method(self, _):
+        self.op_type = "pool2d"
+        x = _rand(1, 2, 4, 4)
+        out = x.reshape(1, 2, 2, 2, 2, 2).mean(axis=(3, 5))
+        self.inputs = {"X": x}
+        self.attrs = {"kernel_size": 2, "stride": 2, "pooling_type": "avg"}
+        self.outputs = {"Out": out}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestTopK(OpTest):
+    def setup_method(self, _):
+        self.op_type = "top_k"
+        x = np.array([[1.0, 3.0, 2.0], [5.0, 4.0, 6.0]])
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        self.outputs = {"Out": np.array([[3.0, 2.0], [6.0, 5.0]]),
+                        "Indices": np.array([[1, 2], [2, 0]])}
+
+    def test_output(self):
+        self.check_output()
+
+
+class TestScale(OpTest):
+    def setup_method(self, _):
+        self.op_type = "scale"
+        x = _rand(3, 3)
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.5, "bias": 1.0}
+        self.outputs = {"Out": 2.5 * x + 1.0}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestWhere(OpTest):
+    def setup_method(self, _):
+        self.op_type = "where"
+        c = np.array([[True, False], [False, True]])
+        x, y = _rand(2, 2), _rand(2, 2)
+        self.inputs = {"C": c, "X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": np.where(c, x, y)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(inputs_to_check=["X", "Y"])
+
+
+@pytest.mark.parametrize(
+    "name,np_fn",
+    [
+        ("exp", np.exp),
+        ("log", lambda x: np.log(np.abs(x) + 1.0)),
+        ("tanh", np.tanh),
+        ("sigmoid", lambda x: 1 / (1 + np.exp(-x))),
+        ("sqrt", lambda x: np.sqrt(np.abs(x) + 0.5)),
+        ("abs", np.abs),
+        ("sin", np.sin),
+        ("cos", np.cos),
+    ],
+)
+def test_unary_against_numpy(name, np_fn):
+    import paddle_tpu as pt
+
+    x = np.random.uniform(-1, 1, (3, 4))
+    if name == "log":
+        inp = np.abs(x) + 1.0
+        expected = np.log(inp)
+    elif name == "sqrt":
+        inp = np.abs(x) + 0.5
+        expected = np.sqrt(inp)
+    else:
+        inp = x
+        expected = np_fn(x)
+    got = getattr(pt, name)(pt.to_tensor(inp, dtype="float64")).numpy()
+    np.testing.assert_allclose(got, expected, rtol=1e-6, atol=1e-6)

@@ -1,0 +1,173 @@
+"""Optimizer tests (reference: tests/unittests/test_sgd_op.py, test_adam_op.py…)."""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _quadratic_param():
+    p = pt.framework.Parameter.from_array(np.array([5.0, -3.0], np.float32))
+    return p
+
+
+def _grad_step(p, optimizer):
+    loss = (p * p).sum()
+    loss.backward()
+    optimizer.step()
+    optimizer.clear_grad()
+    return float(loss.item())
+
+
+def test_sgd_matches_manual():
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    before = p.numpy().copy()
+    _grad_step(p, o)
+    np.testing.assert_allclose(p.numpy(), before - 0.1 * 2 * before, rtol=1e-6)
+
+
+def test_momentum_matches_manual():
+    p = _quadratic_param()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    w = p.numpy().copy()
+    v = np.zeros_like(w)
+    for _ in range(3):
+        _grad_step(p, o)
+        g = 2 * w
+        v = 0.9 * v + g
+        w = w - 0.1 * v
+    np.testing.assert_allclose(p.numpy(), w, rtol=1e-5)
+
+
+def test_adam_matches_manual():
+    p = _quadratic_param()
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    w = p.numpy().astype(np.float64).copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 4):
+        _grad_step(p, o)
+        g = 2 * w
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9**t)
+        vh = v / (1 - 0.999**t)
+        w = w - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), w, rtol=1e-4)
+
+
+def test_all_optimizers_descend():
+    for cls, kwargs in [
+        (opt.SGD, {}),
+        (opt.Momentum, {}),
+        (opt.Adam, {}),
+        (opt.AdamW, {}),
+        (opt.Adagrad, {}),
+        (opt.Adadelta, {"learning_rate": 1.0}),
+        (opt.RMSProp, {}),
+        (opt.Adamax, {}),
+        (opt.Lamb, {"lamb_weight_decay": 0.0}),
+    ]:
+        p = _quadratic_param()
+        kwargs.setdefault("learning_rate", 0.05)
+        o = cls(parameters=[p], **kwargs)
+        first = _grad_step(p, o)
+        for _ in range(20):
+            last = _grad_step(p, o)
+        assert last < first, f"{cls.__name__} failed to descend ({first} -> {last})"
+
+
+def test_weight_decay_l2():
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+    before = p.numpy().copy()
+    _grad_step(p, o)
+    np.testing.assert_allclose(p.numpy(), before - 0.1 * (2 * before + 0.5 * before), rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p1 = _quadratic_param()
+    p2 = _quadratic_param()
+    adam = opt.Adam(learning_rate=0.1, parameters=[p1])
+    adamw = opt.AdamW(learning_rate=0.1, parameters=[p2], weight_decay=0.1)
+    _grad_step(p1, adam)
+    _grad_step(p2, adamw)
+    expected = p1.numpy() - 0.1 * 0.1 * np.array([5.0, -3.0])
+    np.testing.assert_allclose(p2.numpy(), expected, rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    p = _quadratic_param()
+    clip = opt.ClipGradByGlobalNorm(1.0)
+    o = opt.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+    before = p.numpy().copy()
+    _grad_step(p, o)
+    step = before - p.numpy()
+    np.testing.assert_allclose(np.linalg.norm(step), 1.0, rtol=1e-5)
+
+
+def test_lr_scheduler_step_decay():
+    sched = opt.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    p = _quadratic_param()
+    o = opt.SGD(learning_rate=sched, parameters=[p])
+    assert abs(o.get_lr() - 0.1) < 1e-9
+    sched.step()
+    sched.step()
+    assert abs(o.get_lr() - 0.05) < 1e-9
+
+
+def test_lr_warmup():
+    sched = opt.lr.LinearWarmup(learning_rate=0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    lrs = []
+    for _ in range(12):
+        lrs.append(sched.last_lr)
+        sched.step()
+    assert lrs[0] == 0.0
+    assert abs(lrs[5] - 0.05) < 1e-9
+    assert abs(lrs[11] - 0.1) < 1e-9
+
+
+def test_noam_decay():
+    sched = opt.lr.NoamDecay(d_model=128, warmup_steps=100, learning_rate=1.0)
+    for _ in range(99):
+        sched.step()
+    peak = sched.last_lr
+    for _ in range(300):
+        sched.step()
+    assert sched.last_lr < peak
+
+
+def test_optimizer_state_roundtrip():
+    p = _quadratic_param()
+    o = opt.Adam(learning_rate=0.1, parameters=[p])
+    _grad_step(p, o)
+    _grad_step(p, o)
+    state = o.state_dict()
+
+    p2 = _quadratic_param()
+    o2 = opt.Adam(learning_rate=0.1, parameters=[p2])
+    o2.set_state_dict(state)
+    assert o2._global_step == 2
+    np.testing.assert_allclose(
+        np.asarray(o2._accumulators["moment1"][0]),
+        np.asarray(o._accumulators["moment1"][0]),
+    )
+
+
+def test_model_training_convergence():
+    pt.seed(7)
+    np.random.seed(7)
+    x = np.random.randn(64, 8).astype(np.float32)
+    true_w = np.random.randn(8, 1).astype(np.float32)
+    y = x @ true_w + 0.01 * np.random.randn(64, 1).astype(np.float32)
+    model = nn.Linear(8, 1)
+    o = opt.Adam(learning_rate=0.05, parameters=model.parameters())
+    mse = nn.MSELoss()
+    for _ in range(100):
+        loss = mse(model(pt.to_tensor(x)), pt.to_tensor(y))
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    assert float(loss.item()) < 0.01
+    np.testing.assert_allclose(model.weight.numpy(), true_w, atol=0.15)

@@ -1,0 +1,115 @@
+"""Tensor API tests (reference: tests/unittests/test_var_base.py style)."""
+import numpy as np
+
+import paddle_tpu as pt
+
+
+def test_creation_and_dtype_default():
+    t = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    assert t.shape == [2, 2]
+    assert t.dtype == np.float32  # float64 input defaults to fp32
+
+
+def test_explicit_dtype():
+    t = pt.to_tensor([1, 2], dtype="float64")
+    assert str(t.dtype) == "float64"
+
+
+def test_numpy_roundtrip():
+    arr = np.random.randn(3, 4).astype(np.float32)
+    t = pt.to_tensor(arr)
+    np.testing.assert_array_equal(t.numpy(), arr)
+
+
+def test_arith_dunders():
+    a = pt.to_tensor([1.0, 2.0])
+    b = pt.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a**2).numpy(), [1, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((2 - a).numpy(), [1, 0])
+    np.testing.assert_allclose((2 / a).numpy(), [2, 1])
+
+
+def test_comparison():
+    a = pt.to_tensor([1.0, 5.0])
+    b = pt.to_tensor([3.0, 3.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False])
+    np.testing.assert_array_equal((a >= b).numpy(), [False, True])
+    np.testing.assert_array_equal((a == a).numpy(), [True, True])
+
+
+def test_getitem_setitem():
+    t = pt.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(t[1].numpy(), [4, 5, 6, 7])
+    np.testing.assert_allclose(t[0, 2].numpy(), 2)
+    np.testing.assert_allclose(t[:, 1].numpy(), [1, 5, 9])
+    t[0] = 0.0
+    np.testing.assert_allclose(t[0].numpy(), [0, 0, 0, 0])
+
+
+def test_methods():
+    t = pt.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert t.sum().item() == 15
+    assert t.mean().item() == 2.5
+    assert t.max().item() == 5
+    assert t.reshape([3, 2]).shape == [3, 2]
+    assert t.T.shape == [3, 2]
+    assert t.flatten().shape == [6]
+    assert t.unsqueeze(0).shape == [1, 2, 3]
+    assert t.astype("int32").dtype == np.int32
+    assert t.size == 6
+    assert len(t) == 2
+
+
+def test_item_and_bool():
+    t = pt.to_tensor([5.0])
+    assert float(t) == 5.0
+    assert bool(t > 0)
+
+
+def test_set_value():
+    t = pt.to_tensor([1.0, 2.0])
+    t.set_value(np.array([7.0, 8.0], np.float32))
+    np.testing.assert_allclose(t.numpy(), [7, 8])
+
+
+def test_creation_apis():
+    assert pt.zeros([2, 3]).shape == [2, 3]
+    assert pt.ones([2], dtype="int32").dtype == np.int32
+    np.testing.assert_allclose(pt.full([2], 3.5).numpy(), [3.5, 3.5])
+    np.testing.assert_array_equal(pt.arange(5).numpy(), np.arange(5))
+    assert pt.eye(3).numpy()[1, 1] == 1
+    assert pt.linspace(0, 1, 5).shape == [5]
+    r = pt.rand([4, 4])
+    assert 0 <= float(r.min().item()) and float(r.max().item()) <= 1
+
+
+def test_rng_determinism():
+    pt.seed(42)
+    a = pt.randn([3]).numpy()
+    pt.seed(42)
+    b = pt.randn([3]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_where_concat_stack_split():
+    a = pt.to_tensor([1.0, 2.0])
+    b = pt.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose(pt.concat([a, b]).numpy(), [1, 2, 3, 4])
+    np.testing.assert_allclose(pt.stack([a, b]).numpy(), [[1, 2], [3, 4]])
+    parts = pt.split(pt.arange(6, dtype="float32"), 3)
+    assert len(parts) == 3
+    np.testing.assert_allclose(parts[1].numpy(), [2, 3])
+    c = pt.to_tensor([True, False])
+    np.testing.assert_allclose(pt.where(c, a, b).numpy(), [1, 4])
+
+
+def test_cast_and_one_hot():
+    x = pt.to_tensor([0, 2])
+    oh = pt.ops.one_hot(x, 3)
+    np.testing.assert_allclose(oh.numpy(), [[1, 0, 0], [0, 0, 1]])
