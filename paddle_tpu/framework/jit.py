@@ -1,0 +1,353 @@
+"""Functionalization + compiled train steps.
+
+Reference parity: the role played by ParallelExecutor/CompiledProgram
+(paddle/fluid/framework/parallel_executor.cc, python/paddle/fluid/compiler.py:87)
+— turning a model + optimizer into an efficient multi-device executable — and
+by dygraph-to-static (python/paddle/fluid/dygraph/jit.py).
+
+TPU-native design: instead of rewriting a program IR, we *functionalize* the
+eager objects. A Layer's parameters/buffers and an Optimizer's accumulators
+are extracted as pytrees of jax arrays; the eager forward/step code is run
+once under JAX tracing with traced arrays swapped into the live objects,
+yielding a single pure function
+
+    step(state, batch, lr, rng) -> (state', metrics)
+
+that XLA compiles (and, under a Mesh, partitions via GSPMD). The eager code
+is the single source of truth — the same optimizer math runs eagerly and
+compiled.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .random import default_generator
+from .tensor import Tensor
+
+__all__ = [
+    "capture_state",
+    "functional_call",
+    "TrainStepFn",
+    "train_step",
+    "eval_step",
+]
+
+
+# ---------------------------------------------------------------------------
+# state extraction / swapping
+# ---------------------------------------------------------------------------
+
+
+def capture_state(model, optimizer=None):
+    """Extract the functional state of a model (+ optional optimizer).
+
+    Returns a dict pytree:
+      params  — trainable parameter arrays (name -> array)
+      frozen  — non-trainable parameter arrays
+      buffers — persistable buffers (batchnorm stats, ...)
+      opt     — optimizer accumulators + step count (if optimizer given)
+    """
+    params = OrderedDict()
+    frozen = OrderedDict()
+    for name, p in model.named_parameters():
+        (params if getattr(p, "trainable", True) else frozen)[name] = p._array
+    buffers = OrderedDict(
+        (name, b._array) for name, b in model.named_buffers() if b is not None
+    )
+    state = {"params": params, "frozen": frozen, "buffers": buffers}
+    if optimizer is not None:
+        state["opt"] = {
+            "accums": {k: list(v) for k, v in optimizer._accumulators.items()},
+            "step": jnp.asarray(optimizer._global_step, jnp.int32),
+        }
+    return state
+
+
+def restore_state(model, state, optimizer=None):
+    """Write a state pytree back into the live eager objects."""
+    named = dict(model.named_parameters())
+    for name, arr in list(state["params"].items()) + list(state["frozen"].items()):
+        named[name]._array = arr
+    named_buf = dict(model.named_buffers())
+    for name, arr in state["buffers"].items():
+        named_buf[name]._array = arr
+    if optimizer is not None and "opt" in state:
+        optimizer._accumulators = {
+            k: list(v) for k, v in state["opt"]["accums"].items()
+        }
+        optimizer._global_step = state["opt"]["step"]
+
+
+@contextlib.contextmanager
+def _swapped_model(model, state, rng_key=None):
+    """Swap state arrays into the model's live tensors for the duration.
+
+    On exit, the (possibly updated, e.g. batchnorm) buffer arrays are written
+    into ``state["buffers"]`` and originals restored.
+    """
+    named = dict(model.named_parameters())
+    named_buf = {n: b for n, b in model.named_buffers() if b is not None}
+    saved_p = {n: t._array for n, t in named.items()}
+    saved_b = {n: t._array for n, t in named_buf.items()}
+    gen = default_generator()
+    saved_key = gen.get_state()
+    try:
+        for name, arr in state["params"].items():
+            named[name]._array = arr
+        for name, arr in state["frozen"].items():
+            named[name]._array = arr
+        for name, arr in state["buffers"].items():
+            named_buf[name]._array = arr
+        if rng_key is not None:
+            gen.set_state(rng_key)
+        yield
+        state["buffers"] = OrderedDict(
+            (n, named_buf[n]._array) for n in state["buffers"]
+        )
+        state["rng"] = gen.get_state() if rng_key is not None else None
+    finally:
+        gen.set_state(saved_key)
+        for n, a in saved_p.items():
+            named[n]._array = a
+        for n, a in saved_b.items():
+            named_buf[n]._array = a
+
+
+def functional_call(model, state, *args, rng=None, **kwargs):
+    """Run ``model(*args)`` as a pure function of ``state``.
+
+    ``args`` may be jax arrays or Tensors. Returns (outputs, new_state) where
+    outputs have been unwrapped to jax arrays.
+    """
+    state = dict(state)
+    state["buffers"] = OrderedDict(state["buffers"])
+    wrapped = [
+        a if isinstance(a, Tensor) else Tensor._from_array(jnp.asarray(a))
+        for a in args
+    ]
+    with _swapped_model(model, state, rng_key=rng):
+        with autograd.no_grad():
+            out = model(*wrapped, **kwargs)
+    out = jax.tree_util.tree_map(
+        lambda x: x._array if isinstance(x, Tensor) else x,
+        out,
+        is_leaf=lambda x: isinstance(x, Tensor),
+    )
+    return out, state
+
+
+# ---------------------------------------------------------------------------
+# optimizer functionalization
+# ---------------------------------------------------------------------------
+
+
+@contextlib.contextmanager
+def _swapped_opt(optimizer, opt_state, lr):
+    saved_acc = optimizer._accumulators
+    saved_step = optimizer._global_step
+    saved_lr = optimizer._lr_override
+    try:
+        optimizer._accumulators = {
+            k: list(v) for k, v in opt_state["accums"].items()
+        }
+        optimizer._global_step = opt_state["step"]
+        optimizer._lr_override = lr
+        yield
+        opt_state["accums"] = {
+            k: list(v) for k, v in optimizer._accumulators.items()
+        }
+        opt_state["step"] = jnp.asarray(optimizer._global_step, jnp.int32)
+    finally:
+        optimizer._accumulators = saved_acc
+        optimizer._global_step = saved_step
+        optimizer._lr_override = saved_lr
+
+
+def _apply_optimizer(model, optimizer, state, grads, lr):
+    """Run optimizer.step() purely: returns (new_params, new_opt_state)."""
+    named = dict(model.named_parameters())
+    saved = {n: t._array for n, t in named.items()}
+    saved_grads = {n: t.grad for n, t in named.items()}
+    opt_state = {
+        "accums": dict(state["opt"]["accums"]),
+        "step": state["opt"]["step"],
+    }
+    try:
+        for name, arr in state["params"].items():
+            named[name]._array = arr
+            g = grads.get(name)
+            named[name].grad = Tensor._from_array(g) if g is not None else None
+        for name, arr in state["frozen"].items():
+            named[name]._array = arr
+            named[name].grad = None
+        with _swapped_opt(optimizer, opt_state, lr):
+            optimizer.step()
+        new_params = OrderedDict(
+            (n, named[n]._array) for n in state["params"]
+        )
+        return new_params, opt_state
+    finally:
+        for n, a in saved.items():
+            named[n]._array = a
+            named[n].grad = saved_grads[n]
+
+
+def init_opt_state(model, optimizer, state=None):
+    """Materialize optimizer accumulators without advancing real state.
+
+    Accumulator layout differs per optimizer class and is created lazily by
+    eager ``step()``; we discover it with ``jax.eval_shape`` (abstract trace,
+    no FLOPs) and allocate concrete zeros. This keeps the step function's
+    input pytree structure stable from the very first compiled step.
+    """
+    if state is None:
+        state = capture_state(model, optimizer)
+    if optimizer._accumulators:
+        return state  # already materialized (e.g. loaded from checkpoint)
+
+    def probe(params):
+        zero_grads = {n: jnp.zeros_like(a) for n, a in params.items()}
+        st = {
+            "params": params,
+            "frozen": state["frozen"],
+            "opt": {"accums": {}, "step": jnp.asarray(0, jnp.int32)},
+        }
+        _, opt_state = _apply_optimizer(model, optimizer, st, zero_grads, 0.0)
+        return opt_state["accums"]
+
+    shapes = jax.eval_shape(probe, state["params"])
+    accums = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes
+    )
+    optimizer._accumulators = {k: list(v) for k, v in accums.items()}
+    state["opt"] = {
+        "accums": accums,
+        "step": jnp.asarray(optimizer._global_step, jnp.int32),
+    }
+    return state
+
+
+# ---------------------------------------------------------------------------
+# compiled train / eval steps
+# ---------------------------------------------------------------------------
+
+
+class TrainStepFn:
+    """A compiled training step bound to live eager objects.
+
+    ``self.pure`` is the pure function
+        pure(state, batch, lr, rng) -> (state', metrics)
+    usable directly under jax.jit / pjit / shard_map.  Calling the object
+    runs one step, keeping state on device; ``sync()`` writes state back
+    into the eager model/optimizer (for checkpointing etc).
+    """
+
+    def __init__(self, model, optimizer, loss_fn, jit=True, donate=True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self.state = init_opt_state(model, optimizer)
+        self.pure = self._build_pure()
+        if jit:
+            self.compiled = jax.jit(
+                self.pure, donate_argnums=(0,) if donate else ()
+            )
+        else:
+            self.compiled = self.pure
+        self._rng = default_generator().split()
+
+    def _build_pure(self):
+        model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
+
+        def pure(state, batch, lr, rng):
+            frozen, buffers = state["frozen"], state["buffers"]
+
+            def loss_of(params):
+                st = {
+                    "params": params,
+                    "frozen": frozen,
+                    "buffers": OrderedDict(buffers),
+                }
+                wrapped = [Tensor._from_array(a) for a in batch]
+                with _swapped_model(model, st, rng_key=rng):
+                    with autograd.no_grad():
+                        loss = loss_fn(model, *wrapped)
+                loss_arr = loss._array if isinstance(loss, Tensor) else loss
+                return loss_arr, st["buffers"]
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(state["params"])
+            new_params, new_opt = _apply_optimizer(
+                model, optimizer, state, grads, lr
+            )
+            new_state = {
+                "params": new_params,
+                "frozen": frozen,
+                "buffers": new_buffers,
+                "opt": new_opt,
+            }
+            return new_state, {"loss": loss}
+
+        return pure
+
+    def __call__(self, *batch):
+        batch = tuple(
+            b._array if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
+        )
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        self._rng, sub = jax.random.split(self._rng)
+        self.state, metrics = self.compiled(self.state, batch, lr, sub)
+        # advance the LR scheduler's python-side state
+        lr_sched = self.optimizer._learning_rate
+        if hasattr(lr_sched, "step"):
+            lr_sched.step()
+        return metrics
+
+    def sync(self):
+        restore_state(self.model, self.state, self.optimizer)
+        return self
+
+
+def train_step(model, optimizer, loss_fn, jit=True, donate=True):
+    """Build a compiled train step.
+
+    ``loss_fn(model, *batch) -> scalar loss Tensor`` runs the eager forward.
+    """
+    return TrainStepFn(model, optimizer, loss_fn, jit=jit, donate=donate)
+
+
+def eval_step(model, fn=None, jit=True):
+    """Compile an inference step: returns callable(batch...) -> arrays."""
+    state = capture_state(model)
+    was_training = model.training
+    model.eval()
+
+    def pure(state, *batch):
+        out, _ = functional_call(model, state, *batch)
+        return out
+
+    compiled = jax.jit(pure) if jit else pure
+    if was_training:
+        model.train()
+
+    def run(*batch):
+        arrs = tuple(
+            b._array if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
+        )
+        model_was = model.training
+        model.eval()
+        try:
+            return compiled(capture_state(model), *arrs)
+        finally:
+            if model_was:
+                model.train()
+
+    run.pure = pure
+    run.state = state
+    return run

@@ -112,6 +112,9 @@ class Optimizer:
         # accumulators: name -> list of jnp arrays aligned with parameters
         self._accumulators: dict[str, list] = {}
         self._global_step = 0
+        # set by framework/jit.py to thread a traced lr through a compiled
+        # step instead of baking a python float into the XLA module
+        self._lr_override = None
 
     # accumulator helpers ---------------------------------------------------
     def _ensure_accumulator(self, name, like_fn=None):
@@ -123,6 +126,8 @@ class Optimizer:
         return self._accumulators[name]
 
     def get_lr(self):
+        if self._lr_override is not None:
+            return self._lr_override
         if isinstance(self._learning_rate, LRScheduler):
             return float(self._learning_rate())
         return float(self._learning_rate)
