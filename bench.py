@@ -1,0 +1,99 @@
+"""Headline benchmark: BERT-base MLM pretraining tokens/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+vs_baseline compares against the A100 GPU-parity target from BASELINE.md
+(the reference publishes no numbers in-tree; NVIDIA DeepLearningExamples
+BERT-base phase-1 pretraining, seq 128 fp16 + fused kernels, reports
+~700-800 sequences/sec on one A100 ≈ 90-100k tokens/sec — we use 90000
+tokens/sec/chip as the parity bar).
+
+Timing note: the final loss value is fetched (np.asarray), not just
+block_until_ready'd — on the remote-TPU (axon) backend block_until_ready
+can return before execution completes, giving absurd throughputs; a value
+fetch is the reliable barrier.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+GPU_PARITY_TOKENS_PER_SEC = 90000.0
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.framework import jit as fjit
+    from paddle_tpu.models import (
+        BertConfig,
+        BertForPretraining,
+        BertPretrainingCriterion,
+    )
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    # BERT-base on TPU; scaled-down config for CPU smoke so bench.py always
+    # completes quickly in dev environments.
+    if on_tpu:
+        cfg = BertConfig()  # base: 12L/768H
+        batch, seq, iters = 32, 128, 20
+    else:
+        cfg = BertConfig(
+            vocab_size=8192, hidden_size=256, num_hidden_layers=4,
+            num_attention_heads=8, intermediate_size=1024,
+            max_position_embeddings=128,
+        )
+        batch, seq, iters = 8, 128, 3
+
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids, tt, mlm, nsp):
+        pred, rel = m(ids, tt)
+        return crit(pred, rel, mlm, nsp)
+
+    step = fjit.train_step(model, optimizer, loss_fn)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64")
+    tt = rng.randint(0, 2, (batch, seq)).astype("int64")
+    mlm = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64")
+    nsp = rng.randint(0, 2, (batch, 1)).astype("int64")
+
+    # warmup + compile
+    float(np.asarray(step(ids, tt, mlm, nsp)["loss"]))
+    float(np.asarray(step(ids, tt, mlm, nsp)["loss"]))
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = step(ids, tt, mlm, nsp)
+    float(np.asarray(m["loss"]))  # value fetch = reliable barrier
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * iters / dt
+    print(
+        json.dumps(
+            {
+                "metric": "bert_base_pretrain_tokens_per_sec_per_chip"
+                if on_tpu
+                else "bert_small_cpu_smoke_tokens_per_sec",
+                "value": round(tokens_per_sec, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(
+                    tokens_per_sec / GPU_PARITY_TOKENS_PER_SEC, 3
+                )
+                if on_tpu
+                else 0.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

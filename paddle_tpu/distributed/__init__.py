@@ -1,0 +1,30 @@
+"""paddle.distributed equivalent — user-facing distributed API.
+
+Reference parity: python/paddle/distributed/ (collective.py, fleet/,
+launch.py, parallel.py ParallelEnv). The TPU-native runtime underneath is
+paddle_tpu.parallel (mesh + GSPMD) instead of NCCL rings + transpilers.
+"""
+from . import collective  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    barrier,
+    broadcast,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    recv,
+    alltoall,
+    new_group,
+)
+from .env import (  # noqa: F401
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+)
+from . import fleet  # noqa: F401
+from .fleet import DistributedStrategy  # noqa: F401
+from .launch import spawn  # noqa: F401

@@ -1,0 +1,266 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/collective.py (broadcast :59,
+all_reduce :115, reduce :189, all_gather :271, scatter :343, barrier :414)
+and the c_* collective op family (paddle/fluid/operators/collective/).
+
+TPU-native semantics: a *group* is a mesh axis (or tuple of axes), not an
+NCCL ring. Inside compiled/sharded code (shard_map or a sharded train
+step), these functions lower to jax.lax collectives over ICI; XLA schedules
+and overlaps them — the reference's c_sync_calc_stream/c_sync_comm_stream
+ops have no equivalent because there are no streams to sync.
+
+Outside traced code they operate on the global view directly (a sharded
+jax.Array already *is* the collective result's layout), so single-process
+"world" calls are identity transforms, matching paddle's nranks==1 path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.tensor import Tensor
+from ..parallel.mesh import get_mesh
+
+__all__ = [
+    "ReduceOp", "new_group", "all_reduce", "broadcast", "reduce",
+    "all_gather", "reduce_scatter", "scatter", "alltoall", "barrier",
+    "send", "recv",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A collective group = named mesh axis/axes (replaces ring_id)."""
+
+    def __init__(self, axes, rank=-1, nranks=1):
+        self.axes = axes if isinstance(axes, (tuple, list)) else (axes,)
+        self.rank = rank
+        self.nranks = nranks
+
+    @property
+    def name(self):
+        return "+".join(self.axes)
+
+
+_default_group = Group(("dp",))
+
+
+def new_group(ranks=None, axes=None):
+    """Create a collective group bound to mesh axes.
+
+    The reference keys groups by ring_id over explicit rank lists
+    (collective.py:_new_ring_id); on a mesh the natural key is the axis
+    name. ``ranks`` is accepted for API compat and ignored (device
+    placement is the mesh's concern).
+    """
+    return Group(axes or ("dp",))
+
+
+def _axes(group):
+    g = group or _default_group
+    return tuple(g.axes) if isinstance(g, Group) else (g,)
+
+
+def _unwrap(t):
+    return t._array if isinstance(t, Tensor) else t
+
+
+def _rewrap(arr, like):
+    if isinstance(like, Tensor):
+        like._array = arr
+        return like
+    return arr
+
+
+def _in_trace(arr) -> bool:
+    return isinstance(arr, jax.core.Tracer)
+
+
+def _valid_axes(axes):
+    """Keep only axes present in the current mesh (size>1 not required)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return ()
+    return tuple(a for a in axes if a in mesh.shape)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In traced code: psum/pmax/pmin/pprod over the group's mesh axes.
+    Eager: identity (single-controller holds the global view already)."""
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        if axes:
+            if op == ReduceOp.SUM:
+                arr = lax.psum(arr, axes)
+            elif op == ReduceOp.MAX:
+                arr = lax.pmax(arr, axes)
+            elif op == ReduceOp.MIN:
+                arr = lax.pmin(arr, axes)
+            elif op == ReduceOp.PROD:
+                arr = jnp.exp(lax.psum(jnp.log(arr), axes))
+            elif op == ReduceOp.AVG:
+                arr = lax.pmean(arr, axes)
+            else:
+                raise ValueError(f"unknown reduce op {op}")
+    return _rewrap(arr, tensor)
+
+
+def pmean(tensor, group=None):
+    return all_reduce(tensor, op=ReduceOp.AVG, group=group)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    """Traced: take the value from index ``src`` along the group axis.
+    Eager: identity."""
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        for ax in axes:
+            # one-hot select of src's shard, summed to all members
+            idx = lax.axis_index(ax)
+            mask = (idx == src).astype(arr.dtype)
+            arr = lax.psum(arr * mask, ax)
+    return _rewrap(arr, tensor)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce-to-one. On mesh hardware the all-reduce and reduce cost the
+    same over ICI, so this is all_reduce (the reference's c_reduce_* are
+    likewise allreduce-shaped on ring hardware)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
+    """Paddle signature: all_gather(tensor_list, tensor). Traced: gather
+    along a new leading axis over the group axis. Also usable functional
+    style: out = all_gather(None, tensor)."""
+    if tensor is None and not isinstance(tensor_list, list):
+        tensor_list, tensor = None, tensor_list
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        out = arr
+        for ax in axes:
+            out = lax.all_gather(out, ax)
+            out = out.reshape((-1,) + arr.shape)
+        parts = out
+    else:
+        parts = arr[None]
+    if tensor_list is not None:
+        n = parts.shape[0] if not _in_trace(arr) else parts.shape[0]
+        tensor_list.clear()
+        for i in range(n):
+            tensor_list.append(
+                Tensor._from_array(parts[i])
+                if isinstance(tensor, Tensor)
+                else parts[i]
+            )
+        return tensor_list
+    return Tensor._from_array(parts) if isinstance(tensor, Tensor) else parts
+
+
+def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """c_reducescatter equivalent: psum_scatter along the leading dim."""
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        for ax in axes:
+            arr = lax.psum_scatter(arr, ax, tiled=True)
+    return _rewrap(arr, tensor) if not isinstance(tensor, Tensor) else Tensor._from_array(arr)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Traced: each member takes its slice of src's value."""
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        for ax in axes:
+            full = broadcast(arr, src=src, group=Group((ax,)))
+            n = get_mesh().shape[ax]
+            idx = lax.axis_index(ax)
+            size = full.shape[0] // n
+            arr = lax.dynamic_slice_in_dim(full, idx * size, size, axis=0)
+    return _rewrap(arr, tensor) if not isinstance(tensor, Tensor) else Tensor._from_array(arr)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """All-to-all over the group axis (basis of expert parallelism)."""
+    arr = _unwrap(in_tensor_list)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        for ax in axes:
+            n = get_mesh().shape[ax]
+            arr = lax.all_to_all(
+                arr.reshape((n, -1) + arr.shape[1:]),
+                ax, split_axis=0, concat_axis=0, tiled=False,
+            ).reshape((-1,) + arr.shape[1:])
+    return (
+        Tensor._from_array(arr)
+        if isinstance(in_tensor_list, Tensor)
+        else arr
+    )
+
+
+def send(tensor, dst, group=None, sync_op=True):
+    """Point-to-point over a ring: ppermute shift. Paired send/recv on a
+    mesh axis is expressed as a single ppermute in the compiled program —
+    see parallel.pipeline for the pipeline-parallel use."""
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        for ax in axes:
+            n = get_mesh().shape[ax]
+            perm = [(i, dst % n) for i in range(n)]
+            arr = lax.ppermute(arr, ax, perm)
+    return _rewrap(arr, tensor)
+
+
+def recv(tensor, src, group=None, sync_op=True):
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        for ax in axes:
+            n = get_mesh().shape[ax]
+            perm = [(src % n, i) for i in range(n)]
+            arr = lax.ppermute(arr, ax, perm)
+    return _rewrap(arr, tensor)
+
+
+def shift(tensor, offset=1, group=None):
+    """Ring shift (ppermute by offset) — the primitive under ring attention
+    and pipeline handoff."""
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        axes = _valid_axes(_axes(group))
+        for ax in axes:
+            n = get_mesh().shape[ax]
+            perm = [(i, (i + offset) % n) for i in range(n)]
+            arr = lax.ppermute(arr, ax, perm)
+    return _rewrap(arr, tensor)
+
+
+def barrier(group=None):
+    """operators/collective/barrier_op.cc equivalent. Eager single
+    controller: block until all pending device work completes."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """c_sync_*_stream compat: XLA has no user-visible streams; block on
+    the value instead."""
+    arr = _unwrap(tensor)
+    if not _in_trace(arr):
+        jax.block_until_ready(arr)
+    return tensor

@@ -1,0 +1,75 @@
+"""Process/device environment.
+
+Reference parity: python/paddle/fluid/dygraph/parallel.py ParallelEnv
+(rank/world-size/device from PADDLE_TRAINER_ID / PADDLE_TRAINER_ENDPOINTS
+env) and python/paddle/distributed/parallel.py init_parallel_env.
+
+TPU-native: a single python process drives all local TPU chips (single-
+controller); multi-host pods run one process per host, coordinated by
+jax.distributed. "rank" therefore means *process* index (host), and
+device-level parallelism is expressed with meshes, not ranks.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Mirrors dygraph/parallel.py:ParallelEnv env-variable surface."""
+
+    def __init__(self):
+        self.rank = int(os.getenv("PADDLE_TRAINER_ID", os.getenv("RANK", "0")))
+        self.world_size = int(
+            os.getenv("PADDLE_TRAINERS_NUM", os.getenv("WORLD_SIZE", "1"))
+        )
+        endpoints = os.getenv("PADDLE_TRAINER_ENDPOINTS", "")
+        self.trainer_endpoints = endpoints.split(",") if endpoints else []
+        self.current_endpoint = os.getenv("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank
+
+    @property
+    def dev_id(self):
+        return int(os.getenv("FLAGS_selected_tpus", "0").split(",")[0])
+
+
+def get_rank() -> int:
+    if jax.process_count() > 1:
+        return jax.process_index()
+    return ParallelEnv().rank
+
+
+def get_world_size() -> int:
+    if jax.process_count() > 1:
+        return jax.process_count()
+    return ParallelEnv().world_size
+
+
+def init_parallel_env():
+    """Initialize multi-host coordination (c_comm_init / init_parallel_env
+    equivalent). Single-host: no-op. Multi-host: jax.distributed handshake
+    using the coordinator from env (replaces gen_nccl_id RPC rendezvous,
+    operators/collective/c_gen_nccl_id_op.cc)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    coordinator = os.getenv("PADDLE_COORDINATOR", "")
+    if env.world_size > 1 and jax.process_count() == 1 and coordinator:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=env.world_size,
+            process_id=env.rank,
+        )
+    _initialized = True
+    return env

@@ -1,0 +1,40 @@
+"""paddle.distributed.fleet equivalent.
+
+Reference parity: python/paddle/distributed/fleet/base/fleet_base.py:43
+(Fleet facade: init :81, distributed_optimizer :269, minimize :291),
+base/distributed_strategy.py (proto-backed strategy), base/role_maker.py,
+base/strategy_compiler.py (meta-optimizer selection).
+
+TPU-native: strategies configure mesh geometry + step transformations
+(amp/recompute/gradient-merge wrap the functionalized step) instead of
+rewriting a program IR with meta-optimizers.
+"""
+from .base import (  # noqa: F401
+    DistributedStrategy,
+    Fleet,
+    PaddleCloudRoleMaker,
+    UserDefinedRoleMaker,
+    fleet,
+)
+
+# module-level facade functions, mirroring `from paddle.distributed import
+# fleet; fleet.init(...)`
+init = fleet.init
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+worker_endpoints = fleet.worker_endpoints
+server_num = fleet.server_num
+server_index = fleet.server_index
+server_endpoints = fleet.server_endpoints
+is_server = fleet.is_server
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+state_dict = fleet.state_dict
+minimize = fleet.minimize

@@ -1,0 +1,340 @@
+"""Fleet facade, DistributedStrategy, role makers.
+
+Reference parity:
+- Fleet: distributed/fleet/base/fleet_base.py:43
+- DistributedStrategy: base/distributed_strategy.py over
+  framework/distributed_strategy.proto:94 (amp :96, recompute :97,
+  gradient_merge, localsgd, lars, lamb, pipeline :92, a_sync, elastic :105)
+- RoleMaker: base/role_maker.py:28 (RoleMakerBase), :167
+  (PaddleCloudRoleMaker — role/rank/endpoints from env)
+
+TPU-native: DistributedStrategy gains mesh-geometry fields (dp/tp/pp/sp/ep
+degrees) that the reference lacks (its TP/SP/EP are absent — SURVEY.md
+§2.3); meta-optimizer program rewriting is replaced by composing step
+transformations over the functionalized train step.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from ..env import ParallelEnv, init_parallel_env
+
+
+@dataclass
+class PipelineConfig:
+    """framework/distributed_strategy.proto:92 PipelineConfig."""
+
+    micro_batch: int = 1
+    accumulate_steps: int = 1
+
+
+@dataclass
+class RecomputeConfig:
+    checkpoints: list = field(default_factory=list)
+
+
+@dataclass
+class AMPConfig:
+    init_loss_scaling: float = 32768.0
+    incr_every_n_steps: int = 1000
+    decr_every_n_nan_or_inf: int = 2
+    incr_ratio: float = 2.0
+    decr_ratio: float = 0.5
+    use_dynamic_loss_scaling: bool = True
+    custom_white_list: list = field(default_factory=list)
+    custom_black_list: list = field(default_factory=list)
+
+
+@dataclass
+class GradientMergeConfig:
+    k_steps: int = 1
+    avg: bool = True
+
+
+@dataclass
+class LocalSGDConfig:
+    k_steps: int = 1
+
+
+@dataclass
+class DGCConfig:
+    rampup_begin_step: int = 0
+
+
+@dataclass
+class LarsConfig:
+    lars_coeff: float = 0.001
+    lars_weight_decay: float = 0.0005
+
+
+@dataclass
+class LambConfig:
+    lamb_weight_decay: float = 0.01
+
+
+@dataclass
+class ShardingConfig:
+    """ZeRO-style optimizer-state sharding (absent in the reference —
+    SURVEY.md §2.3; here it is a first-class mesh axis use)."""
+
+    stage: int = 1
+
+
+class DistributedStrategy:
+    """Mutable strategy bag, field names matching the reference proto."""
+
+    def __init__(self):
+        # reference fields (distributed_strategy.proto:94-118)
+        self.amp = False
+        self.amp_configs = AMPConfig()
+        self.recompute = False
+        self.recompute_configs = RecomputeConfig()
+        self.pipeline = False
+        self.pipeline_configs = PipelineConfig()
+        self.gradient_merge = False
+        self.gradient_merge_configs = GradientMergeConfig()
+        self.localsgd = False
+        self.localsgd_configs = LocalSGDConfig()
+        self.dgc = False
+        self.dgc_configs = DGCConfig()
+        self.lars = False
+        self.lars_configs = LarsConfig()
+        self.lamb = False
+        self.lamb_configs = LambConfig()
+        self.a_sync = False
+        self.elastic = False
+        self.auto = False
+        self.nccl_comm_num = 1  # accepted, meaningless on TPU
+        self.sync_batch_norm = False
+        self.fuse_all_reduce_ops = True  # XLA does this; kept for compat
+        self.fuse_grad_size_in_MB = 32
+        # TPU-native extensions: mesh geometry
+        self.sharding = False
+        self.sharding_configs = ShardingConfig()
+        self.dp_degree = 0  # 0 = infer (all remaining devices)
+        self.tp_degree = 1
+        self.pp_degree = 1
+        self.sp_degree = 1
+        self.ep_degree = 1
+        self.sharding_rules = None  # parallel.ShardingRules override
+
+    def __repr__(self):
+        on = [k for k, v in self.__dict__.items() if v is True]
+        return f"DistributedStrategy(enabled={on})"
+
+
+class RoleMakerBase:
+    """base/role_maker.py:28."""
+
+    def __init__(self):
+        self._is_collective = True
+
+    def is_worker(self):
+        return True
+
+    def is_server(self):
+        return False
+
+    def is_first_worker(self):
+        return self.worker_index() == 0
+
+    def worker_index(self):
+        return 0
+
+    def worker_num(self):
+        return 1
+
+    def get_trainer_endpoints(self):
+        return []
+
+    def get_pserver_endpoints(self):
+        return []
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """base/role_maker.py:167 — role from env variables."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._env = ParallelEnv()
+
+    def worker_index(self):
+        return self._env.rank
+
+    def worker_num(self):
+        return self._env.world_size
+
+    def get_trainer_endpoints(self):
+        return self._env.trainer_endpoints
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    def __init__(self, current_id=0, role=None, worker_num=1,
+                 server_endpoints=None, is_collective=True, **kwargs):
+        super().__init__()
+        self._current_id = current_id
+        self._worker_num = worker_num
+        self._is_collective = is_collective
+
+    def worker_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+
+class Fleet:
+    """fleet_base.py:43 facade, singleton via module-level ``fleet``."""
+
+    def __init__(self):
+        self._role_maker = None
+        self._strategy = None
+        self._is_initialized = False
+        self._mesh = None
+        self._user_defined_optimizer = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective
+        )
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        self._is_initialized = True
+        return self
+
+    def build_mesh(self):
+        """Materialize the mesh implied by the strategy's degrees."""
+        from ...parallel import MeshConfig, create_mesh
+        import jax
+
+        s = self._strategy
+        n = len(jax.devices())
+        fixed = s.tp_degree * s.pp_degree * s.sp_degree * s.ep_degree
+        dp = s.dp_degree or max(1, n // fixed)
+        self._mesh = create_mesh(
+            MeshConfig(dp=dp, tp=s.tp_degree, pp=s.pp_degree,
+                       sp=s.sp_degree, ep=s.ep_degree)
+        )
+        return self._mesh
+
+    # -- role queries (fleet_base.py surface) -------------------------------
+    def is_first_worker(self):
+        return self._role_maker.is_first_worker()
+
+    def worker_index(self):
+        return self._role_maker.worker_index()
+
+    def worker_num(self):
+        return self._role_maker.worker_num()
+
+    def is_worker(self):
+        return self._role_maker.is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        eps = self._role_maker.get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        return len(self._role_maker.get_pserver_endpoints())
+
+    def server_index(self):
+        return 0
+
+    def server_endpoints(self, to_string=False):
+        eps = self._role_maker.get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        return self._role_maker.is_server()
+
+    def barrier_worker(self):
+        from .. import collective
+
+        collective.barrier()
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise NotImplementedError(
+            "parameter-server mode is not part of the TPU runtime "
+            "(SURVEY.md §7: PS/grpc path deferred — orthogonal to TPU)"
+        )
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None, **kwargs):
+        from ...static import io
+
+        return io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor,
+            main_program=main_program,
+        )
+
+    def save_persistables(self, executor, dirname, main_program=None, **kw):
+        from ...static import io
+
+        return io.save_persistables(executor, dirname, main_program)
+
+    # -- the core: distributed optimizer/model ------------------------------
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        self._user_defined_optimizer = optimizer
+        return DistributedOptimizer(self, optimizer, self._strategy)
+
+    def distributed_model(self, model):
+        """Dygraph DataParallel equivalent: on the single-controller TPU
+        runtime the model is already global; gradient sync happens inside
+        the sharded step, so this is identity (kept for API parity with
+        fluid/dygraph/parallel.py:225)."""
+        return model
+
+    def state_dict(self):
+        opt = self._user_defined_optimizer
+        return opt.state_dict() if opt is not None else {}
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        opt = self._user_defined_optimizer
+        if opt is None:
+            raise RuntimeError("call fleet.distributed_optimizer first")
+        return opt.minimize(loss)
+
+
+class DistributedOptimizer:
+    """Wraps a user optimizer per strategy (meta_optimizers/ equivalent).
+
+    In eager/dygraph usage it behaves like the wrapped optimizer; its main
+    job is carrying the strategy so train-step builders (hapi Model,
+    parallel.sharded_train_step, amp decorators) can read it.
+    """
+
+    def __init__(self, fleet_obj, inner, strategy):
+        self._fleet = fleet_obj
+        self.inner_opt = inner
+        self.user_defined_strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self.inner_opt, name)
+
+    def step(self):
+        return self.inner_opt.step()
+
+    def clear_grad(self):
+        return self.inner_opt.clear_grad()
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self.inner_opt.minimize(loss)
+
+
+fleet = Fleet()

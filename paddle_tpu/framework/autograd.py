@@ -77,6 +77,16 @@ def _is_floating(dtype) -> bool:
     return jax.numpy.issubdtype(dtype, np.floating)
 
 
+# AMP autocast hook (imperative/amp_auto_cast.cc equivalent): installed by
+# paddle_tpu.amp; consulted on every eager op dispatch.
+_amp_hook = None
+
+
+def set_amp_hook(fn):
+    global _amp_hook
+    _amp_hook = fn
+
+
 def apply_op(op_type, fn, tensors, attrs, num_outputs=None):
     """Execute a registered op kernel on Tensor inputs, recording a grad node.
 
@@ -86,6 +96,8 @@ def apply_op(op_type, fn, tensors, attrs, num_outputs=None):
     from .tensor import Tensor  # circular-safe at call time
 
     arrays = [t._array for t in tensors]
+    if _amp_hook is not None:
+        arrays = _amp_hook(op_type, arrays)
     requires_grad = _grad_enabled() and any(
         (not t.stop_gradient) and _is_floating(t.dtype) for t in tensors
     )

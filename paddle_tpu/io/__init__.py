@@ -1,0 +1,31 @@
+"""paddle.io equivalent — datasets, samplers, DataLoader.
+
+Reference parity: python/paddle/fluid/dataloader/ (dataset.py,
+batch_sampler.py, collate), fluid/reader.py DataLoader :123 (multiprocess
+worker loop :870, shared-memory transport via memory/allocation/
+mmap_allocator.cc + pybind/reader_py.cc), operators/reader/
+buffered_reader.cc (double-buffer H2D prefetch).
+
+TPU-native: workers feed a prefetch pipeline that lands batches in device
+memory (jax.device_put ahead of use) so the step function never waits on
+H2D; the shared-memory transport is the native ring buffer in
+paddle_tpu/_native (C++), with a multiprocessing.shared_memory fallback.
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

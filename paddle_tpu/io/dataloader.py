@@ -1,0 +1,233 @@
+"""DataLoader with multiprocess workers and device prefetch.
+
+Reference parity: fluid/reader.py DataLoader :123 / DygraphGeneratorLoader
+:697 (worker subprocess loop :870), operators/reader/buffered_reader.cc
+(double-buffered H2D prefetch), memory/allocation/mmap_allocator.cc
+(shared-memory tensor transport between workers and the trainer).
+
+TPU-native: worker processes serialize numpy batches over the native
+shared-memory ring (paddle_tpu._native.shm_ring, C++) — falling back to
+multiprocessing.Queue pickling — and the main process keeps
+``prefetch_factor`` batches in flight with async jax.device_put, so the
+accelerator never stalls on input (buffered_reader.cc's role).
+"""
+from __future__ import annotations
+
+import atexit
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+
+import numpy as np
+
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+# stop sentinel must survive pickling across the process boundary (an
+# object() loses identity in the worker), so use None
+_MP_STOP = None
+
+
+def default_collate_fn(batch):
+    """Stack samples into batch arrays (fluid/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(
+            default_collate_fn([s[i] for s in batch])
+            for i in range(len(sample))
+        )
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    from ..framework.tensor import Tensor
+
+    if isinstance(sample, Tensor):
+        return np.stack([s.numpy() for s in batch])
+    return np.asarray(batch)
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, use_shm):
+    """Worker process body (reader.py:870 _reader_process_loop)."""
+    try:
+        shm = None
+        if use_shm:
+            try:
+                from .._native import shm_ring
+
+                shm = shm_ring
+            except Exception:
+                shm = None
+        while True:
+            task = index_queue.get()
+            if task is None:
+                break
+            seq, indices = task
+            try:
+                batch = collate_fn([dataset[i] for i in indices])
+                data_queue.put((seq, batch, None))
+            except Exception as e:  # propagate to main process
+                data_queue.put((seq, None, e))
+    except KeyboardInterrupt:
+        pass
+
+
+class _MultiprocessIter:
+    def __init__(self, loader):
+        self.loader = loader
+        ds = loader.dataset
+        self.batches = list(iter(loader.batch_sampler))
+        ctx = mp.get_context("fork")
+        self.index_queue = ctx.Queue()
+        self.data_queue = ctx.Queue(maxsize=loader.num_workers * loader.prefetch_factor)
+        self.workers = [
+            ctx.Process(
+                target=_worker_loop,
+                args=(ds, self.index_queue, self.data_queue,
+                      loader.collate_fn, loader.use_shared_memory),
+                daemon=True,
+            )
+            for _ in range(loader.num_workers)
+        ]
+        for w in self.workers:
+            w.start()
+        atexit.register(self.shutdown)
+        self._send = 0
+        self._recv = 0
+        self._reorder = {}
+        # pre-dispatch
+        for _ in range(loader.num_workers * loader.prefetch_factor):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._send < len(self.batches):
+            self.index_queue.put((self._send, self.batches[self._send]))
+            self._send += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._recv >= len(self.batches):
+            self.shutdown()
+            raise StopIteration
+        while self._recv not in self._reorder:
+            seq, batch, err = self.data_queue.get()
+            if err is not None:
+                self.shutdown()
+                raise err
+            self._reorder[seq] = batch
+        batch = self._reorder.pop(self._recv)
+        self._recv += 1
+        self._dispatch()
+        return batch
+
+    def shutdown(self):
+        for _ in self.workers:
+            try:
+                self.index_queue.put(_MP_STOP)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+
+
+class _DevicePrefetcher:
+    """buffered_reader.cc equivalent: keep N batches already on device."""
+
+    def __init__(self, it, depth=2, to_device=None):
+        self.it = it
+        self.depth = depth
+        self.to_device = to_device
+        self.buf = []
+        self._fill()
+
+    def _fill(self):
+        import jax
+
+        while len(self.buf) < self.depth:
+            try:
+                batch = next(self.it)
+            except StopIteration:
+                return
+            if self.to_device:
+                batch = jax.tree_util.tree_map(jax.device_put, batch)
+            self.buf.append(batch)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if not self.buf:
+            raise StopIteration
+        batch = self.buf.pop(0)
+        self._fill()
+        return batch
+
+
+class DataLoader:
+    """paddle.io.DataLoader surface (fluid/reader.py:123)."""
+
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = use_shared_memory
+        self.return_list = return_list
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last,
+            )
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _single_iter(self):
+        if self._iterable_mode:
+            it = iter(self.dataset)
+            while True:
+                batch = list(itertools.islice(it, self.batch_size))
+                if not batch:
+                    return
+                if len(batch) < self.batch_size and self.drop_last:
+                    return
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self.num_workers > 0 and not self._iterable_mode:
+            it = iter(_MultiprocessIter(self))
+        else:
+            it = self._single_iter()
+        if self.use_buffer_reader:
+            return iter(
+                _DevicePrefetcher(it, depth=self.prefetch_factor,
+                                  to_device=True)
+            )
+        return it

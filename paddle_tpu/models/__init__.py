@@ -1,0 +1,20 @@
+"""Model zoo.
+
+Reference parity: the models used by the reference's tests and hapi
+(python/paddle/incubate/hapi/vision/models/, tests/book/, the dist-test
+fixtures dist_mnist.py / dist_se_resnext.py / dist_transformer.py).
+Flagship = BERT (the BASELINE.md headline metric is BERT-base
+tokens/sec/chip).
+"""
+from .lenet import LeNet  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertModel,
+    BertForPretraining,
+    BertPretrainingCriterion,
+    bert_base_config,
+    bert_tiny_config,
+    bert_sharding_rules,
+)
+from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+from .word2vec import Word2Vec  # noqa: F401

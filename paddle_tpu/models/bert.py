@@ -1,0 +1,294 @@
+"""BERT / ERNIE-style transformer encoder for pretraining.
+
+Reference parity: the reference ships the transformer layer stack
+(python/paddle/nn/layer/transformer.py:67,385) and BERT-shaped fused
+attention (operators/fused/multihead_matmul_op.cu); the full model matches
+the ERNIE/BERT configs the reference's ecosystem trains. BASELINE.md's
+headline metric (BERT-base tokens/sec/chip) is measured on this model.
+
+TPU-native design decisions:
+- bf16-first: matmul-heavy blocks run in bfloat16 under AMP; master
+  weights stay fp32.
+- sharding-aware: activations carry GSPMD constraints (dp on batch, sp on
+  sequence); ``bert_sharding_rules()`` gives megatron TP partitioning of
+  qkv/out/ffn weights + vocab-parallel embedding. With both, XLA emits the
+  same collective schedule megatron implements by hand.
+- attention dispatches to the pallas flash kernel on TPU for long
+  sequences (ops/pallas), falling back to the jnp path elsewhere.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layers import Dropout, Embedding, LayerList, LayerNorm, Linear
+from ..nn.transformer import TransformerEncoder, TransformerEncoderLayer
+from ..parallel.sharding import ShardingRules, with_sharding_constraint
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+
+
+def bert_base_config() -> BertConfig:
+    return BertConfig()
+
+
+def bert_tiny_config() -> BertConfig:
+    """For tests / dryruns: 2 layers, 128 hidden."""
+    return BertConfig(
+        vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=512,
+        max_position_embeddings=128, type_vocab_size=2,
+    )
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size
+        )
+        self.token_type_embeddings = Embedding(
+            cfg.type_vocab_size, cfg.hidden_size
+        )
+        self.layer_norm = LayerNorm(cfg.hidden_size)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        if position_ids is None:
+            seq_len = input_ids.shape[1]
+            position_ids = ops.expand(
+                ops.unsqueeze(ops.arange(seq_len, dtype="int64"), 0),
+                [input_ids.shape[0], seq_len],
+            )
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        emb = (
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+            + self.token_type_embeddings(token_type_ids)
+        )
+        emb = self.layer_norm(emb)
+        emb = self.dropout(emb)
+        # batch on dp, sequence on sp, hidden replicated
+        return with_sharding_constraint(emb, P("dp", "sp", None))
+
+
+class BertPooler(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+def _init_bert_weights(model, initializer_range):
+    """Truncated-normal(σ=initializer_range) init of all linear/embedding
+    weights, zeros for biases — the standard BERT scheme."""
+    import numpy as np
+
+    from ..framework.random import default_generator
+    import jax
+
+    for name, p in model.named_parameters():
+        if "norm" in name:  # layer_norm/norm1/norm2 scales stay 1, biases 0
+            continue
+        if p._array.ndim >= 2 and ("weight" in name.split(".")[-1]):
+            key = default_generator().split()
+            arr = (
+                jax.random.truncated_normal(
+                    key, -2.0, 2.0, p._array.shape, "float32"
+                )
+                * initializer_range
+            )
+            p._array = arr.astype(p._array.dtype)
+        elif name.endswith("bias"):
+            p._array = p._array * 0
+
+
+class _BertStage(Layer):
+    """One pipeline stage: k consecutive encoder layers, (x, mask) -> x."""
+
+    def __init__(self, layers):
+        super().__init__()
+        self.layers = LayerList(layers)
+
+    def forward(self, x, mask):
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x
+
+
+class BertModel(Layer):
+    def __init__(self, cfg: BertConfig | None = None, pipeline_stages=1,
+                 num_microbatches=1, **kwargs):
+        super().__init__()
+        self.config = cfg or BertConfig(**kwargs)
+        cfg = self.config
+        self.embeddings = BertEmbeddings(cfg)
+
+        def make_layer():
+            return TransformerEncoderLayer(
+                cfg.hidden_size,
+                cfg.num_attention_heads,
+                cfg.intermediate_size,
+                dropout=cfg.hidden_dropout_prob,
+                activation=cfg.hidden_act,
+                attn_dropout=cfg.attention_probs_dropout_prob,
+                act_dropout=0.0,
+            )
+
+        self._pipelined = pipeline_stages > 1
+        if self._pipelined:
+            # pp mode: encoder layers grouped into GPipe stages
+            # (PipelineOptimizer equivalent, fluid/optimizer.py:4431)
+            from ..parallel.pipeline import GPipe
+
+            assert cfg.num_hidden_layers % pipeline_stages == 0
+            per = cfg.num_hidden_layers // pipeline_stages
+            stages = [
+                _BertStage([make_layer() for _ in range(per)])
+                for _ in range(pipeline_stages)
+            ]
+            self.encoder = GPipe(stages, num_microbatches=num_microbatches)
+        else:
+            self.encoder = TransformerEncoder(
+                make_layer(), cfg.num_hidden_layers
+            )
+        self.pooler = BertPooler(cfg)
+        _init_bert_weights(self, cfg.initializer_range)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is None:
+            attention_mask = ops.cast(
+                ops.not_equal(input_ids, ops.full_like(input_ids, self.config.pad_token_id)),
+                "float32",
+            )
+        # [B, L] -> additive [B, 1, 1, L]
+        ext = ops.unsqueeze(attention_mask, [1, 2])
+        ext = (1.0 - ext) * -1e4
+        emb = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(emb, ext)
+        seq = with_sharding_constraint(seq, P("dp", "sp", None))
+        pooled = self.pooler(seq)
+        return seq, pooled
+
+
+class BertLMPredictionHead(Layer):
+    """MLM head with tied input embedding weights (standard BERT)."""
+
+    def __init__(self, cfg: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.activation = getattr(F, cfg.hidden_act)
+        self.layer_norm = LayerNorm(cfg.hidden_size)
+        self.decoder_weight = embedding_weights  # tied [V, H] parameter
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True
+        )
+
+    def forward(self, hidden_states, masked_positions=None):
+        if masked_positions is not None:
+            # gather the masked token positions: [B, L, H] -> [N, H]
+            b, l, h = hidden_states.shape
+            flat = ops.reshape(hidden_states, [b * l, h])
+            hidden_states = ops.gather(flat, masked_positions)
+        x = self.layer_norm(self.activation(self.transform(hidden_states)))
+        logits = ops.matmul(x, self.decoder_weight, transpose_y=True)
+        return logits + self.decoder_bias
+
+
+class BertForPretraining(Layer):
+    """MLM + next-sentence-prediction pretraining model."""
+
+    def __init__(self, cfg: BertConfig | None = None, pipeline_stages=1,
+                 num_microbatches=1, **kwargs):
+        super().__init__()
+        self.bert = BertModel(
+            cfg, pipeline_stages=pipeline_stages,
+            num_microbatches=num_microbatches, **kwargs
+        )
+        cfg = self.bert.config
+        self.cls = BertLMPredictionHead(
+            cfg, self.bert.embeddings.word_embeddings.weight
+        )
+        self.seq_relationship = Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, masked_positions=None):
+        seq, pooled = self.bert(
+            input_ids, token_type_ids, position_ids, attention_mask
+        )
+        prediction_scores = self.cls(seq, masked_positions)
+        seq_relationship_score = self.seq_relationship(pooled)
+        return prediction_scores, seq_relationship_score
+
+
+class BertPretrainingCriterion(Layer):
+    """MLM + NSP loss (softmax_with_cross_entropy on both heads)."""
+
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels, masked_lm_scale=1.0):
+        mlm = F.cross_entropy(
+            ops.reshape(prediction_scores, [-1, self.vocab_size]),
+            ops.reshape(masked_lm_labels, [-1]),
+        )
+        nsp = F.cross_entropy(
+            seq_relationship_score, ops.reshape(next_sentence_labels, [-1])
+        )
+        return mlm.mean() / masked_lm_scale + nsp.mean()
+
+
+def bert_sharding_rules() -> ShardingRules:
+    """Megatron-style TP partition of BERT weights over the tp axis.
+
+    Column-parallel: q/k/v projections and FFN up-projection (output dim
+    split). Row-parallel: attention output and FFN down-projection (input
+    dim split). Vocab-parallel embedding + tied MLM decoder. Linear weights
+    are stored [in, out].
+
+    Includes the pipelined variants: GPipe stacks stage params on a
+    leading axis (name mangled with ``__``), sharded pp × tp.
+    """
+    return ShardingRules([
+        # pipelined (stacked) encoder weights: [stage, ...] — pp × tp
+        (r"stacked__.*self_attn__(q|k|v)_proj__weight$", P("pp", None, "tp")),
+        (r"stacked__.*self_attn__(q|k|v)_proj__bias$", P("pp", "tp")),
+        (r"stacked__.*self_attn__out_proj__weight$", P("pp", "tp", None)),
+        (r"stacked__.*linear1__weight$", P("pp", None, "tp")),
+        (r"stacked__.*linear1__bias$", P("pp", "tp")),
+        (r"stacked__.*linear2__weight$", P("pp", "tp", None)),
+        (r"stacked__", P("pp")),
+        # unpipelined encoder weights
+        (r"\.self_attn\.(q|k|v)_proj\.weight$", P(None, "tp")),
+        (r"\.self_attn\.(q|k|v)_proj\.bias$", P("tp")),
+        (r"\.self_attn\.out_proj\.weight$", P("tp", None)),
+        (r"\.linear1\.weight$", P(None, "tp")),
+        (r"\.linear1\.bias$", P("tp")),
+        (r"\.linear2\.weight$", P("tp", None)),
+        (r"word_embeddings\.weight$", P("tp", None)),
+    ])

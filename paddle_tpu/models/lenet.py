@@ -1,0 +1,44 @@
+"""LeNet-5 for MNIST.
+
+Reference parity: the `dist_mnist.py` / `test_recognize_digits.py` fixture
+model (python/paddle/fluid/tests/unittests/dist_mnist.py cnn_model;
+incubate/hapi/vision/models/lenet.py).
+"""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layers import Conv2D, Flatten, Linear, MaxPool2D, Sequential
+
+
+class LeNet(Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(1, 6, 3, stride=1, padding=1),
+            _Act("relu"),
+            MaxPool2D(2, 2),
+            Conv2D(6, 16, 5, stride=1, padding=0),
+            _Act("relu"),
+            MaxPool2D(2, 2),
+        )
+        self.fc = Sequential(
+            Flatten(),
+            Linear(400, 120),
+            _Act("relu"),
+            Linear(120, 84),
+            _Act("relu"),
+            Linear(84, num_classes),
+        )
+
+    def forward(self, x):
+        return self.fc(self.features(x))
+
+
+class _Act(Layer):
+    def __init__(self, name):
+        super().__init__()
+        self._fn = getattr(F, name)
+
+    def forward(self, x):
+        return self._fn(x)

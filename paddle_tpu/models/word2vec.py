@@ -1,0 +1,27 @@
+"""Skip-gram word2vec.
+
+Reference parity: tests/book/test_word2vec.py and the dist_word2vec.py
+dist-test fixture (CBOW with shared embedding + softmax head).
+"""
+from __future__ import annotations
+
+from .. import ops
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layers import Embedding, Linear
+
+
+class Word2Vec(Layer):
+    """CBOW: predict middle word from N context words."""
+
+    def __init__(self, vocab_size, embed_dim=32, context=4):
+        super().__init__()
+        self.embedding = Embedding(vocab_size, embed_dim)
+        self.fc = Linear(embed_dim, vocab_size)
+        self.context = context
+
+    def forward(self, context_ids):
+        # context_ids: [B, context]
+        emb = self.embedding(context_ids)  # [B, C, E]
+        hidden = ops.mean(emb, axis=1)
+        return self.fc(hidden)

@@ -32,12 +32,18 @@ class MultiHeadAttention(Layer):
     Cache = tuple  # (k, v)
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
-                 need_weights=False, weight_attr=None, bias_attr=None):
+                 need_weights=False, weight_attr=None, bias_attr=None,
+                 use_ring_attention=False):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
         self.need_weights = need_weights
+        # TPU extension: sequence-parallel ring attention over the sp mesh
+        # axis (parallel/ring_attention.py). Requires dropout == 0.
+        self.use_ring_attention = use_ring_attention
+        if use_ring_attention and dropout:
+            raise ValueError("ring attention does not support attn dropout")
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim
         kdim = kdim or embed_dim
@@ -66,14 +72,24 @@ class MultiHeadAttention(Layer):
             new_cache = (k, v)
 
         scale = float(self.head_dim) ** -0.5
-        scores = ops.matmul(q, k, transpose_y=True) * scale
-        mask = _convert_attention_mask(attn_mask, q.dtype)
-        if mask is not None:
-            scores = scores + mask
-        weights = F.softmax(scores, axis=-1)
-        if self.dropout:
-            weights = F.dropout(weights, p=self.dropout, training=self.training)
-        out = ops.matmul(weights, v)  # [B, H, L, D]
+        mask_ring_ok = attn_mask is None or (
+            attn_mask.ndim == 4 and attn_mask.shape[-2] == 1
+        )  # ring rotation supports only K-dim [B,1,1,L] masks
+        if (self.use_ring_attention and not self.need_weights
+                and cache is None and mask_ring_ok):
+            from ..parallel.ring_attention import ring_attention
+
+            mask = _convert_attention_mask(attn_mask, q.dtype)
+            out = ring_attention(q, k, v, mask=mask, scale=scale)
+        else:
+            scores = ops.matmul(q, k, transpose_y=True) * scale
+            mask = _convert_attention_mask(attn_mask, q.dtype)
+            if mask is not None:
+                scores = scores + mask
+            weights = F.softmax(scores, axis=-1)
+            if self.dropout:
+                weights = F.dropout(weights, p=self.dropout, training=self.training)
+            out = ops.matmul(weights, v)  # [B, H, L, D]
         out = ops.transpose(out, [0, 2, 1, 3])
         b, l = out.shape[0], out.shape[1]
         out = ops.reshape(out, [b, l, self.embed_dim])

@@ -164,6 +164,10 @@ class Optimizer:
         self._global_step += 1
         for (i, p), g in params_grads:
             new_param = self._apply_one(i, p._array, g, lr_value)
+            # keep the param dtype stable: scalar math (e.g. beta**t under
+            # x64) must not silently upcast master weights
+            if new_param.dtype != p._array.dtype:
+                new_param = new_param.astype(p._array.dtype)
             p._array = new_param
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):

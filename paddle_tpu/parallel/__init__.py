@@ -1,0 +1,37 @@
+"""paddle_tpu.parallel — SPMD machinery over TPU meshes.
+
+Replaces the reference's NCCL-ring world (platform/collective_helper.h:62
+NCCLCommContext, framework/parallel_executor.cc ring init) with the
+TPU-native model: a single logical `jax.sharding.Mesh` with named axes
+
+    dp — data parallel           (batch dimension)
+    pp — pipeline parallel       (layer stages)
+    tp — tensor/model parallel   (hidden dimension, megatron-style)
+    sp — sequence/context parallel (ring attention over ICI)
+    ep — expert parallel         (MoE experts)
+
+Collectives are mesh-axis reductions compiled by XLA onto ICI/DCN — there
+are no comm streams, rings, or sync ops to manage (c_sync_calc_stream etc.
+intentionally have no equivalent).
+"""
+from .mesh import (  # noqa: F401
+    MeshConfig,
+    create_mesh,
+    get_mesh,
+    set_mesh,
+    mesh_scope,
+    axis_size,
+    in_mesh,
+)
+from .sharding import (  # noqa: F401
+    ShardingRules,
+    named_sharding,
+    shard_state,
+    shard_batch,
+    with_sharding_constraint,
+    DEFAULT_RULES,
+)
+from .train import sharded_train_step  # noqa: F401
+from .ring_attention import ring_attention  # noqa: F401
+from .pipeline import GPipe  # noqa: F401
+from .moe import MoELayer, SwitchFFN  # noqa: F401

@@ -1,0 +1,99 @@
+"""Device-mesh management.
+
+Reference parity: the role of ParallelExecutor's communicator setup
+(paddle/fluid/framework/parallel_executor.cc:118 InitNCCLCtxs — flat and
+hierarchical rings keyed by ring_id) and imperative/nccl_context.cc
+bootstrap. TPU-native: one logical mesh, axes named by parallelism kind;
+"rings" are mesh axes and need no bootstrap — XLA lowers collectives onto
+ICI/DCN directly.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+# canonical axis order: pipeline outermost (cross-slice / DCN friendly),
+# then data, then the intra-layer axes that want highest ICI bandwidth
+AXES = ("pp", "dp", "ep", "sp", "tp")
+
+_state = threading.local()
+
+
+@dataclass
+class MeshConfig:
+    """Sizes of each parallelism axis (1 = disabled).
+
+    Mirrors the role of DistributedStrategy's hierarchical-allreduce /
+    nranks knobs (framework/distributed_strategy.proto:94) but expressed as
+    mesh geometry.
+    """
+
+    dp: int = 1
+    pp: int = 1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+    devices: list = field(default=None)
+
+    def total(self) -> int:
+        return self.dp * self.pp * self.tp * self.sp * self.ep
+
+
+def create_mesh(config: MeshConfig | None = None, **sizes) -> Mesh:
+    """Build a Mesh with the canonical axis order.
+
+    create_mesh(dp=2, tp=4) uses 8 devices; unspecified axes default to 1
+    and still appear in the mesh so sharding rules can always reference
+    them. With no sizes at all, all devices go to dp.
+    """
+    if config is None:
+        config = MeshConfig(**sizes)
+    devices = config.devices if config.devices is not None else jax.devices()
+    n = config.total()
+    if not sizes and config.dp == 1 and n == 1:
+        config.dp = len(devices)
+        n = config.dp
+    if n > len(devices):
+        raise ValueError(
+            f"mesh needs {n} devices ({config}), only {len(devices)} available"
+        )
+    shape = [getattr(config, ax) for ax in AXES]
+    arr = np.array(devices[:n]).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def set_mesh(mesh: Mesh | None):
+    _state.mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh | None:
+    return getattr(_state, "mesh", None)
+
+
+def in_mesh() -> bool:
+    return get_mesh() is not None
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    prev = get_mesh()
+    set_mesh(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        set_mesh(prev)
+
+
+def axis_size(axis: str, mesh: Mesh | None = None) -> int:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(axis, 1))

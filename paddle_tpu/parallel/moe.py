@@ -1,0 +1,180 @@
+"""Mixture-of-Experts with expert parallelism over the ep mesh axis.
+
+Beyond-reference capability (SURVEY.md §2.3: EP/MoE absent in the
+reference). GShard/Switch-style top-k routing implemented as dense
+einsum dispatch/combine: expert weights carry a leading [num_experts]
+axis sharded on ep, tokens are dispatched with a one-hot combine tensor,
+and GSPMD lowers the dispatch einsums to all-to-alls over ICI.
+
+The dense-dispatch formulation (einsum with a [G, S, E, C] combine tensor
+instead of gather/scatter) is the canonical TPU design: static shapes,
+MXU-friendly, no sorting kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .. import ops
+from ..framework import autograd
+from ..framework.tensor import Parameter, Tensor
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layers import Linear
+from .mesh import get_mesh
+from .sharding import ShardingRules, with_sharding_constraint
+
+__all__ = ["MoELayer", "SwitchFFN"]
+
+
+class SwitchFFN(Layer):
+    """Top-1 (Switch) routed expert FFN.
+
+    x: [B, L, H] -> [B, L, H]; E experts, each a 2-layer MLP with
+    intermediate dim F. Expert params are [E, ...] leaves sharded on ep.
+    """
+
+    def __init__(self, hidden_size, intermediate_size, num_experts,
+                 capacity_factor=1.25, activation="relu",
+                 router_noise=1e-2):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.router_noise = router_noise
+        self.router = Linear(hidden_size, num_experts)
+        # expert weights: [E, H, F], [E, F], [E, F, H], [E, H]
+        bound1 = float(np.sqrt(6.0 / (hidden_size + intermediate_size)))
+        from ..framework.random import split_key
+
+        self.expert_w1 = Parameter.from_array(
+            jax.random.uniform(
+                split_key(), (num_experts, hidden_size, intermediate_size),
+                jnp.float32, -bound1, bound1,
+            ),
+            name="expert_w1",
+        )
+        self.expert_b1 = Parameter.from_array(
+            jnp.zeros((num_experts, intermediate_size)), name="expert_b1"
+        )
+        self.expert_w2 = Parameter.from_array(
+            jax.random.uniform(
+                split_key(), (num_experts, intermediate_size, hidden_size),
+                jnp.float32, -bound1, bound1,
+            ),
+            name="expert_w2",
+        )
+        self.expert_b2 = Parameter.from_array(
+            jnp.zeros((num_experts, hidden_size)), name="expert_b2"
+        )
+        self._last_aux_loss = None
+
+    @staticmethod
+    def sharding_rules():
+        return ShardingRules([
+            (r"expert_(w|b)\d$", P("ep")),
+        ])
+
+    def forward(self, x):
+        logits = self.router(x)  # [B, L, E]
+        fn = self._dispatch_fn()
+        param_tensors = [self.expert_w1, self.expert_b1,
+                         self.expert_w2, self.expert_b2]
+        mesh = get_mesh()
+        if mesh is not None and int(mesh.shape.get("ep", 1)) > 1:
+            # eager edge: settle expert params onto the ep axis once; they
+            # stay resident across calls
+            from jax.sharding import NamedSharding
+
+            for p in param_tensors:
+                if not isinstance(p._array, jax.core.Tracer):
+                    p._array = jax.device_put(
+                        p._array, NamedSharding(mesh, P("ep"))
+                    )
+
+            def repl(t):
+                if isinstance(t, Tensor) and not isinstance(
+                    t._array, jax.core.Tracer
+                ):
+                    return Tensor._from_array(
+                        jax.device_put(t._array, NamedSharding(mesh, P())),
+                        stop_gradient=t.stop_gradient,
+                    )
+                return t
+
+            x, logits = repl(x), repl(logits)
+        out, aux = autograd.apply_op(
+            "moe_switch_ffn", jax.jit(fn),
+            [x, logits, *param_tensors],
+            {},
+        )
+        self._last_aux_loss = aux
+        return out
+
+    def aux_loss(self):
+        """Load-balancing auxiliary loss of the last forward (Switch
+        Transformer eq. 4); add `model.moe.aux_loss()` to the train loss."""
+        return self._last_aux_loss
+
+    def _dispatch_fn(self):
+        E = self.num_experts
+        cap_f = self.capacity_factor
+        act = getattr(jax.nn, self.activation)
+        training = self.training
+        noise = self.router_noise
+
+        def pure(x, logits, w1, b1, w2, b2):
+            b, l, h = x.shape
+            s = b * l
+            cap = max(1, int(cap_f * s / E))
+            xt = x.reshape(s, h)
+            lg = logits.reshape(s, E).astype(jnp.float32)
+            # NOTE: router jitter (Switch §2.2) is intentionally omitted —
+            # stateful RNG inside this pure fn would bake a constant under
+            # jit; thread it via the train-step rng when needed.
+            probs = jax.nn.softmax(lg, axis=-1)
+            gate = jnp.max(probs, axis=-1)              # [S]
+            expert = jnp.argmax(probs, axis=-1)         # [S]
+            # position of each token within its expert's queue
+            onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # [S, E]
+            pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # [S, E]
+            pos_in_expert = jnp.sum(pos, axis=-1)                  # [S]
+            keep = pos_in_expert < cap
+            gate = gate * keep
+
+            # dispatch tensor [S, E, C]
+            disp = (
+                jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None]
+                * jax.nn.one_hot(
+                    jnp.clip(pos_in_expert, 0, cap - 1), cap, dtype=x.dtype
+                )[:, None, :]
+                * keep[:, None, None]
+            )
+            # expert inputs [E, C, H]
+            ex_in = jnp.einsum("sec,sh->ech", disp, xt)
+            ex_in = with_sharding_constraint(ex_in, P("ep", None, None))
+            hmid = act(
+                jnp.einsum("ech,ehf->ecf", ex_in, w1) + b1[:, None, :]
+            )
+            ex_out = jnp.einsum("ecf,efh->ech", hmid, w2) + b2[:, None, :]
+            ex_out = with_sharding_constraint(ex_out, P("ep", None, None))
+            combine = disp * gate[:, None, None]        # [S, E, C]
+            yt = jnp.einsum("sec,ech->sh", combine, ex_out)
+
+            # load-balance aux loss: E * sum_e f_e * p_e
+            frac_tokens = jnp.mean(
+                jax.nn.one_hot(expert, E, dtype=jnp.float32), axis=0
+            )
+            frac_probs = jnp.mean(probs, axis=0)
+            aux = E * jnp.sum(frac_tokens * frac_probs)
+            return yt.reshape(b, l, h), aux
+
+        return pure
+
+
+MoELayer = SwitchFFN  # alias

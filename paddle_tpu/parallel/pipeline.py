@@ -1,0 +1,245 @@
+"""Pipeline parallelism (GPipe schedule) over the pp mesh axis.
+
+Reference parity: PipelineTrainer/SectionWorker
+(paddle/fluid/framework/pipeline_trainer.cc:24, section_worker.cc:83 —
+per-section ProgramDescs on separate devices, microbatch scopes flowing
+through queues, Forward-all/Backward-all/Optimize GPipe schedule) and
+fluid.optimizer.PipelineOptimizer (python/paddle/fluid/optimizer.py:4431).
+
+TPU-native redesign: sections become one SPMD program. All pp ranks run
+the same stage function on their own slice of a [n_stages, ...]-stacked
+parameter pytree (sharded on pp); activations hop stages via
+lax.ppermute over ICI each tick. The GPipe schedule is the classic
+skewed loop: tick t runs microbatch (t - stage) on each stage. Backward
+falls out of jax.grad through the ppermutes (reverse ring), and the
+optimizer applies elementwise to the stacked params — so pipeline
+composes with dp/tp/sp via GSPMD (`auto` axes) and with the standard
+ShardedTrainStep.
+
+SectionWorker's threads/queues/condition-vars have no equivalent: XLA
+schedules the whole skewed loop.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework import autograd
+from ..framework import jit as fjit
+from ..framework.tensor import Parameter, Tensor
+from ..nn.layer_base import Layer
+from .mesh import AXES, get_mesh
+
+__all__ = ["GPipe"]
+
+
+class GPipe(Layer):
+    """Wrap N identical stage Layers into one pipeline-parallel Layer.
+
+    The stages must share parameter structure (e.g. k transformer blocks
+    each) and map activations shape-preservingly. Parameters are stored
+    stacked on a leading [n_stages] axis; shard it on pp via
+    ``GPipe.sharding_rules()``.
+    """
+
+    def __init__(self, stages, num_microbatches, axis="pp"):
+        super().__init__()
+        assert len(stages) >= 1
+        self._stage0 = stages[0]
+        self.n_stages = len(stages)
+        self.n_micro = num_microbatches
+        self.axis = axis
+        # stack per-stage parameters: name -> [n_stages, *shape]
+        states = [fjit.capture_state(s) for s in stages]
+        names = list(states[0]["params"].keys())
+        for st in states[1:]:
+            assert list(st["params"].keys()) == names, (
+                "pipeline stages must have identical parameter structure"
+            )
+        self._param_names = names
+        for name in names:
+            stacked = jnp.stack([st["params"][name] for st in states])
+            self.add_parameter(
+                _flat(name), Parameter.from_array(stacked, name=_flat(name))
+            )
+        if states[0]["buffers"]:
+            raise NotImplementedError(
+                "pipeline stages with buffers (batchnorm) are unsupported; "
+                "use buffer-free blocks (layernorm)"
+            )
+
+    def sharding_rules(self):
+        """Rules shard the stacked leading axis over pp; within-stage dims
+        can be composed with tp rules by the caller."""
+        from .sharding import ShardingRules
+
+        return ShardingRules(
+            [(r"(^|\.)stacked__", P(self.axis))]
+        )
+
+    def forward(self, x, *extras):
+        """``extras`` are broadcast inputs handed to every stage unchanged
+        (e.g. an attention mask); only ``x`` flows through the pipeline."""
+        mesh = get_mesh()
+        param_tensors = [self._parameters[_flat(n)] for n in self._param_names]
+        if mesh is not None and int(mesh.shape.get(self.axis, 1)) > 1:
+            # eager edge: settle operands onto the mesh once; params stay
+            # resident in the pp-sharded layout across calls
+            from jax.sharding import NamedSharding
+
+            for p in param_tensors:
+                if not isinstance(p._array, jax.core.Tracer):
+                    p._array = jax.device_put(
+                        p._array, NamedSharding(mesh, P(self.axis))
+                    )
+
+            def repl(t):
+                if isinstance(t, Tensor) and not isinstance(
+                    t._array, jax.core.Tracer
+                ):
+                    return Tensor._from_array(
+                        jax.device_put(t._array, NamedSharding(mesh, P())),
+                        stop_gradient=t.stop_gradient,
+                    )
+                return t
+
+            x = repl(x)
+            extras = tuple(repl(e) for e in extras)
+        fn = partial(
+            _gpipe_pure,
+            stage0=self._stage0,
+            names=self._param_names,
+            n_stages=self.n_stages,
+            n_micro=self.n_micro,
+            axis=self.axis,
+            mesh=mesh,
+            n_extras=len(extras),
+        )
+        # jit so the shard_map island always lowers under a trace (also
+        # makes eager-mode vjp run compiled); inlines under an outer jit
+        return autograd.apply_op(
+            "gpipe_forward", jax.jit(fn), [*param_tensors, x, *extras], {}
+        )
+
+
+def _flat(name):
+    return "stacked__" + name.replace(".", "__")
+
+
+def _gpipe_pure(*args, stage0, names, n_stages, n_micro, axis, mesh,
+                n_extras=0):
+    """Pure fn: (stacked params..., x, extras...) -> y over the pp axis."""
+    n_params = len(names)
+    stacked = dict(zip(names, args[:n_params]))
+    x = args[n_params]
+    extras = args[n_params + 1 :]
+
+    def stage_fn(local_params, act, *ex):
+        state = {
+            "params": local_params,
+            "frozen": {},
+            "buffers": {},
+        }
+        out, _ = fjit.functional_call(stage0, state, act, *ex)
+        return out
+
+    if mesh is None or int(mesh.shape.get(axis, 1)) == 1:
+        # no pp axis: run stages sequentially (single-device semantics)
+        y = x
+        for s in range(n_stages):
+            y = stage_fn({n: stacked[n][s] for n in names}, y, *extras)
+        return y
+
+    b = x.shape[0]
+    assert b % n_micro == 0, (b, n_micro)
+    mb = b // n_micro
+    x_mb = x.reshape((n_micro, mb) + x.shape[1:])
+    # per-sample extras (leading dim == batch) are microbatched alongside
+    # x; anything else broadcasts to all microbatches unchanged
+    ex_kinds = tuple(
+        e.ndim >= 1 and e.shape[0] == b for e in extras
+    )
+    extras = tuple(
+        e.reshape((n_micro, mb) + e.shape[1:]) if per_sample else e
+        for e, per_sample in zip(extras, ex_kinds)
+    )
+
+    # keep the stacked params pinned to the pp layout inside the program
+    from jax.sharding import NamedSharding
+
+    stacked = {
+        n: lax.with_sharding_constraint(
+            stacked[n], NamedSharding(mesh, P(axis))
+        )
+        for n in names
+    }
+
+    body = partial(
+        _gpipe_body, stage_fn=stage_fn, names=names,
+        n_stages=n_stages, n_micro=n_micro, axis=axis, ex_kinds=ex_kinds,
+    )
+    in_specs = (
+        {n: P(axis) for n in names},
+        P(),
+        *([P()] * len(extras)),
+    )
+    # partial-manual shard_map: only pp is manual; dp/tp/sp stay under
+    # GSPMD (auto) so the pipeline composes with the other parallelisms
+    sm = jax.shard_map(
+        body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        axis_names={axis}, check_vma=False,
+    )
+    # partial-manual shard_map only lowers under jit; jit inlines when
+    # already inside an outer trace
+    y_mb = jax.jit(sm)(stacked, x_mb, *extras)
+    return y_mb.reshape((b,) + y_mb.shape[2:])
+
+
+def _gpipe_body(stacked, x_mb, *extras, stage_fn, names, n_stages, n_micro,
+                axis, ex_kinds=()):
+    """Runs per-stage under shard_map. stacked leaves: [1, *shape] local."""
+    local = {n: stacked[n][0] for n in names}
+    stage = lax.axis_index(axis)
+    n = n_stages
+
+    act_shape = x_mb.shape[1:]
+    recv = jnp.zeros(act_shape, x_mb.dtype)
+    out = jnp.zeros((n_micro,) + act_shape, x_mb.dtype)
+
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    for t in range(n_micro + n_stages - 1):
+        # stage 0 injects microbatch t (if any); others take the handoff
+        mb_idx = min(t, n_micro - 1)
+        inject = x_mb[mb_idx]
+        cur = jnp.where(stage == 0, inject, recv)
+        run = jnp.logical_and(t - stage >= 0, t - stage < n_micro)
+        # NOTE(GPipe skew): per-sample extras must follow the activation's
+        # microbatch index *per stage* — stage s at tick t works on
+        # microbatch t-s. A replicated extra is fine; a per-sample one is
+        # only exact when every stage sees its own slice, so we select by
+        # the stage-local microbatch index.
+        local_mb = jnp.clip(t - stage, 0, n_micro - 1)
+        cur_extras = tuple(
+            (lax.dynamic_index_in_dim(e, local_mb, keepdims=False)
+             if per_sample else e)
+            for e, per_sample in zip(extras, ex_kinds)
+        )
+        y = stage_fn(local, cur, *cur_extras)
+        # keep activations defined on idle stages (they compute garbage
+        # that is masked out here; XLA's schedule overlaps it with comms)
+        y = jnp.where(run, y, jnp.zeros_like(y))
+        # last stage collects microbatch t-(n-1)
+        oidx = t - (n_stages - 1)
+        if oidx >= 0:
+            collected = jnp.where(stage == n - 1, y, jnp.zeros_like(y))
+            out = out.at[oidx].set(collected)
+        recv = lax.ppermute(y, axis, fwd_perm)
+
+    # outputs live on the last stage only; broadcast via psum
+    return lax.psum(out, axis)

@@ -1,0 +1,156 @@
+"""Ring attention — sequence/context parallelism over the sp mesh axis.
+
+Beyond-reference capability (SURVEY.md §2.3: SP/ring attention absent in
+the reference; §5 names it the north-star extension). Design follows the
+blockwise-parallel/ring attention construction: Q stays put, K/V blocks
+rotate around the sp ring via lax.ppermute, and softmax is computed online
+(flash-attention style running max/denominator), so no device ever holds
+the full [L, L] score matrix or the full K/V sequence.
+
+Comms ride ICI: each of the sp-1 steps moves one K/V block to the ring
+neighbour while the matmuls for the current block run — XLA overlaps the
+ppermute with compute.
+
+Implemented as a shard_map island, so it nests inside a GSPMD-partitioned
+train step (heads sharded on tp, batch on dp, sequence on sp).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import axis_size, get_mesh
+
+__all__ = ["ring_attention"]
+
+
+def _plain_attention(q, k, v, mask, scale, causal):
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        lq, lk = scores.shape[-2], scores.shape[-1]
+        idx_q = jnp.arange(lq)[:, None]
+        idx_k = jnp.arange(lk)[None, :]
+        scores = jnp.where(idx_q >= idx_k, scores, -jnp.inf)
+    if mask is not None:
+        scores = scores + mask
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", w, v)
+
+
+def _ring_body(q, k, v, mask, *, axis, scale, causal):
+    """Per-shard ring attention. q,k,v: [B, H, Lq, D] local blocks."""
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    lq = q.shape[2]
+    lk = k.shape[2]
+
+    acc = jnp.zeros(q.shape, jnp.float32)                    # weighted sum
+    m = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)          # running max
+    denom = jnp.zeros(q.shape[:3], jnp.float32)               # running sum
+
+    def step(i, carry):
+        acc, m, denom, k, v, mask_blk = carry
+        # K/V block currently held came from shard (my + i) mod n
+        src = (my + i) % n
+        # bf16 inputs hit the MXU; accumulation in f32
+        scores = (
+            jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        )
+        if causal:
+            gq = my * lq + jnp.arange(lq)[:, None]
+            gk = src * lk + jnp.arange(lk)[None, :]
+            scores = jnp.where(gq >= gk, scores, -jnp.inf)
+        if mask_blk is not None:
+            scores = scores + mask_blk.astype(jnp.float32)
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked rows: exp(-inf - -inf)
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        corr = jnp.exp(jnp.where(jnp.isneginf(m), -jnp.inf, m) - safe_m)
+        corr = jnp.where(jnp.isneginf(m), 0.0, corr)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isneginf(scores), 0.0, p)
+        denom = denom * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(v.dtype), v,
+            preferred_element_type=jnp.float32,
+        )
+        # rotate K/V (and K-mask) one step around the ring
+        perm = [(j, (j - 1) % n) for j in range(n)]
+        k = lax.ppermute(k, axis, perm)
+        v = lax.ppermute(v, axis, perm)
+        if mask_blk is not None:
+            mask_blk = lax.ppermute(mask_blk, axis, perm)
+        return acc, new_m, denom, k, v, mask_blk
+
+    # python loop (n is static) so ppermute/compute overlap is visible to
+    # the scheduler without a loop-carried dependency on trip count
+    carry = (acc, m, denom, k, v, mask)
+    for i in range(n):
+        carry = step(i, carry)
+    acc, m, denom = carry[0], carry[1], carry[2]
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    return (acc / denom[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mask=None, axis="sp", causal=False, scale=None,
+                   mesh=None):
+    """Attention with K/V ring-rotated over the sp axis.
+
+    q, k, v: [B, H, L, D] arrays (or Tensors) whose L dim is sharded over
+    ``axis`` in the enclosing mesh; mask: additive [B, 1, 1, L] or
+    [B, 1, Lq, Lk] (only the K-dim-sharded [B,1,1,L] form rotates).
+    Falls back to plain attention when no mesh / axis size 1.
+    """
+    from ..framework.tensor import Tensor
+
+    unwrap = lambda t: t._array if isinstance(t, Tensor) else t
+    wrap_out = isinstance(q, Tensor)
+    qa, ka, va = unwrap(q), unwrap(k), unwrap(v)
+    ma = unwrap(mask) if mask is not None else None
+    if scale is None:
+        scale = float(qa.shape[-1]) ** -0.5
+
+    mesh = mesh or get_mesh()
+    n = axis_size(axis, mesh)
+    if mesh is None or n == 1:
+        pure = lambda q, k, v, *m_: _plain_attention(
+            q, k, v, m_[0] if m_ else None, scale, causal
+        )
+    else:
+        # partial-manual: only sp is manual; dp/tp remain GSPMD-auto so
+        # this nests inside tp/dp-partitioned programs
+        specs = P(None, None, axis, None)
+        body = partial(_ring_body, axis=axis, scale=scale, causal=causal)
+        if ma is None:
+            pure = jax.shard_map(
+                lambda q, k, v: body(q, k, v, None),
+                mesh=mesh, in_specs=(specs, specs, specs),
+                out_specs=specs, axis_names={axis}, check_vma=False,
+            )
+        else:
+            mask_spec = P(None, None, None, axis)
+            pure = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(specs, specs, specs, mask_spec),
+                out_specs=specs, axis_names={axis}, check_vma=False,
+            )
+        # partial-manual shard_map only lowers under jit; jit here inlines
+        # when already inside an outer trace
+        pure = jax.jit(pure)
+    if wrap_out:
+        # route through the tape (original Tensor objects) so eager
+        # backward accumulates into the caller's tensors
+        from ..framework.autograd import apply_op
+
+        tensors = [q, k, v] + ([mask] if ma is not None else [])
+        tensors = [t if isinstance(t, Tensor) else Tensor._from_array(jnp.asarray(t))
+                   for t in tensors]
+        return apply_op("ring_attention", pure, tensors, {})
+    args = (qa, ka, va) if ma is None else (qa, ka, va, ma)
+    return pure(*args)

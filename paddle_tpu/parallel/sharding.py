@@ -1,0 +1,167 @@
+"""Parameter/activation sharding rules.
+
+Reference parity: the role of multi_devices_graph_pass.cc (deciding, per
+variable, where it lives and which collective moves it) — reimagined as
+GSPMD sharding annotations: a rule table maps parameter names (regex) to
+PartitionSpecs; XLA's partitioner then inserts the collectives the
+reference inserted by graph rewriting.
+"""
+from __future__ import annotations
+
+import re
+from collections import OrderedDict
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import get_mesh
+
+__all__ = [
+    "ShardingRules",
+    "named_sharding",
+    "shard_state",
+    "shard_batch",
+    "with_sharding_constraint",
+    "DEFAULT_RULES",
+]
+
+
+class ShardingRules:
+    """Ordered (regex -> PartitionSpec) table; first match wins.
+
+    Example (megatron TP over axis "tp"):
+        rules = ShardingRules([
+            (r".*\\.qkv_proj\\.weight$", P(None, "tp")),   # column parallel
+            (r".*\\.out_proj\\.weight$", P("tp", None)),   # row parallel
+            (r".*\\.embedding\\.weight$", P("tp", None)),  # vocab parallel
+        ])
+    Unmatched parameters are replicated (P()).
+    """
+
+    def __init__(self, rules=None, default=P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+        self.default = default
+
+    def add(self, pattern, spec):
+        self.rules.append((re.compile(pattern), spec))
+        return self
+
+    def spec_for(self, name: str) -> P:
+        for pat, spec in self.rules:
+            if pat.search(name):
+                return spec
+        return self.default
+
+    def __add__(self, other: "ShardingRules") -> "ShardingRules":
+        out = ShardingRules(default=other.default)
+        out.rules = list(self.rules) + list(other.rules)
+        return out
+
+
+DEFAULT_RULES = ShardingRules()  # replicate everything (pure DP)
+
+
+def _clamp_spec(spec: P, ndim: int) -> P:
+    """Trim a PartitionSpec to the array rank (rules may be written for the
+    2D weight but match a 1D bias)."""
+    parts = tuple(spec)
+    if len(parts) > ndim:
+        parts = parts[:ndim]
+    return P(*parts)
+
+
+def named_sharding(spec: P, mesh: Mesh | None = None) -> NamedSharding:
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise RuntimeError("no active mesh; use parallel.mesh_scope(...)")
+    return NamedSharding(mesh, spec)
+
+
+def shard_state(state, rules: ShardingRules | None = None, mesh: Mesh | None = None):
+    """Produce the sharding pytree for a train-step state dict.
+
+    params/frozen follow the rule table; buffers and optimizer accumulators
+    inherit the sharding of their parameter (accumulator lists are aligned
+    with the optimizer's parameter list order = model.parameters() order).
+    Returns a pytree of NamedShardings shaped like ``state``.
+    """
+    mesh = mesh or get_mesh()
+    rules = rules or DEFAULT_RULES
+
+    def param_shardings(group):
+        return OrderedDict(
+            (
+                name,
+                NamedSharding(
+                    mesh, _clamp_spec(rules.spec_for(name), arr.ndim)
+                ),
+            )
+            for name, arr in group.items()
+        )
+
+    out = {
+        "params": param_shardings(state["params"]),
+        "frozen": param_shardings(state["frozen"]),
+        "buffers": OrderedDict(
+            (name, NamedSharding(mesh, P())) for name in state["buffers"]
+        ),
+    }
+    if "opt" in state:
+        # accumulators: per-param lists in params order; scalar-shaped
+        # accumulators (e.g. beta powers) replicate.
+        pshard = list(out["params"].values())
+        pshapes = [a.shape for a in state["params"].values()]
+        accums = {}
+        for name, accs in state["opt"]["accums"].items():
+            shards = []
+            for arr, ps, pshape in zip(accs, pshard, pshapes):
+                if tuple(arr.shape) == tuple(pshape):
+                    spec = _clamp_spec(ps.spec, arr.ndim)
+                else:  # shape-divergent accumulator (beta powers etc.)
+                    spec = P()
+                shards.append(NamedSharding(mesh, spec))
+            accums[name] = shards
+        out["opt"] = {
+            "accums": accums,
+            "step": NamedSharding(mesh, P()),
+        }
+    return out
+
+
+def shard_batch(batch, mesh: Mesh | None = None, axes=("dp",)):
+    """NamedSharding for input batches: leading dim split over dp (and sp
+    for sequence dim if requested as ("dp", "sp"))."""
+    mesh = mesh or get_mesh()
+
+    def one(arr):
+        spec = [None] * arr.ndim
+        if arr.ndim >= 1:
+            spec[0] = axes[0]
+        if len(axes) > 1 and arr.ndim >= 2:
+            spec[1] = axes[1]
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_map(one, batch)
+
+
+def with_sharding_constraint(x, spec: P):
+    """Annotate an activation's sharding. No-op without an active mesh.
+
+    Traced values get a GSPMD constraint; concrete (eager) arrays are
+    device_put onto the mesh instead — with_sharding_constraint is
+    jit-only in JAX."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    from ..framework.tensor import Tensor
+
+    def one(arr):
+        if isinstance(arr, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(
+                arr, NamedSharding(mesh, spec)
+            )
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    if isinstance(x, Tensor):
+        return Tensor._from_array(one(x._array), stop_gradient=x.stop_gradient)
+    return one(x)

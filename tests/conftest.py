@@ -14,6 +14,14 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "true")
 
+import jax  # noqa: E402
+
+# The axon TPU bootstrap (sitecustomize) may have fully imported jax at
+# interpreter startup (when it wins the chip claim), in which case the env
+# vars above were read too early; force the config programmatically.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -1,0 +1,117 @@
+"""AMP tests.
+
+Reference parity: tests/unittests/test_amp_check_finite_and_scale_op.py,
+test_imperative_auto_mixed_precision.py patterns.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp
+from paddle_tpu.framework import jit as fjit
+
+
+def test_auto_cast_white_list_casts_matmul():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    w = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    with amp.auto_cast():
+        y = paddle.matmul(x, w)
+    assert y.dtype == jnp.bfloat16
+    # outside the scope: fp32 again
+    y2 = paddle.matmul(x, w)
+    assert y2.dtype == jnp.float32
+
+
+def test_auto_cast_black_list_stays_fp32():
+    x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+    with amp.auto_cast():
+        s = F.softmax(x.astype("bfloat16"))
+    assert s.dtype == jnp.float32
+
+
+def test_auto_cast_custom_lists():
+    x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+    with amp.auto_cast(custom_white_list=["relu"]):
+        y = F.relu(x)
+    assert y.dtype == jnp.bfloat16
+
+
+def test_grad_scaler_dynamic_scaling():
+    m = nn.Linear(4, 4)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = amp.GradScaler(
+        init_loss_scaling=8.0, incr_every_n_steps=2,
+        decr_every_n_nan_or_inf=1,
+    )
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+
+    w_before = m.weight.numpy().copy()
+    loss = m(x).mean()
+    scaled = scaler.scale(loss)
+    assert abs(float(scaled.numpy()) - 8.0 * float(loss.numpy())) < 1e-5
+    scaled.backward()
+    scaler.step(o)
+    o.clear_grad()
+    assert not np.allclose(m.weight.numpy(), w_before)  # update applied
+    assert scaler.get_loss_scaling() == 8.0  # not yet incremented
+
+    # second good step triggers increase (incr_every_n_steps=2)
+    loss = m(x).mean()
+    scaler.scale(loss).backward()
+    scaler.step(o)
+    o.clear_grad()
+    assert scaler.get_loss_scaling() == 16.0
+
+
+def test_grad_scaler_skips_on_inf():
+    m = nn.Linear(4, 4)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=16.0, decr_every_n_nan_or_inf=1)
+    x = paddle.to_tensor(np.full((2, 4), 1e38, "float32"))
+    w_before = m.weight.numpy().copy()
+    loss = (m(x) * 1e38).mean()  # overflows
+    scaler.scale(loss).backward()
+    scaler.step(o)
+    o.clear_grad()
+    np.testing.assert_array_equal(m.weight.numpy(), w_before)  # skipped
+    assert scaler.get_loss_scaling() == 8.0  # decreased
+
+
+def test_amp_inside_compiled_train_step():
+    class M(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(8, 32)
+            self.fc2 = nn.Linear(32, 4)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    paddle.seed(0)
+    m = M()
+    o = opt.Adam(learning_rate=1e-2, parameters=m.parameters())
+
+    def loss_fn(model, x, y):
+        with amp.auto_cast():
+            out = model(x)
+        return F.cross_entropy(out.astype("float32"), y).mean()
+
+    step = fjit.train_step(m, o, loss_fn)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype("float32")
+    y = rng.randint(0, 4, (16,)).astype("int64")
+    losses = [float(step(x, y)["loss"]) for _ in range(10)]
+    assert losses[-1] < losses[0]
+    # master weights stayed fp32
+    assert step.state["params"]["fc1.weight"].dtype == jnp.float32
+
+
+def test_decorate_o2_casts_params():
+    m = nn.Linear(4, 4)
+    amp.decorate(models=m, level="O2", dtype="bfloat16")
+    assert m.weight._array.dtype == jnp.bfloat16

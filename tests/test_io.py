@@ -1,0 +1,122 @@
+"""Data pipeline tests (fluid/tests/unittests/test_dataloader_* patterns)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.io import (
+    BatchSampler,
+    ChainDataset,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    SequenceSampler,
+    TensorDataset,
+    random_split,
+)
+
+
+class SquaresDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class Stream(IterableDataset):
+    def __init__(self, n=10):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+def test_tensor_dataset_and_batch():
+    x = np.arange(12, dtype=np.float32).reshape(6, 2)
+    y = np.arange(6, dtype=np.int64)
+    ds = TensorDataset([x, y])
+    assert len(ds) == 6
+    loader = DataLoader(ds, batch_size=4, use_buffer_reader=False)
+    batches = list(loader)
+    assert len(batches) == 2
+    bx, by = batches[0]
+    assert bx.shape == (4, 2) and by.shape == (4,)
+    np.testing.assert_array_equal(by, [0, 1, 2, 3])
+
+
+def test_shuffle_and_drop_last():
+    ds = SquaresDataset(10)
+    loader = DataLoader(ds, batch_size=4, shuffle=True, drop_last=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    seen = sorted(int(v) for b in batches for v in b[0])
+    assert len(seen) == 8  # dropped last partial batch
+
+
+def test_iterable_dataset():
+    loader = DataLoader(Stream(10), batch_size=3)
+    sizes = [b.shape[0] for b in loader]
+    assert sizes == [3, 3, 3, 1]
+
+
+def test_multiprocess_workers_match_single():
+    ds = SquaresDataset(20)
+    single = [b for b in DataLoader(ds, batch_size=5, use_buffer_reader=False)]
+    multi = [b for b in DataLoader(ds, batch_size=5, num_workers=2,
+                                   use_buffer_reader=False)]
+    assert len(single) == len(multi)
+    for (sx, sy), (mx, my) in zip(single, multi):
+        np.testing.assert_array_equal(sx, mx)
+        np.testing.assert_array_equal(sy, my)
+
+
+def test_worker_exception_propagates():
+    class Bad(Dataset):
+        def __getitem__(self, i):
+            raise ValueError("boom")
+
+        def __len__(self):
+            return 4
+
+    loader = DataLoader(Bad(), batch_size=2, num_workers=1,
+                        use_buffer_reader=False)
+    with pytest.raises(ValueError):
+        list(loader)
+
+
+def test_device_prefetch_returns_jax_arrays():
+    import jax
+
+    ds = SquaresDataset(8)
+    loader = DataLoader(ds, batch_size=4, use_buffer_reader=True)
+    bx, by = next(iter(loader))
+    assert isinstance(bx, jax.Array)
+
+
+def test_distributed_batch_sampler_shards():
+    ds = SquaresDataset(16)
+    s0 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=1)
+    i0 = [i for b in s0 for i in b]
+    i1 = [i for b in s1 for i in b]
+    assert len(i0) == len(i1) == 4
+    assert not set(i0) & set(i1)
+
+
+def test_random_split():
+    a, b = random_split(SquaresDataset(10), [7, 3])
+    assert len(a) == 7 and len(b) == 3
+
+
+def test_samplers():
+    ds = SquaresDataset(5)
+    assert list(SequenceSampler(ds)) == [0, 1, 2, 3, 4]
+    assert sorted(RandomSampler(ds)) == [0, 1, 2, 3, 4]
+    bs = BatchSampler(ds, batch_size=2)
+    assert list(bs) == [[0, 1], [2, 3], [4]]
+    assert len(bs) == 3

