@@ -195,4 +195,9 @@ from . import parallel  # noqa: E402
 from . import distributed  # noqa: E402
 from . import models  # noqa: E402
 from . import static  # noqa: E402
-from .framework import jit  # noqa: E402
+from . import metric  # noqa: E402
+from . import inference  # noqa: E402
+from . import jit_api as jit  # noqa: E402  (paddle.jit.to_static/save/load)
+from .hapi import Model  # noqa: E402
+from . import vision  # noqa: E402
+from . import profiler  # noqa: E402

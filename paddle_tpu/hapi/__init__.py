@@ -1,0 +1,8 @@
+"""High-level training API (paddle.Model).
+
+Reference parity: python/paddle/incubate/hapi/ — model.py (Model :637,
+fit :1110, evaluate :1309, predict :1406, DynamicGraphAdapter :443),
+callbacks.py, progressbar.
+"""
+from .model import Model  # noqa: F401
+from .callbacks import Callback, EarlyStopping, ModelCheckpoint, ProgBarLogger  # noqa: F401

@@ -1,0 +1,263 @@
+"""paddle.Model — Keras-like train/eval/predict facade.
+
+Reference parity: incubate/hapi/model.py (Model :637, fit :1110,
+evaluate :1309, predict :1406). The DynamicGraphAdapter's per-batch
+train_batch is replaced by a compiled train step
+(framework/jit.py), optionally sharded over a mesh when one is active —
+so Model.fit is TPU-efficient out of the box.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework import jit as fjit
+from ..framework.tensor import Tensor
+from ..io import DataLoader
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._eval_fn = None
+
+    # -- configuration ------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics is not None else []
+        )
+        self._amp = amp_configs
+        self._train_step = None
+        return self
+
+    # -- core steps ---------------------------------------------------------
+    def _build_train_step(self):
+        loss_obj = self._loss
+
+        use_amp = bool(self._amp)
+
+        def loss_fn(network, *batch):
+            # convention: last element is the label
+            *xs, y = batch
+            if use_amp:
+                from .. import amp as amp_mod
+
+                with amp_mod.auto_cast():
+                    out = network(*xs)
+                out = out.astype("float32")
+            else:
+                out = network(*xs)
+            loss = loss_obj(out, y)
+            if isinstance(loss, (list, tuple)):
+                loss = loss[0]
+            return loss.mean() if loss.ndim > 0 else loss
+
+        from ..parallel.mesh import get_mesh
+
+        mesh = get_mesh()
+        if mesh is not None:
+            from ..parallel import sharded_train_step
+
+            return sharded_train_step(
+                self.network, self._optimizer, loss_fn, mesh
+            )
+        return fjit.train_step(self.network, self._optimizer, loss_fn)
+
+    def train_batch(self, inputs, labels=None):
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if labels is not None else []
+        labels = labels if isinstance(labels, (list, tuple)) else [labels]
+        metrics = self._train_step(*inputs, *labels)
+        return {"loss": float(np.asarray(metrics["loss"]))}
+
+    def eval_batch(self, inputs, labels=None):
+        self._sync_from_step()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        try:
+            t_in = [_to_tensor(x) for x in inputs]
+            out = self.network(*t_in)
+        finally:
+            self.network.train()
+        logs = {}
+        if labels is not None and self._loss is not None:
+            y = _to_tensor(labels if not isinstance(labels, (list, tuple)) else labels[0])
+            loss = self._loss(out, y)
+            if isinstance(loss, (list, tuple)):
+                loss = loss[0]
+            logs["loss"] = float(np.asarray(loss.mean().numpy()))
+        for m in self._metrics:
+            y = labels if not isinstance(labels, (list, tuple)) else labels[0]
+            res = m.compute(out, _to_tensor(y))
+            m.update(res)
+        return logs, out
+
+    def predict_batch(self, inputs):
+        self._sync_from_step()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        try:
+            out = self.network(*[_to_tensor(x) for x in inputs])
+        finally:
+            self.network.train()
+        return out
+
+    def _sync_from_step(self):
+        if self._train_step is not None:
+            self._train_step.sync()
+
+    # -- high-level loops ---------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=1,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None):
+        loader = _as_loader(train_data, batch_size, shuffle, drop_last,
+                            num_workers)
+        eval_loader = (
+            _as_loader(eval_data, batch_size, False, False, num_workers)
+            if eval_data is not None else None
+        )
+        cbks = CallbackList(
+            (callbacks or []) + ([ProgBarLogger(log_freq, verbose)] if verbose else [])
+        )
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "verbose": verbose})
+        self.stop_training = False
+
+        cbks.on_train_begin()
+        logs = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                xs, ys = _split_batch(batch)
+                logs = self.train_batch(xs, ys)
+                cbks.on_train_batch_end(step, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_loader, batch_size=batch_size, verbose=0,
+                    _prepared=True,
+                )
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+                cbks.on_eval_end(eval_logs)
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return logs
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=1,
+                 num_workers=0, callbacks=None, _prepared=False):
+        loader = (
+            eval_data if _prepared
+            else _as_loader(eval_data, batch_size, False, False, num_workers)
+        )
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            xs, ys = _split_batch(batch)
+            logs, _ = self.eval_batch(xs, ys)
+            if "loss" in logs:
+                losses.append(logs["loss"])
+        out = {}
+        if losses:
+            out["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            name = m.name()
+            val = m.accumulate()
+            if isinstance(name, list):
+                out.update(dict(zip(name, val)))
+            else:
+                out[name] = val
+        return out
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outs = []
+        for batch in loader:
+            # labeled datasets (x..., y): the trailing label is dropped,
+            # matching hapi predict over a train dataset
+            xs, _ = _split_batch(batch)
+            out = self.predict_batch(xs)
+            outs.append(
+                out.numpy() if isinstance(out, Tensor) else out
+            )
+        if stack_outputs:
+            return np.concatenate(outs, axis=0)
+        return outs
+
+    # -- persistence / introspection ----------------------------------------
+    def save(self, path, training=True):
+        from ..framework import serialization
+
+        self._sync_from_step()
+        serialization.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            serialization.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import serialization
+
+        state = serialization.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        self._train_step = None
+        if not reset_optimizer and self._optimizer is not None:
+            try:
+                opt_state = serialization.load(path + ".pdopt")
+                self._optimizer.set_state_dict(opt_state)
+            except FileNotFoundError:
+                pass
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        trainable = sum(
+            int(np.prod(p.shape))
+            for p in self.network.parameters()
+            if getattr(p, "trainable", True)
+        )
+        s = {
+            "total_params": total,
+            "trainable_params": trainable,
+        }
+        print(f"Total params: {total:,} (trainable {trainable:,})")
+        return s
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+def _split_batch(batch, labeled=True):
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2 and labeled:
+        return list(batch[:-1]), batch[-1]
+    if isinstance(batch, (list, tuple)):
+        return list(batch), None
+    return [batch], None
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if isinstance(data, DataLoader):
+        return data
+    return DataLoader(
+        data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
+        num_workers=num_workers,
+    )

@@ -1,0 +1,18 @@
+"""Inference API.
+
+Reference parity: paddle/fluid/inference/api/ — AnalysisConfig
+(paddle_analysis_config.h), AnalysisPredictor (analysis_predictor.h:82),
+create_paddle_predictor, PaddleTensor handles. The pass-pipeline
+optimization role (ir_pass_manager.cc fusions, memory_optimize_pass) is
+played by XLA: the pruned inference program compiles to one fused HLO
+module on first run and is cached per input signature (NaiveExecutor's
+no-churn hot loop ≙ replaying the compiled executable).
+"""
+from .predictor import (  # noqa: F401
+    Config,
+    Predictor,
+    Tensor as PredictorTensor,
+    create_predictor,
+)
+
+AnalysisConfig = Config

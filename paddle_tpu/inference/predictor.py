@@ -1,0 +1,122 @@
+"""Predictor implementation (analysis_predictor.cc equivalent)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+
+
+class Config:
+    """AnalysisConfig surface (paddle_analysis_config.h)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self._model_dir = model_dir
+        self._prog_file = prog_file
+        self._params_file = params_file
+        self._use_tpu = True
+        self._memory_optim = True
+        self._ir_optim = True
+
+    def set_model(self, model_dir, params_file=None):
+        self._model_dir = model_dir
+        self._params_file = params_file
+
+    def model_dir(self):
+        return self._model_dir
+
+    def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
+        pass  # accepted for compat; device selection is XLA's
+
+    def enable_tpu(self):
+        self._use_tpu = True
+
+    def disable_gpu(self):
+        self._use_tpu = False
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+
+class Tensor:
+    """Input/output handle (PaddleTensor / ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._data = None
+
+    def copy_from_cpu(self, arr):
+        self._data = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._data)
+
+    def reshape(self, shape):
+        if self._data is not None:
+            self._data = self._data.reshape(shape)
+
+    def shape(self):
+        return list(self._data.shape) if self._data is not None else None
+
+
+class Predictor:
+    """AnalysisPredictor equivalent over the jitted static executor."""
+
+    def __init__(self, config: Config):
+        from ..static import Executor, io as static_io
+
+        self.config = config
+        self._exe = Executor()
+        self._program, self._feed_names, self._fetch_names = (
+            static_io.load_inference_model(
+                config.model_dir(), self._exe,
+                model_filename=config._prog_file,
+                params_filename=config._params_file,
+            )
+        )
+        self._inputs = {n: Tensor(n) for n in self._feed_names}
+        self._outputs = {n: Tensor(n) for n in self._fetch_names}
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return list(self._fetch_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    get_input_tensor = get_input_handle
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    get_output_tensor = get_output_handle
+
+    def run(self, inputs=None):
+        """Zero-copy style: stage inputs via handles then run(); or pass a
+        list of numpy arrays matching get_input_names() order."""
+        if inputs is not None:
+            for n, arr in zip(self._feed_names, inputs):
+                self._inputs[n].copy_from_cpu(arr)
+        feed = {n: self._inputs[n]._data for n in self._feed_names}
+        for n, v in feed.items():
+            if v is None:
+                raise RuntimeError(f"input {n!r} not set")
+        outs = self._exe.run(
+            self._program, feed=feed, fetch_list=self._fetch_names
+        )
+        for n, o in zip(self._fetch_names, outs):
+            self._outputs[n]._data = o
+        return outs
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

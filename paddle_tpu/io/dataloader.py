@@ -16,6 +16,7 @@ from __future__ import annotations
 import atexit
 import itertools
 import multiprocessing as mp
+import os
 import queue as queue_mod
 import threading
 
@@ -52,17 +53,23 @@ def default_collate_fn(batch):
     return np.asarray(batch)
 
 
-def _worker_loop(dataset, index_queue, data_queue, collate_fn, use_shm):
-    """Worker process body (reader.py:870 _reader_process_loop)."""
-    try:
-        shm = None
-        if use_shm:
-            try:
-                from .._native import shm_ring
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, ring_name,
+                 ring_capacity):
+    """Worker process body (reader.py:870 _reader_process_loop).
 
-                shm = shm_ring
-            except Exception:
-                shm = None
+    Results travel over the native shared-memory ring when available
+    (mmap_allocator.cc transport equivalent); the mp.Queue is the fallback
+    and the error channel.
+    """
+    ring = None
+    if ring_name:
+        try:
+            from .._native import ShmRing
+
+            ring = ShmRing(ring_name, capacity=ring_capacity, owner=False)
+        except Exception:
+            ring = None
+    try:
         while True:
             task = index_queue.get()
             if task is None:
@@ -70,11 +77,21 @@ def _worker_loop(dataset, index_queue, data_queue, collate_fn, use_shm):
             seq, indices = task
             try:
                 batch = collate_fn([dataset[i] for i in indices])
+                if ring is not None:
+                    try:
+                        ring.put((seq, batch))
+                        data_queue.put((seq, ring_name, None))  # ready signal
+                        continue
+                    except ValueError:  # batch larger than the ring
+                        pass
                 data_queue.put((seq, batch, None))
             except Exception as e:  # propagate to main process
                 data_queue.put((seq, None, e))
     except KeyboardInterrupt:
         pass
+    finally:
+        if ring is not None:
+            ring.close(unlink=False)
 
 
 class _MultiprocessIter:
@@ -85,14 +102,31 @@ class _MultiprocessIter:
         ctx = mp.get_context("fork")
         self.index_queue = ctx.Queue()
         self.data_queue = ctx.Queue(maxsize=loader.num_workers * loader.prefetch_factor)
+        # one shared-memory ring per worker (SPSC); None disables
+        self.rings = {}
+        ring_names = [None] * loader.num_workers
+        ring_cap = 64 << 20
+        if loader.use_shared_memory:
+            try:
+                from .._native import ShmRing, available
+
+                if available():
+                    for i in range(loader.num_workers):
+                        name = f"/ptpu_dl_{os.getpid()}_{id(self) & 0xFFFF}_{i}"
+                        self.rings[name] = ShmRing(
+                            name, capacity=ring_cap, owner=True
+                        )
+                    ring_names = list(self.rings.keys())
+            except Exception:
+                self.rings = {}
         self.workers = [
             ctx.Process(
                 target=_worker_loop,
                 args=(ds, self.index_queue, self.data_queue,
-                      loader.collate_fn, loader.use_shared_memory),
+                      loader.collate_fn, ring_names[i], ring_cap),
                 daemon=True,
             )
-            for _ in range(loader.num_workers)
+            for i in range(loader.num_workers)
         ]
         for w in self.workers:
             w.start()
@@ -121,6 +155,10 @@ class _MultiprocessIter:
             if err is not None:
                 self.shutdown()
                 raise err
+            if isinstance(batch, str) and batch in self.rings:
+                # ready-signal: the payload sits in that worker's shm ring
+                rseq, batch = self.rings[batch].get()
+                seq = rseq
             self._reorder[seq] = batch
         batch = self._reorder.pop(self._recv)
         self._recv += 1
@@ -138,6 +176,12 @@ class _MultiprocessIter:
             if w.is_alive():
                 w.terminate()
         self.workers = []
+        for ring in getattr(self, "rings", {}).values():
+            try:
+                ring.close(unlink=True)
+            except Exception:
+                pass
+        self.rings = {}
 
 
 class _DevicePrefetcher:

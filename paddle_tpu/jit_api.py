@@ -1,0 +1,182 @@
+"""paddle.jit — dygraph-to-static + save/load.
+
+Reference parity: python/paddle/fluid/dygraph/jit.py (@declarative /
+to_static, jit.save, jit.load) and dygraph_to_static/ (22 files of AST
+rewriting).
+
+TPU-native collapse: the reference rewrites Python AST into a ProgramDesc
+because its eager mode can't be captured; our eager API is mode-aware
+(paddle_tpu.ops._run) and traceable, so
+- to_static == compile the eager callable with the functionalization layer
+  (no AST surgery; python control flow is handled by JAX tracing rules),
+- save == run the callable once in static mode over symbolic Variables,
+  which *is* the program capture, then save_inference_model,
+- load == load_inference_model wrapped back into a callable layer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .framework import jit as fjit
+from .framework.tensor import Tensor
+from .nn.layer_base import Layer
+
+__all__ = ["to_static", "save", "load", "InputSpec", "TranslatedLayer"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec (fluid/dygraph/static_runner InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    @classmethod
+    def from_tensor(cls, t, name=None):
+        return cls(list(t.shape), str(t.dtype), name)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+class StaticFunction:
+    """@to_static wrapper: jit-compiles the eager callable per signature."""
+
+    def __init__(self, function, input_spec=None):
+        self._function = function
+        self._input_spec = input_spec
+        self._compiled = {}
+
+    def __call__(self, *args, **kwargs):
+        import jax
+
+        layer = getattr(self._function, "__self__", None)
+        if isinstance(layer, Layer):
+            model, fwd = layer, type(layer).forward
+        else:
+            model, fwd = None, self._function
+
+        arrays = tuple(
+            a._array if isinstance(a, Tensor) else a for a in args
+        )
+        if model is None:
+            key = "fn"
+            if key not in self._compiled:
+                def pure(*arrs):
+                    wrapped = [
+                        Tensor._from_array(a) if hasattr(a, "dtype") else a
+                        for a in arrs
+                    ]
+                    out = fwd(*wrapped, **kwargs)
+                    import jax as _jax
+
+                    return _jax.tree_util.tree_map(
+                        lambda x: x._array if isinstance(x, Tensor) else x,
+                        out,
+                        is_leaf=lambda x: isinstance(x, Tensor),
+                    )
+
+                self._compiled[key] = jax.jit(pure)
+            out = self._compiled[key](*arrays)
+        else:
+            if "model" not in self._compiled:
+                orig_forward = self._function
+                # bypass Layer.__call__ → our own wrapper recursion: call
+                # the captured original bound forward
+                self._compiled["model"] = fjit.eval_step(
+                    model, fn=lambda m, *a: orig_forward(*a)
+                )
+            out = self._compiled["model"](*arrays)
+        return jax.tree_util.tree_map(Tensor._from_array, out)
+
+
+def to_static(function=None, input_spec=None, **kwargs):
+    """@paddle.jit.to_static decorator."""
+    def deco(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn.forward, input_spec)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+def save(layer, path, input_spec=None):
+    """paddle.jit.save: capture the layer as a static inference program.
+
+    Runs the forward once in static mode over symbolic Variables — the
+    mode-aware op API appends the program — then saves model+params in the
+    inference-model layout loadable by paddle.jit.load AND the inference
+    Predictor (analysis_predictor parity).
+    """
+    from . import static
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec")
+    was_training = getattr(layer, "training", False)
+    if isinstance(layer, Layer):
+        layer.eval()
+    # a to_static-wrapped layer: capture through the original forward
+    call = layer
+    if isinstance(layer, Layer) and isinstance(
+        getattr(layer, "forward", None), StaticFunction
+    ):
+        call = layer.forward._function
+    prog = static.Program()
+    startup = static.Program()
+    feed_names = []
+    try:
+        with static.program_guard(prog, startup):
+            static.enable_static()
+            feeds = []
+            for i, spec in enumerate(input_spec):
+                name = spec.name or f"x{i}"
+                feed_names.append(name)
+                shape = [d if d is not None else -1 for d in spec.shape]
+                feeds.append(static.data(name, shape, spec.dtype))
+            outs = call(*feeds)
+            if not isinstance(outs, (list, tuple)):
+                outs = [outs]
+    finally:
+        static.disable_static()
+        if isinstance(layer, Layer) and was_training:
+            layer.train()
+
+    exe = static.Executor()
+    import os
+
+    dirname = path if os.path.isdir(path) or not os.path.splitext(path)[1] else os.path.dirname(path)
+    static.save_inference_model(
+        dirname or path, feed_names, list(outs), exe, main_program=prog
+    )
+    return dirname or path
+
+
+class TranslatedLayer(Layer):
+    """jit.load result: a Layer running a saved inference program."""
+
+    def __init__(self, dirname):
+        super().__init__()
+        from . import static
+
+        self._exe = static.Executor()
+        self._program, self._feed_names, self._fetch_names = (
+            static.load_inference_model(dirname, self._exe)
+        )
+
+    def forward(self, *args):
+        feed = {}
+        for name, a in zip(self._feed_names, args):
+            feed[name] = a.numpy() if isinstance(a, Tensor) else np.asarray(a)
+        outs = self._exe.run(
+            self._program, feed=feed, fetch_list=self._fetch_names,
+            return_numpy=False,
+        )
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def load(path):
+    return TranslatedLayer(path)

@@ -33,17 +33,21 @@ class MultiHeadAttention(Layer):
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None,
-                 use_ring_attention=False):
+                 use_ring_attention=False, use_flash_attention=False):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
         self.need_weights = need_weights
-        # TPU extension: sequence-parallel ring attention over the sp mesh
-        # axis (parallel/ring_attention.py). Requires dropout == 0.
+        # TPU extensions: sequence-parallel ring attention over the sp mesh
+        # axis (parallel/ring_attention.py) and the fused pallas flash
+        # kernel (ops/pallas/flash_attention.py). Both require dropout == 0.
         self.use_ring_attention = use_ring_attention
-        if use_ring_attention and dropout:
-            raise ValueError("ring attention does not support attn dropout")
+        self.use_flash_attention = use_flash_attention
+        if (use_ring_attention or use_flash_attention) and dropout:
+            raise ValueError(
+                "ring/flash attention does not support attn dropout"
+            )
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim
         kdim = kdim or embed_dim
@@ -81,6 +85,12 @@ class MultiHeadAttention(Layer):
 
             mask = _convert_attention_mask(attn_mask, q.dtype)
             out = ring_attention(q, k, v, mask=mask, scale=scale)
+        elif (self.use_flash_attention and not self.need_weights
+                and cache is None):
+            from ..ops.pallas import flash_attention
+
+            mask = _convert_attention_mask(attn_mask, q.dtype)
+            out = flash_attention(q, k, v, bias=mask, scale=scale)
         else:
             scores = ops.matmul(q, k, transpose_y=True) * scale
             mask = _convert_attention_mask(attn_mask, q.dtype)

@@ -8,6 +8,8 @@ PRNG key for stochastic ops, and dispatches through the autograd tracer
 """
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -32,7 +34,7 @@ def _run(name, *tensors, **attrs):
     from ..static.program import Variable, in_static_mode
 
     if in_static_mode() and (
-        any(isinstance(t, Variable) for t in tensors) or not tensors
+        builtins.any(isinstance(t, Variable) for t in tensors) or not tensors
     ):
         from ..static.op_append import append_static_op
 

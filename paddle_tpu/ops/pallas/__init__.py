@@ -1,0 +1,8 @@
+"""Pallas TPU kernels for hot ops.
+
+Reference parity: the role of hand-written CUDA kernels in
+paddle/fluid/operators/fused/ (multihead_matmul_op.cu — BERT fused
+attention) and operators/jit/ (runtime-codegen CPU kernels) — here as
+Pallas kernels compiled through Mosaic for the TPU's MXU/VMEM.
+"""
+from .flash_attention import flash_attention  # noqa: F401

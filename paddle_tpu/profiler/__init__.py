@@ -1,0 +1,138 @@
+"""Profiler.
+
+Reference parity: paddle/fluid/platform/profiler.h (RAII RecordEvent :126,
+EnableProfiler/DisableProfiler :208, chrome-trace export via
+device_tracer.cc + profiler.proto) and python/paddle/fluid/profiler.py
+context managers.
+
+TPU-native: host-side RAII events feed a chrome-trace JSON directly;
+device timelines come from jax.profiler (XPlane/perfetto) started and
+stopped by the same switch — start_profiler/stop_profiler wrap both so
+one API yields the merged picture the reference's CUPTI tracer gave.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+
+__all__ = [
+    "RecordEvent",
+    "record_event",
+    "start_profiler",
+    "stop_profiler",
+    "profiler",
+    "reset_profiler",
+    "export_chrome_tracing",
+]
+
+_state = threading.local()
+_events = []
+_events_lock = threading.Lock()
+_enabled = [False]
+_device_trace_dir = [None]
+
+
+def _now_us():
+    return time.perf_counter_ns() / 1e3
+
+
+class RecordEvent:
+    """RAII named range (platform/profiler.h:126). Usable as context
+    manager or begin()/end() pair."""
+
+    def __init__(self, name):
+        self.name = name
+        self._begin = None
+
+    def begin(self):
+        self._begin = _now_us()
+        return self
+
+    def end(self):
+        if self._begin is None or not _enabled[0]:
+            return
+        ev = {
+            "name": self.name,
+            "ph": "X",
+            "ts": self._begin,
+            "dur": _now_us() - self._begin,
+            "pid": os.getpid(),
+            "tid": threading.get_ident() % 100000,
+        }
+        with _events_lock:
+            _events.append(ev)
+        self._begin = None
+
+    def __enter__(self):
+        return self.begin()
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+@contextlib.contextmanager
+def record_event(name):
+    with RecordEvent(name):
+        yield
+
+
+def start_profiler(state="All", tracer_option="Default", trace_dir=None):
+    """EnableProfiler equivalent. state: CPU | GPU | All (accepted for
+    compat; device tracing starts whenever state != CPU)."""
+    _enabled[0] = True
+    if state != "CPU":
+        import jax
+
+        d = trace_dir or "/tmp/paddle_tpu_trace"
+        os.makedirs(d, exist_ok=True)
+        try:
+            jax.profiler.start_trace(d)
+            _device_trace_dir[0] = d
+        except Exception:
+            _device_trace_dir[0] = None  # already tracing / unsupported
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    """DisableProfiler equivalent; writes chrome trace to profile_path."""
+    _enabled[0] = False
+    if _device_trace_dir[0] is not None:
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+        _device_trace_dir[0] = None
+    if profile_path:
+        export_chrome_tracing(profile_path)
+
+
+def reset_profiler():
+    with _events_lock:
+        _events.clear()
+
+
+def export_chrome_tracing(path):
+    """Write collected host events as a chrome://tracing JSON file."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with _events_lock:
+        trace = {"traceEvents": list(_events)}
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path=None):
+    """fluid.profiler.profiler context manager."""
+    start_profiler(state)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -2,7 +2,17 @@
 python/paddle/fluid/ Program/Executor surface)."""
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from .io import (  # noqa: F401
+    load,
+    load_inference_model,
+    load_persistables,
+    save,
+    save_inference_model,
+    save_persistables,
+)
 from .backward import append_backward, gradients  # noqa: F401
+from ..jit_api import InputSpec  # noqa: F401
 from .executor import Executor, Scope, global_scope  # noqa: F401
 from .program import (  # noqa: F401
     Block,

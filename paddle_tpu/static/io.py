@@ -1,0 +1,165 @@
+"""Static-graph model serialization.
+
+Reference parity: python/paddle/fluid/io.py (save_persistables,
+save_inference_model :? , load_inference_model, save/load state) over
+save_op/load_op/save_combine_op (operators/save_combine_op.cc).
+
+Format: `<path>/__model__` holds the serialized Program (JSON — our
+ProgramDesc form); `<path>/__params__` holds all persistable variables in
+one combined file (save_combine semantics) via framework.serialization.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..framework import serialization
+from .executor import global_scope
+from .program import Program, default_main_program
+
+__all__ = [
+    "save", "load", "save_persistables", "load_persistables",
+    "save_inference_model", "load_inference_model",
+]
+
+_MODEL_FILENAME = "__model__"
+_PARAMS_FILENAME = "__params__"
+
+
+def _persistable_dict(program, scope=None):
+    scope = scope or global_scope()
+    out = {}
+    for var in program.list_vars():
+        if var.persistable and scope.has(var.name):
+            out[var.name] = np.asarray(scope.get(var.name))
+    # eager tensors captured into the program as constants (op_append.py)
+    # are authoritative over any same-named value a previously-loaded
+    # program left in the global scope
+    for cname, cval in getattr(program, "_constants", {}).items():
+        out[cname] = np.asarray(cval)
+    return out
+
+
+def save(program, model_path, protocol=4):
+    """paddle.static.save: program params+buffers -> {path}.pdparams,
+    program -> {path}.pdmodel."""
+    d = os.path.dirname(model_path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    serialization.save(_persistable_dict(program), model_path + ".pdparams")
+    with open(model_path + ".pdmodel", "wb") as f:
+        f.write(program.serialize_to_string())
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """paddle.static.load: restore persistables into the scope."""
+    state = serialization.load(model_path + ".pdparams")
+    scope = global_scope()
+    names = (
+        [v.name for v in var_list]
+        if var_list is not None
+        else [v.name for v in program.list_vars() if v.persistable]
+    )
+    for name in names:
+        if name in state:
+            scope.set(name, state[name])
+    return program
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """fluid.io.save_persistables (save_combine semantics: one file)."""
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    serialization.save(
+        _persistable_dict(main_program),
+        os.path.join(dirname, filename or _PARAMS_FILENAME),
+    )
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    main_program = main_program or default_main_program()
+    state = serialization.load(
+        os.path.join(dirname, filename or _PARAMS_FILENAME)
+    )
+    scope = global_scope()
+    for name, arr in state.items():
+        scope.set(name, arr)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, **kwargs):
+    """fluid.io.save_inference_model: prune the program to the inference
+    subgraph reachable from feeds->fetches and save program+params.
+
+    The reference prunes via ProgramDesc::Prune; here we keep ops whose
+    outputs are (transitively) needed for target_vars, drop backward ops
+    (op_role), and record the feed/fetch lists in the saved model.
+    """
+    main_program = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    target_names = [
+        v.name if hasattr(v, "name") else str(v) for v in target_vars
+    ]
+
+    pruned = _prune_for_inference(main_program, feeded_var_names, target_names)
+    import json
+
+    model = {
+        "program": pruned.to_dict(),
+        "feed_names": list(feeded_var_names),
+        "fetch_names": target_names,
+    }
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME), "w") as f:
+        json.dump(model, f)
+    serialization.save(
+        _persistable_dict(pruned),
+        os.path.join(dirname, params_filename or _PARAMS_FILENAME),
+    )
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    """Returns (program, feed_names, fetch_names), params loaded into the
+    global scope."""
+    import json
+
+    with open(os.path.join(dirname, model_filename or _MODEL_FILENAME)) as f:
+        model = json.load(f)
+    program = Program.from_dict(model["program"])
+    state = serialization.load(
+        os.path.join(dirname, params_filename or _PARAMS_FILENAME)
+    )
+    scope = global_scope()
+    for name, arr in state.items():
+        scope.set(name, arr)
+    return program, model["feed_names"], model["fetch_names"]
+
+
+def _prune_for_inference(program, feed_names, target_names):
+    """Keep the forward subgraph producing target_names from feed_names."""
+    block = program.global_block()
+    kept_idx = []
+    needed = set(target_names)
+    for i in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[i]
+        if set(op.output_names()) & needed:
+            kept_idx.append(i)
+            needed |= set(op.input_names())
+    kept_idx.reverse()
+
+    pruned = Program.from_dict(program.to_dict())
+    # captured eager constants don't survive to_dict; carry them over
+    pruned._constants = dict(getattr(program, "_constants", {}))
+    pblock = pruned.global_block()
+    pblock.ops = [pblock.ops[i] for i in kept_idx]
+    # drop vars not referenced anymore (keep persistables used by kept ops)
+    used = set()
+    for op in pblock.ops:
+        used |= set(op.input_names()) | set(op.output_names())
+    pblock.vars = {
+        n: v for n, v in pblock.vars.items() if n in used
+    }
+    return pruned

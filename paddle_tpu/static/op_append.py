@@ -24,6 +24,8 @@ from .program import Variable, default_main_program
 # so we can recognize it in outputs and restore -1.
 _DYN = 83
 
+_GLOBAL_CONST_ID = [0]
+
 RNG_OPS = {
     "dropout", "uniform_random", "gaussian_random", "randint", "randperm",
     "bernoulli", "multinomial", "truncated_gaussian_random",
@@ -48,7 +50,10 @@ def append_static_op(op_type, tensors, attrs, alias_outputs=None):
             in_names.append(t.name)
         else:
             # eager Tensor constant captured into the program
-            cname = prog._unique_name("const")
+            # globally unique across programs: two captured programs must
+            # never share a constant name in the (shared) global scope
+            _GLOBAL_CONST_ID[0] += 1
+            cname = prog._unique_name(f"const{_GLOBAL_CONST_ID[0]}")
             cvar = block.create_var(name=cname, shape=list(t._array.shape),
                                     dtype=str(t._array.dtype), persistable=True)
             if not hasattr(prog, "_constants"):

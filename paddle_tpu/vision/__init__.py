@@ -1,0 +1,6 @@
+"""paddle.vision equivalent (incubate/hapi/vision + paddle/dataset)."""
+from . import datasets  # noqa: F401
+from . import transforms  # noqa: F401
+from ..models import LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+
+from .models import *  # noqa: F401,F403

@@ -1,0 +1,159 @@
+"""Built-in datasets.
+
+Reference parity: python/paddle/dataset/ (mnist.py, cifar.py fetchers) and
+incubate/hapi datasets. This environment has zero network egress, so each
+dataset loads from a local file when present (same on-disk formats as the
+reference's cache: idx-gzip for MNIST, pickled batches for CIFAR) and
+otherwise generates a deterministic synthetic sample set with the same
+shapes/dtypes/label-space — keeping every book-test equivalent runnable
+offline. ``backend`` follows the data home convention
+(~/.cache/paddle_tpu/dataset).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+
+class _SyntheticMixin:
+    """Deterministic stand-in data when the real files are absent."""
+
+    def _synthesize(self, n, image_shape, num_classes, seed):
+        rng = np.random.RandomState(seed)
+        # class patterns come from a split-independent seed so train and
+        # test share the same class structure (only noise/labels differ)
+        import zlib
+
+        pattern_rng = np.random.RandomState(
+            zlib.crc32(type(self).__name__.encode()) % 2**31
+        )
+        bases = [
+            pattern_rng.rand(*image_shape).astype("float32")
+            for _ in range(num_classes)
+        ]
+        labels = rng.randint(0, num_classes, n).astype("int64")
+        images = np.zeros((n,) + image_shape, np.float32)
+        for c in range(num_classes):
+            images[labels == c] = bases[c][None] * 0.8
+        images += rng.rand(n, *image_shape).astype("float32") * 0.2
+        self.synthetic = True
+        return images, labels
+
+
+class MNIST(_SyntheticMixin, Dataset):
+    """paddle.vision.datasets.MNIST (dataset/mnist.py idx format)."""
+
+    IMAGE_SHAPE = (1, 28, 28)
+    NUM_CLASSES = 10
+    _PREFIX = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = False
+        split = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            DATA_HOME, self._PREFIX, f"{split}-images-idx3-ubyte.gz"
+        )
+        label_path = label_path or os.path.join(
+            DATA_HOME, self._PREFIX, f"{split}-labels-idx1-ubyte.gz"
+        )
+        if os.path.exists(image_path) and os.path.exists(label_path):
+            self.images = self._read_idx_images(image_path)
+            self.labels = self._read_idx_labels(label_path)
+        else:
+            n = 2048 if mode == "train" else 512
+            self.images, self.labels = self._synthesize(
+                n, self.IMAGE_SHAPE, self.NUM_CLASSES,
+                seed=42 if mode == "train" else 43,
+            )
+
+    @staticmethod
+    def _read_idx_images(path):
+        with gzip.open(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), np.uint8).reshape(n, 1, rows, cols)
+        return (data.astype("float32") / 255.0 - 0.5) / 0.5
+
+    @staticmethod
+    def _read_idx_labels(path):
+        with gzip.open(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype("int64")
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    _PREFIX = "fashion-mnist"
+
+
+class Cifar10(_SyntheticMixin, Dataset):
+    """paddle.vision.datasets.Cifar10 (dataset/cifar.py pickled batches)."""
+
+    IMAGE_SHAPE = (3, 32, 32)
+    NUM_CLASSES = 10
+    _ARCHIVE = "cifar-10-python.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        self.synthetic = False
+        data_file = data_file or os.path.join(DATA_HOME, self._ARCHIVE)
+        if os.path.exists(data_file):
+            self.images, self.labels = self._read_archive(data_file, mode)
+        else:
+            n = 2048 if mode == "train" else 512
+            self.images, self.labels = self._synthesize(
+                n, self.IMAGE_SHAPE, self.NUM_CLASSES,
+                seed=44 if mode == "train" else 45,
+            )
+
+    def _read_archive(self, path, mode):
+        images, labels = [], []
+        want = "data_batch" if mode == "train" else "test_batch"
+        with tarfile.open(path) as tar:
+            for member in tar.getmembers():
+                if want in member.name:
+                    d = pickle.load(tar.extractfile(member), encoding="bytes")
+                    images.append(d[b"data"])
+                    labels.extend(d.get(b"labels", d.get(b"fine_labels")))
+        images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        images = (images.astype("float32") / 255.0 - 0.5) / 0.5
+        return images, np.asarray(labels, "int64")
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+    _ARCHIVE = "cifar-100-python.tar.gz"

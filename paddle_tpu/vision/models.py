@@ -1,0 +1,15 @@
+"""paddle.vision.models namespace — re-export the model zoo."""
+from ..models import (  # noqa: F401
+    LeNet,
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+
+__all__ = [
+    "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+    "resnet152",
+]

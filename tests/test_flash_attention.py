@@ -1,0 +1,68 @@
+"""Flash attention tests (CPU fallback path; the pallas kernel itself is
+exercised on TPU by bench/perf runs)."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.ops.pallas.flash_attention import (
+    _plain_attention,
+    flash_attention,
+)
+
+
+def _qkv(b=2, h=2, l=64, d=16, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: rng.randn(b, h, l, d).astype("float32")
+    return mk(), mk(), mk()
+
+
+def test_matches_reference_no_bias():
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v)
+    ref = _plain_attention(q, k, v, None, False, q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_causal_and_bias():
+    q, k, v = _qkv()
+    bias = np.random.RandomState(1).randn(2, 1, 64, 64).astype("float32")
+    out = flash_attention(q, k, v, bias=bias, causal=True)
+    ref = _plain_attention(q, k, v, bias, True, q.shape[-1] ** -0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_eager_tensor_backward():
+    q, k, v = _qkv(l=32)
+    qt = paddle.to_tensor(q, stop_gradient=False)
+    kt = paddle.to_tensor(k, stop_gradient=False)
+    vt = paddle.to_tensor(v, stop_gradient=False)
+    out = flash_attention(qt, kt, vt, causal=True)
+    out.sum().backward()
+    assert qt.grad is not None
+    assert np.isfinite(qt.grad.numpy()).all()
+    assert kt.grad is not None and vt.grad is not None
+
+
+def test_mha_flash_flag():
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(32, 4, dropout=0.0, use_flash_attention=True)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 16, 32).astype("float32"))
+    out = mha(x, x, x)
+    assert list(out.shape) == [2, 16, 32]
+    # matches the plain path numerically
+    paddle.seed(0)
+    mha2 = nn.MultiHeadAttention(32, 4, dropout=0.0)
+    mha2.set_state_dict(mha.state_dict())
+    ref = mha2(x, x, x)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_dropout_conflict_raises():
+    try:
+        nn.MultiHeadAttention(32, 4, dropout=0.1, use_flash_attention=True)
+        assert False
+    except ValueError:
+        pass

@@ -1,0 +1,84 @@
+"""hapi Model.fit/evaluate/predict tests (incubate/hapi/tests patterns)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.hapi import EarlyStopping
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy
+
+
+def _dataset(n=64, d=8, c=3, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype("float32")
+    w = rng.randn(d, c).astype("float32")
+    y = (x @ w).argmax(1).astype("int64")
+    return TensorDataset([x, y])
+
+
+def _model():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=opt.Adam(learning_rate=1e-2, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    return model
+
+
+def test_fit_reduces_loss_and_evaluate():
+    model = _model()
+    ds = _dataset()
+    logs1 = model.fit(ds, batch_size=16, epochs=1, verbose=0)
+    logs5 = model.fit(ds, batch_size=16, epochs=5, verbose=0)
+    assert logs5["loss"] < logs1["loss"]
+    ev = model.evaluate(ds, batch_size=16, verbose=0)
+    assert ev["acc"] > 0.5
+    assert "loss" in ev
+
+
+def test_predict_shapes():
+    model = _model()
+    ds = _dataset(n=20)
+    outs = model.predict(ds, batch_size=8, stack_outputs=True)
+    assert outs.shape == (20, 3)
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = _model()
+    ds = _dataset()
+    model.fit(ds, batch_size=16, epochs=2, verbose=0)
+    path = str(tmp_path / "ckpt")
+    model.save(path)
+
+    model2 = _model()
+    model2.load(path)
+    p1 = model.predict(ds, batch_size=64, stack_outputs=True)
+    p2 = model2.predict(ds, batch_size=64, stack_outputs=True)
+    np.testing.assert_allclose(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_early_stopping():
+    model = _model()
+    ds = _dataset()
+    es = EarlyStopping(monitor="loss", patience=0, mode="min", min_delta=10.0)
+    model.fit(ds, eval_data=ds, batch_size=16, epochs=10, verbose=0,
+              callbacks=[es])
+    # min_delta=10 means no improvement ever counts -> stops after 2 evals
+    assert model.stop_training
+
+
+def test_fit_with_amp():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 3))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=opt.Adam(learning_rate=1e-2, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        amp_configs="O1",
+    )
+    logs = model.fit(_dataset(), batch_size=16, epochs=3, verbose=0)
+    assert np.isfinite(logs["loss"])

@@ -1,0 +1,70 @@
+"""paddle.jit to_static/save/load tests (dygraph_to_static test patterns)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.jit_api import InputSpec
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 16)
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_to_static_function():
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2 + 1
+
+    x = paddle.to_tensor(np.arange(4, dtype="float32"))
+    out = f(x)
+    np.testing.assert_allclose(out.numpy(), np.arange(4) * 2 + 1)
+
+
+def test_to_static_layer_matches_eager():
+    paddle.seed(0)
+    m = Net()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(5, 4).astype("float32"))
+    m.eval()
+    ref = m(x).numpy()
+    paddle.jit.to_static(m)
+    out = m(x)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    paddle.seed(1)
+    m = Net()
+    m.eval()
+    x = np.random.RandomState(1).randn(6, 4).astype("float32")
+    ref = m(paddle.to_tensor(x)).numpy()
+
+    path = str(tmp_path / "net_model")
+    paddle.jit.save(m, path, input_spec=[InputSpec([None, 4], "float32")])
+
+    loaded = paddle.jit.load(path)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_saved_model_loads_in_predictor(tmp_path):
+    """jit.save output is also consumable by the inference Predictor."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    paddle.seed(2)
+    m = Net()
+    m.eval()
+    x = np.random.RandomState(2).randn(3, 4).astype("float32")
+    ref = m(paddle.to_tensor(x)).numpy()
+    path = str(tmp_path / "net_model2")
+    paddle.jit.save(m, path, input_spec=[InputSpec([None, 4], "float32")])
+
+    pred = create_predictor(Config(path))
+    (out,) = pred.run([x])
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,72 @@
+"""Metric + profiler tests."""
+import json
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]], np.float32)
+    label = np.array([1, 2], np.int64)
+    correct = m.compute(paddle.to_tensor(pred), paddle.to_tensor(label))
+    m.update(correct)
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 0.5) < 1e-6  # only first sample top-1 correct
+    assert abs(top2 - 0.5) < 1e-6  # second sample's label ranked 3rd
+    assert m.name() == ["acc_top1", "acc_top2"]
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6  # tp=2 fp=1
+    assert abs(r.accumulate() - 2 / 3) < 1e-6  # tp=2 fn=1
+
+
+def test_auc_perfect_separation():
+    auc = Auc()
+    preds = np.array([0.9, 0.8, 0.1, 0.2])
+    labels = np.array([1, 1, 0, 0])
+    auc.update(preds, labels)
+    assert auc.accumulate() == 1.0
+
+
+def test_auc_random_is_half():
+    auc = Auc()
+    rng = np.random.RandomState(0)
+    preds = rng.rand(10000)
+    labels = rng.randint(0, 2, 10000)
+    auc.update(preds, labels)
+    assert abs(auc.accumulate() - 0.5) < 0.02
+
+
+def test_profiler_chrome_trace(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    with profiler.RecordEvent("forward"):
+        _ = paddle.to_tensor(np.ones((64, 64))).numpy()
+    with profiler.record_event("backward"):
+        pass
+    path = str(tmp_path / "trace.json")
+    profiler.stop_profiler(profile_path=path)
+    trace = json.load(open(path))
+    names = [e["name"] for e in trace["traceEvents"]]
+    assert "forward" in names and "backward" in names
+    assert all(e["dur"] >= 0 for e in trace["traceEvents"])
+
+
+def test_profiler_disabled_records_nothing(tmp_path):
+    profiler.reset_profiler()
+    with profiler.RecordEvent("not-recorded"):
+        pass
+    path = str(tmp_path / "trace2.json")
+    profiler.export_chrome_tracing(path)
+    trace = json.load(open(path))
+    assert trace["traceEvents"] == []
