@@ -28,7 +28,7 @@ from ..parallel.mesh import get_mesh
 __all__ = [
     "ReduceOp", "new_group", "all_reduce", "broadcast", "reduce",
     "all_gather", "reduce_scatter", "scatter", "alltoall", "barrier",
-    "send", "recv",
+    "send", "recv", "p2p",
 ]
 
 
@@ -213,29 +213,57 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     )
 
 
-def send(tensor, dst, group=None, sync_op=True):
-    """Point-to-point over a ring: ppermute shift. Paired send/recv on a
-    mesh axis is expressed as a single ppermute in the compiled program —
-    see parallel.pipeline for the pipeline-parallel use."""
+def p2p(tensor, src, dst, group=None):
+    """Paired point-to-point as ONE static single-pair permutation.
+
+    SPMD semantics: rank ``dst`` ends up with rank ``src``'s value; every
+    other rank gets zeros (lax.ppermute's untargeted-destination rule).
+    This is how a matched send/recv pair lowers in a single compiled
+    program — see parallel.pipeline for the pipeline-parallel use.
+    """
     arr = _unwrap(tensor)
     if _in_trace(arr):
         axes = _valid_axes(_axes(group))
         for ax in axes:
             n = get_mesh().shape[ax]
-            perm = [(i, dst % n) for i in range(n)]
-            arr = lax.ppermute(arr, ax, perm)
-    return _rewrap(arr, tensor)
+            arr = lax.ppermute(arr, ax, [(src % n, dst % n)])
+    # never mutate the input: untargeted ranks get zeros, and writing that
+    # back would destroy the sender's local copy (paddle.distributed.send
+    # leaves the argument intact)
+    return Tensor._from_array(arr) if isinstance(tensor, Tensor) else arr
 
 
-def recv(tensor, src, group=None, sync_op=True):
+def send(tensor, dst, group=None, sync_op=True, src=None):
+    """Point-to-point send. In SPMD traced code both endpoints must be
+    static, so the matched pair is expressed as one permutation: pass
+    ``src`` (the sending rank) alongside ``dst``. lax.ppermute requires
+    unique sources/destinations — a one-to-all or all-to-one perm is
+    invalid, hence the single-pair form."""
     arr = _unwrap(tensor)
     if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        for ax in axes:
-            n = get_mesh().shape[ax]
-            perm = [(src % n, i) for i in range(n)]
-            arr = lax.ppermute(arr, ax, perm)
-    return _rewrap(arr, tensor)
+        if src is None:
+            raise ValueError(
+                "send() inside traced/SPMD code needs both endpoints: "
+                "send(tensor, dst, src=<sending rank>) — a paired p2p "
+                "lowers to a single-pair ppermute (see collective.p2p)"
+            )
+        return p2p(tensor, src, dst, group=group)
+    return tensor
+
+
+def recv(tensor, src, group=None, sync_op=True, dst=None):
+    """Point-to-point receive; the SPMD twin of :func:`send` — pass
+    ``dst`` (the receiving rank) so the pair lowers to one permutation."""
+    arr = _unwrap(tensor)
+    if _in_trace(arr):
+        if dst is None:
+            raise ValueError(
+                "recv() inside traced/SPMD code needs both endpoints: "
+                "recv(tensor, src, dst=<receiving rank>) — a paired p2p "
+                "lowers to a single-pair ppermute (see collective.p2p)"
+            )
+        return p2p(tensor, src, dst, group=group)
+    return tensor
 
 
 def shift(tensor, offset=1, group=None):

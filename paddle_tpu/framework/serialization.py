@@ -57,8 +57,27 @@ def save(obj, path, protocol=4):
         f.write(buf.getvalue())
 
 
+def _to_tensor(obj):
+    """Wrap ndarray leaves back into (device-backed) Tensors, recursively."""
+    import jax.numpy as jnp
+
+    from .tensor import Tensor
+
+    if isinstance(obj, np.ndarray):
+        return Tensor._from_array(jnp.asarray(obj))
+    if isinstance(obj, dict):
+        return {k: _to_tensor(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensor(v) for v in obj)
+    return obj
+
+
 def load(path, return_numpy=False):
-    """Load an object saved by ``save``."""
+    """Load an object saved by ``save``.
+
+    Matching paddle.load semantics: by default array leaves come back as
+    Tensors; ``return_numpy=True`` keeps them as numpy arrays.
+    """
     with open(path, "rb") as f:
         head = f.read(len(_MAGIC))
         if head != _MAGIC:
@@ -68,4 +87,4 @@ def load(path, return_numpy=False):
         obj = pickle.load(f)
     if return_numpy:
         return obj
-    return obj
+    return _to_tensor(obj)

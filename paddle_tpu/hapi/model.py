@@ -212,12 +212,12 @@ class Model:
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         from ..framework import serialization
 
-        state = serialization.load(path + ".pdparams")
+        state = serialization.load(path + ".pdparams", return_numpy=True)
         self.network.set_state_dict(state)
         self._train_step = None
         if not reset_optimizer and self._optimizer is not None:
             try:
-                opt_state = serialization.load(path + ".pdopt")
+                opt_state = serialization.load(path + ".pdopt", return_numpy=True)
                 self._optimizer.set_state_dict(opt_state)
             except FileNotFoundError:
                 pass

@@ -115,8 +115,12 @@ class Layer:
         return Parameter.from_array(arr, name=name, trainable=trainable)
 
     # -- traversal ----------------------------------------------------------
-    def named_parameters(self, prefix="", include_sublayers=True):
-        seen = set()
+    def named_parameters(self, prefix="", include_sublayers=True, _seen=None):
+        # `_seen` is threaded through the whole module tree so a Parameter
+        # shared between layers (e.g. a tied embedding/decoder weight) yields
+        # exactly one canonical leaf — aliased leaves would silently shadow
+        # each other in functionalized train steps (framework/jit.py).
+        seen = set() if _seen is None else _seen
         for name, p in self._parameters.items():
             if p is not None and id(p) not in seen:
                 seen.add(id(p))
@@ -125,7 +129,9 @@ class Layer:
             for lname, layer in self._sub_layers.items():
                 if layer is None:
                     continue
-                yield from layer.named_parameters(prefix=f"{prefix}{lname}.")
+                yield from layer.named_parameters(
+                    prefix=f"{prefix}{lname}.", _seen=seen
+                )
 
     def parameters(self, include_sublayers=True):
         return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]

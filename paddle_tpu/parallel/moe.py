@@ -142,8 +142,12 @@ class SwitchFFN(Layer):
             expert = jnp.argmax(probs, axis=-1)         # [S]
             # position of each token within its expert's queue
             onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)   # [S, E]
-            pos = jnp.cumsum(onehot, axis=0) * onehot - 1          # [S, E]
-            pos_in_expert = jnp.sum(pos, axis=-1)                  # [S]
+            # rank within the chosen expert's queue: mask the cumsum to the
+            # chosen column *before* the -1 (subtracting inside the sum
+            # would shift by E, aliasing the first E tokens into slot 0)
+            pos_in_expert = (
+                jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+            )  # [S]
             keep = pos_in_expert < cap
             gate = gate * keep
 

@@ -54,7 +54,7 @@ def save(program, model_path, protocol=4):
 
 def load(program, model_path, executor=None, var_list=None):
     """paddle.static.load: restore persistables into the scope."""
-    state = serialization.load(model_path + ".pdparams")
+    state = serialization.load(model_path + ".pdparams", return_numpy=True)
     scope = global_scope()
     names = (
         [v.name for v in var_list]
@@ -80,7 +80,8 @@ def save_persistables(executor, dirname, main_program=None, filename=None):
 def load_persistables(executor, dirname, main_program=None, filename=None):
     main_program = main_program or default_main_program()
     state = serialization.load(
-        os.path.join(dirname, filename or _PARAMS_FILENAME)
+        os.path.join(dirname, filename or _PARAMS_FILENAME),
+        return_numpy=True,
     )
     scope = global_scope()
     for name, arr in state.items():
@@ -130,7 +131,8 @@ def load_inference_model(dirname, executor, model_filename=None,
         model = json.load(f)
     program = Program.from_dict(model["program"])
     state = serialization.load(
-        os.path.join(dirname, params_filename or _PARAMS_FILENAME)
+        os.path.join(dirname, params_filename or _PARAMS_FILENAME),
+        return_numpy=True,
     )
     scope = global_scope()
     for name, arr in state.items():
