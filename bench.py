@@ -8,6 +8,13 @@ BERT-base phase-1 pretraining, seq 128 fp16 + fused kernels, reports
 ~700-800 sequences/sec on one A100 ≈ 90-100k tokens/sec — we use 90000
 tokens/sec/chip as the parity bar).
 
+Recipe parity: phase-1 pretraining at seq 128 with
+max_predictions_per_seq=20 — MLM logits are computed only at the gathered
+masked positions (BertForPretraining masked_positions path), exactly as the
+A100 reference recipe does; dropout (hidden 0.1 + attention 0.1) is ON, as
+in the standard config. RNG uses the TPU-native rbg implementation
+(framework/random.py) — part of the measured win.
+
 Timing note: the final loss value is fetched (np.asarray), not just
 block_until_ready'd — on the remote-TPU (axon) backend block_until_ready
 can return before execution completes, giving absurd throughputs; a value
@@ -52,15 +59,16 @@ def main():
             max_position_embeddings=128,
         )
         batch, seq, iters = 8, 128, 3
+    n_pred = 20  # max_predictions_per_seq, phase-1 standard
 
     paddle.seed(0)
     model = BertForPretraining(cfg)
     crit = BertPretrainingCriterion(cfg.vocab_size)
     optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
 
-    def loss_fn(m, ids, tt, mlm, nsp):
+    def loss_fn(m, ids, tt, pos, mlm, nsp):
         with amp.auto_cast():
-            pred, rel = m(ids, tt)
+            pred, rel = m(ids, tt, masked_positions=pos)
         return crit(
             pred.astype("float32"), rel.astype("float32"), mlm, nsp
         )
@@ -70,16 +78,20 @@ def main():
     rng = np.random.RandomState(0)
     ids = rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64")
     tt = rng.randint(0, 2, (batch, seq)).astype("int64")
-    mlm = rng.randint(0, cfg.vocab_size, (batch, seq)).astype("int64")
+    # flat positions into the [B*L] hidden-state table, n_pred per sequence
+    pos = np.stack(
+        [rng.choice(seq, n_pred, replace=False) + i * seq for i in range(batch)]
+    ).ravel().astype("int64")
+    mlm = rng.randint(0, cfg.vocab_size, (batch * n_pred,)).astype("int64")
     nsp = rng.randint(0, 2, (batch, 1)).astype("int64")
 
     # warmup + compile
-    float(np.asarray(step(ids, tt, mlm, nsp)["loss"]))
-    float(np.asarray(step(ids, tt, mlm, nsp)["loss"]))
+    float(np.asarray(step(ids, tt, pos, mlm, nsp)["loss"]))
+    float(np.asarray(step(ids, tt, pos, mlm, nsp)["loss"]))
 
     t0 = time.perf_counter()
     for _ in range(iters):
-        m = step(ids, tt, mlm, nsp)
+        m = step(ids, tt, pos, mlm, nsp)
     float(np.asarray(m["loss"]))  # value fetch = reliable barrier
     dt = time.perf_counter() - t0
 
