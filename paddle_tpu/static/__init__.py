@@ -12,6 +12,7 @@ from .io import (  # noqa: F401
     save_persistables,
 )
 from .backward import append_backward, gradients  # noqa: F401
+from .control_flow import case, cond, scan, switch_case, while_loop  # noqa: F401
 from ..jit_api import InputSpec  # noqa: F401
 from .executor import Executor, Scope, global_scope  # noqa: F401
 from .program import (  # noqa: F401

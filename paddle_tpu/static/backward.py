@@ -38,6 +38,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
         if not v.stop_gradient and _is_float_var(block, v.name):
             requires.add(v.name)
     for op in ops:
+        if op.type == "while":
+            continue  # gradient barrier: lax.while_loop has no reverse mode
         ins = op.inputs.get("X", [])
         outs = op.outputs.get("Out", [])
         if any(n in requires for n in ins):
@@ -60,8 +62,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     n_fwd_ops = len(ops)
     for i in range(n_fwd_ops - 1, -1, -1):
         op = ops[i]
-        if op.type in ("fill_any_like", "fill_constant") and i >= n_fwd_ops:
-            continue
+        if op.type == "while":
+            continue  # see gradient-barrier note above
         in_names = op.inputs.get("X", [])
         out_names = op.outputs.get("Out", [])
         out_grads = [grad_map.get(n) for n in out_names]

@@ -1,0 +1,347 @@
+"""Static-graph control flow: while_loop / cond / scan (+ case dispatch).
+
+Reference parity: paddle/fluid/operators/controlflow/while_op.cc,
+conditional_block_op.cc and python/paddle/fluid/layers/control_flow.py
+(while_loop, cond, case, switch_case). Ops consume nested BlockDescs via
+block-index attributes, exactly like the reference's BLOCK attr
+(framework/framework.proto:34).
+
+TPU-native lowering (static/executor.py):
+- ``while_loop`` -> ``lax.while_loop``: dynamic trip count, NOT
+  reverse-differentiable (XLA cannot backprop an unbounded loop). Use for
+  inference-style iteration (decoding, convergence loops).
+- ``cond`` -> ``lax.cond``: both branches compiled, predicate selects at
+  run time; fully differentiable.
+- ``scan`` -> ``lax.scan``: the differentiable bounded loop — the TPU
+  answer to the reference's trainable RNN loops (recurrent_op /
+  StaticRNN): time-major sequences with a static length, reverse-mode
+  autodiff supported by construction.
+
+Sub-block construction: the user fn runs under ``block_guard`` on fresh
+placeholder Variables; every op it emits lands in the sub-block. Names the
+sub-block reads but does not define ("captures", e.g. parameters) become
+explicit op inputs so append_backward can route gradients to them.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .program import Variable, block_guard, default_main_program
+
+__all__ = ["while_loop", "cond", "scan", "case", "switch_case"]
+
+# attr keys holding sub-block indices (executor + serialization walk these)
+BLOCK_ATTR_KEYS = (
+    "__cond_block__", "__body_block__", "__true_block__", "__false_block__",
+)
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_variables(vars_):
+    """Coerce eager Tensors (e.g. ops.zeros run eagerly) to captured
+    constant Variables so loop inputs are always program vars."""
+    from .op_append import capture_constant
+
+    out = []
+    for v in _as_list(vars_):
+        out.append(v if isinstance(v, Variable) else capture_constant(v))
+    return out
+
+
+def _placeholders(block, ref_vars, shapes=None, prefix="loopvar"):
+    """Formal-argument Variables inside ``block`` mirroring ``ref_vars``."""
+    prog = block.program
+    out = []
+    for i, v in enumerate(ref_vars):
+        shape = shapes[i] if shapes is not None else v.shape
+        ph = block.create_var(
+            name=prog._unique_name(prefix), shape=shape, dtype=str(v.dtype)
+        )
+        ph.stop_gradient = v.stop_gradient
+        out.append(ph)
+    return out
+
+
+def _trace_subblock(fn, formal_vars):
+    """Run ``fn`` on ``formal_vars`` with ops captured into a new block."""
+    prog = default_main_program()
+    blk = prog._create_block()
+    # formals were created by the caller in blk already
+    with block_guard(blk):
+        outs = fn(*formal_vars)
+    return blk, outs
+
+
+def _collect_captures(program, block_idxs, exclude):
+    """Names read by the sub-blocks (recursively) that resolve outside them.
+
+    These become explicit inputs of the control-flow op so static autodiff
+    sees the dependency (e.g. RNN weights used inside a scan body).
+    """
+    captures = []
+    seen = set(exclude)
+    stack = list(block_idxs)
+    local_blocks = set(block_idxs)
+    while stack:
+        bi = stack.pop()
+        blk = program.blocks[bi]
+        for op in blk.ops:
+            for key, val in op.attrs.items():
+                if key in BLOCK_ATTR_KEYS and isinstance(val, int):
+                    local_blocks.add(val)
+                    stack.append(val)
+            for names in op.inputs.values():
+                for n in names:
+                    if n in seen:
+                        continue
+                    seen.add(n)
+                    owner = _owning_block(program, blk, n)
+                    if owner is not None and owner.idx not in local_blocks:
+                        captures.append(n)
+    return captures
+
+
+def _owning_block(program, block, name):
+    blk = block
+    while blk is not None:
+        if name in blk.vars:
+            return blk
+        blk = program.blocks[blk.parent_idx] if blk.parent_idx >= 0 else None
+    return None
+
+
+def _defined_names(program, block_idxs):
+    names = set()
+    for bi in block_idxs:
+        names.update(program.blocks[bi].vars.keys())
+    return names
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop (fluid control_flow.py while_loop).
+
+    ``cond(*loop_vars) -> bool scalar``, ``body(*loop_vars) -> loop_vars'``.
+    Lowers to ``lax.while_loop``; loop-carried shapes/dtypes must be
+    invariant. Not differentiable — train bounded loops with :func:`scan`.
+    """
+    loop_vars = _as_variables(loop_vars)
+    if not loop_vars:
+        raise ValueError("while_loop needs at least one loop variable")
+    prog = default_main_program()
+    parent = prog.current_block()
+
+    cond_blk = prog._create_block()
+    cond_formals = _placeholders(cond_blk, loop_vars)
+    with block_guard(cond_blk):
+        pred = cond(*cond_formals)
+    if isinstance(pred, (list, tuple)):
+        raise TypeError("while_loop cond must return a single boolean")
+
+    body_blk = prog._create_block()
+    body_formals = _placeholders(body_blk, loop_vars)
+    with block_guard(body_blk):
+        body_outs = _as_list(body(*body_formals))
+    if len(body_outs) != len(loop_vars):
+        raise ValueError(
+            f"body returned {len(body_outs)} vars, expected {len(loop_vars)}"
+        )
+
+    formal_names = [v.name for v in cond_formals] + [v.name for v in body_formals]
+    captures = _collect_captures(
+        prog, [cond_blk.idx, body_blk.idx], set(formal_names)
+    )
+
+    out_vars = []
+    for v in loop_vars:
+        ov = parent.create_var(
+            name=prog._unique_name("while_out"), shape=v.shape,
+            dtype=str(v.dtype),
+        )
+        ov.stop_gradient = True  # while is not reverse-differentiable
+        out_vars.append(ov)
+
+    parent.append_op(
+        "while",
+        {"X": [v.name for v in loop_vars] + captures},
+        {"Out": [v.name for v in out_vars]},
+        {
+            "__cond_block__": cond_blk.idx,
+            "__body_block__": body_blk.idx,
+            "__cond_formals__": [v.name for v in cond_formals],
+            "__body_formals__": [v.name for v in body_formals],
+            "__cond_out__": pred.name,
+            "__body_outs__": [v.name for v in body_outs],
+            "__n_loop__": len(loop_vars),
+            "is_test": is_test,
+        },
+    )
+    return out_vars
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """paddle.static.nn.cond (conditional_block_op pair + select).
+
+    Both branches are traced into sub-blocks and compiled; ``lax.cond``
+    selects at run time. Differentiable.
+    """
+    prog = default_main_program()
+    parent = prog.current_block()
+
+    true_blk = prog._create_block()
+    with block_guard(true_blk):
+        t_outs = _as_list(true_fn())
+    false_blk = prog._create_block()
+    with block_guard(false_blk):
+        f_outs = _as_list(false_fn())
+    if len(t_outs) != len(f_outs):
+        raise ValueError(
+            f"cond branches returned {len(t_outs)} vs {len(f_outs)} outputs"
+        )
+    for t, f in zip(t_outs, f_outs):
+        if str(t.dtype) != str(f.dtype):
+            raise TypeError(
+                f"cond branch dtype mismatch: {t.dtype} vs {f.dtype}"
+            )
+
+    captures = _collect_captures(prog, [true_blk.idx, false_blk.idx], set())
+
+    out_vars = []
+    for t, f in zip(t_outs, f_outs):
+        ov = parent.create_var(
+            name=prog._unique_name("cond_out"), shape=t.shape,
+            dtype=str(t.dtype),
+        )
+        ov.stop_gradient = t.stop_gradient and f.stop_gradient
+        out_vars.append(ov)
+
+    parent.append_op(
+        "cond",
+        {"X": [pred.name] + captures},
+        {"Out": [v.name for v in out_vars]},
+        {
+            "__true_block__": true_blk.idx,
+            "__false_block__": false_blk.idx,
+            "__true_outs__": [v.name for v in t_outs],
+            "__false_outs__": [v.name for v in f_outs],
+        },
+    )
+    return out_vars[0] if len(out_vars) == 1 else out_vars
+
+
+def scan(body, init, sequences=None, length=None, name=None):
+    """Differentiable bounded loop over time-major sequences (TPU-native).
+
+    ``body(*carries, *x_slices) -> (new_carries, y_slices)`` where
+    ``x_slices`` are per-step slices (``seq[t]``) of each sequence and
+    ``y_slices`` are per-step outputs stacked into ``[T, ...]`` results.
+    Returns ``(final_carries, stacked_ys)`` (each a list).
+
+    This is the construct to train RNN-style models with: it lowers to
+    ``lax.scan``, which XLA reverse-differentiates (the role of the
+    reference's recurrent_op / StaticRNN, fluid/layers/control_flow.py).
+    """
+    init = _as_variables(init)
+    sequences = _as_variables(sequences)
+    if not init and not sequences:
+        raise ValueError("scan needs carries and/or sequences")
+    if not sequences and length is None:
+        raise ValueError(
+            "scan without sequences needs an explicit length= (static trip "
+            "count; XLA loops are bounded)"
+        )
+    prog = default_main_program()
+    parent = prog.current_block()
+
+    body_blk = prog._create_block()
+    carry_formals = _placeholders(body_blk, init, prefix="scan_carry")
+    seq_formals = _placeholders(
+        body_blk, sequences,
+        shapes=[list(s.shape)[1:] for s in sequences], prefix="scan_x",
+    )
+    with block_guard(body_blk):
+        res = body(*carry_formals, *seq_formals)
+    if not (isinstance(res, tuple) and len(res) == 2):
+        raise TypeError(
+            "scan body must return (new_carries, y_slices); use ([], ...) "
+            "or (..., []) for empty groups"
+        )
+    new_carries, ys = _as_list(res[0]), _as_list(res[1])
+    if len(new_carries) != len(init):
+        raise ValueError(
+            f"body returned {len(new_carries)} carries, expected {len(init)}"
+        )
+
+    formal_names = {v.name for v in carry_formals} | {v.name for v in seq_formals}
+    captures = _collect_captures(prog, [body_blk.idx], formal_names)
+
+    length = sequences[0].shape[0] if sequences else int(length)
+
+    out_vars = []
+    for v in new_carries:
+        ov = parent.create_var(
+            name=prog._unique_name("scan_carry_out"), shape=v.shape,
+            dtype=str(v.dtype),
+        )
+        ov.stop_gradient = v.stop_gradient
+        out_vars.append(ov)
+    for v in ys:
+        ov = parent.create_var(
+            name=prog._unique_name("scan_y"),
+            shape=[length] + list(v.shape or []),
+            dtype=str(v.dtype),
+        )
+        ov.stop_gradient = v.stop_gradient
+        out_vars.append(ov)
+
+    parent.append_op(
+        "scan",
+        {"X": [v.name for v in init] + [v.name for v in sequences] + captures},
+        {"Out": [v.name for v in out_vars]},
+        {
+            "__body_block__": body_blk.idx,
+            "__carry_formals__": [v.name for v in carry_formals],
+            "__seq_formals__": [v.name for v in seq_formals],
+            "__carry_outs__": [v.name for v in new_carries],
+            "__y_outs__": [v.name for v in ys],
+            "__n_carry__": len(init),
+            "__n_seq__": len(sequences),
+            "__length__": None if sequences else length,
+        },
+    )
+    n_c = len(init)
+    return out_vars[:n_c], out_vars[n_c:]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """fluid.layers.case: first true predicate wins. Built on cond chains."""
+    if not pred_fn_pairs:
+        raise ValueError("case needs at least one (pred, fn) pair")
+    pred, fn = pred_fn_pairs[0]
+    rest = pred_fn_pairs[1:]
+    if rest:
+        return cond(pred, fn, lambda: case(rest, default=default))
+    if default is not None:
+        return cond(pred, fn, default)
+    return fn()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """fluid.layers.switch_case over an integer index."""
+    from .. import ops
+
+    items = sorted(branch_fns.items()) if isinstance(branch_fns, dict) else list(
+        enumerate(branch_fns)
+    )
+    pairs = [
+        (ops.equal(branch_index, np.int64(i)), fn) for i, fn in items
+    ]
+    if default is None:
+        default = items[-1][1]
+    return case(pairs, default=default)

@@ -12,6 +12,13 @@ op-boundary overhead and intermediate materialization vanish. Gradient ops
 tracing — per-op grad kernels never need hand-writing. Persistable vars
 (parameters, optimizer state, RNG-updated stats) are threaded in/out of the
 compiled function and written back to the Scope after each run.
+
+Control-flow ops (while/cond/scan, operators/controlflow/ in the reference)
+consume nested blocks and lower to lax.while_loop / lax.cond / lax.scan:
+sub-blocks are traced recursively into the same XLA module. Their grad ops
+re-trace the sub-block as a pure closure over (explicit) inputs and
+jax.vjp through it — lax.cond and lax.scan are reverse-differentiable by
+construction; lax.while_loop is not (use scan for trainable loops).
 """
 from __future__ import annotations
 
@@ -21,6 +28,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..framework import random as _random
 from ..framework.place import Place, _default_place
@@ -61,32 +69,178 @@ def global_scope() -> Scope:
     return _global_scope
 
 
-def _trace_block(block, op_list, feed_names, fetch_names, persist_in, rng_ops):
-    """Build the pure function for one block. Returns fn(feeds, persists, key)
-    -> (fetches, updated_persists)."""
+_BLOCK_OPS = ("while", "cond", "scan")
 
-    def fn(feed_arrays, persist_arrays, base_key):
-        env = {}
-        env.update(dict(zip(feed_names, feed_arrays)))
-        env.update(dict(zip(persist_in, persist_arrays)))
-        written_persist = {}
 
-        for op_index, op in enumerate(op_list):
+def _walk_ops(program, block_idx, seen=None):
+    """Yield (block, op) over a block and all nested sub-blocks."""
+    from .control_flow import BLOCK_ATTR_KEYS
+
+    if seen is None:
+        seen = set()
+    if block_idx in seen:
+        return
+    seen.add(block_idx)
+    blk = program.blocks[block_idx]
+    for op in blk.ops:
+        yield blk, op
+        for key, val in op.attrs.items():
+            if key in BLOCK_ATTR_KEYS and isinstance(val, int):
+                yield from _walk_ops(program, val, seen)
+
+
+def _op_key(base_key, op, it=None):
+    key = jax.random.fold_in(base_key, op.attrs["__rng_id__"])
+    if it is not None:
+        key = jax.random.fold_in(key, it)
+    return key
+
+
+class _BlockRunner:
+    """Traces a program's ops into jax, recursively through sub-blocks."""
+
+    def __init__(self, program):
+        self.program = program
+
+    # -- control-flow lowering ---------------------------------------------
+
+    def _run_while(self, op, env, base_key):
+        attrs = op.attrs
+        n_loop = attrs["__n_loop__"]
+        in_names = op.inputs["X"]
+        loop_in = in_names[:n_loop]
+        cond_blk = self.program.blocks[attrs["__cond_block__"]]
+        body_blk = self.program.blocks[attrs["__body_block__"]]
+
+        init = tuple(env[n] for n in loop_in)
+
+        def cond_f(carry_it):
+            _, carry = carry_it
+            sub = dict(env)
+            sub.update(zip(attrs["__cond_formals__"], carry))
+            self.exec_ops(cond_blk.ops, sub, base_key, {}, block=cond_blk)
+            pred = sub[attrs["__cond_out__"]]
+            return jnp.reshape(pred, ()).astype(bool)
+
+        def body_f(carry_it):
+            it, carry = carry_it
+            sub = dict(env)
+            sub.update(zip(attrs["__body_formals__"], carry))
+            # fold the iteration count into RNG keys so stochastic ops
+            # (sampling decoders) draw fresh randomness each step
+            self.exec_ops(body_blk.ops, sub, base_key, {}, block=body_blk,
+                          iter_idx=it)
+            return it + 1, tuple(sub[n] for n in attrs["__body_outs__"])
+
+        _, final = lax.while_loop(
+            cond_f, body_f, (jnp.asarray(0, jnp.int32), init)
+        )
+        return list(final)
+
+    def _run_cond(self, op, env, base_key):
+        attrs = op.attrs
+        pred = env[op.inputs["X"][0]]
+        true_blk = self.program.blocks[attrs["__true_block__"]]
+        false_blk = self.program.blocks[attrs["__false_block__"]]
+
+        def branch(blk, out_names):
+            def f():
+                sub = dict(env)
+                self.exec_ops(blk.ops, sub, base_key, {}, block=blk)
+                return tuple(sub[n] for n in out_names)
+            return f
+
+        outs = lax.cond(
+            jnp.reshape(pred, ()).astype(bool),
+            branch(true_blk, attrs["__true_outs__"]),
+            branch(false_blk, attrs["__false_outs__"]),
+        )
+        return list(outs)
+
+    def _run_scan(self, op, env, base_key):
+        attrs = op.attrs
+        n_c, n_s = attrs["__n_carry__"], attrs["__n_seq__"]
+        in_names = op.inputs["X"]
+        body_blk = self.program.blocks[attrs["__body_block__"]]
+
+        init = tuple(env[n] for n in in_names[:n_c])
+        seqs = tuple(env[n] for n in in_names[n_c:n_c + n_s])
+
+        def body_f(carry_it, xs):
+            it, carry = carry_it
+            sub = dict(env)
+            sub.update(zip(attrs["__carry_formals__"], carry))
+            sub.update(zip(attrs["__seq_formals__"], xs or ()))
+            self.exec_ops(body_blk.ops, sub, base_key, {}, block=body_blk,
+                          iter_idx=it)
+            new_carry = tuple(sub[n] for n in attrs["__carry_outs__"])
+            y = tuple(sub[n] for n in attrs["__y_outs__"])
+            return (it + 1, new_carry), y
+
+        (_, final), ys = lax.scan(
+            body_f, (jnp.asarray(0, jnp.int32), init),
+            seqs if seqs else None, length=attrs.get("__length__"),
+        )
+        return list(final) + list(ys)
+
+    def _block_op_closure(self, op, env, base_key):
+        """Pure fn over the op's explicit inputs, for jax.vjp (grad ops)."""
+        in_names = op.inputs["X"]
+
+        def closure(*arrays):
+            local = dict(env)
+            local.update(zip(in_names, arrays))
+            if op.type == "cond":
+                outs = self._run_cond(op, local, base_key)
+            elif op.type == "scan":
+                outs = self._run_scan(op, local, base_key)
+            else:  # while
+                outs = self._run_while(op, local, base_key)
+            return tuple(outs)
+
+        return closure
+
+    # -- main interpreter ---------------------------------------------------
+
+    def exec_ops(self, op_list, env, base_key, written_persist, block=None,
+                 iter_idx=None):
+        for op in op_list:
             in_names = op.inputs.get("X", [])
             out_names = op.outputs.get("Out", [])
             attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
 
-            if op.type.startswith("grad::"):
+            if op.type in _BLOCK_OPS:
+                results = getattr(self, f"_run_{op.type}")(op, env, base_key)
+            elif op.type.startswith("grad::"):
                 fwd_type = op.type[len("grad::"):]
-                fwd_fn = kernel(fwd_type)
                 n_in = op.attrs["__n_fwd_in__"]
                 fwd_in = [env[n] for n in in_names[:n_in]]
                 out_grad_names = in_names[n_in:]
-                f_attrs = dict(attrs)
-                f_attrs.pop("__rng__", None)
-                if op.attrs.get("__rng__"):
-                    f_attrs["key"] = jax.random.fold_in(base_key, op.attrs["__rng_id__"])
-                outs, vjp_fn = jax.vjp(partial(fwd_fn, **f_attrs), *fwd_in)
+                if fwd_type in _BLOCK_OPS:
+                    if fwd_type == "while":
+                        raise RuntimeError(
+                            "while_loop is not reverse-differentiable on "
+                            "XLA (unbounded trip count); build trainable "
+                            "loops with paddle_tpu.static.nn.scan instead"
+                        )
+                    # the grad op carries the forward op's attrs (incl. the
+                    # sub-block indices) and its input list is the forward
+                    # X — enough to rebuild the forward closure
+                    from .program import OpDesc
+
+                    fwd_op = OpDesc(
+                        fwd_type, {"X": in_names[:n_in]}, {"Out": []},
+                        op.attrs,
+                    )
+                    fwd_fn = self._block_op_closure(fwd_op, env, base_key)
+                    outs, vjp_fn = jax.vjp(fwd_fn, *fwd_in)
+                else:
+                    f_attrs = dict(attrs)
+                    f_attrs.pop("__rng__", None)
+                    if op.attrs.get("__rng__"):
+                        f_attrs["key"] = _op_key(base_key, op, iter_idx)
+                    fwd_fn = kernel(fwd_type)
+                    outs, vjp_fn = jax.vjp(partial(fwd_fn, **f_attrs), *fwd_in)
                 outs_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
                 cots = []
                 for i, o in enumerate(outs_list):
@@ -97,15 +251,20 @@ def _trace_block(block, op_list, feed_names, fetch_names, persist_in, rng_ops):
                         cots.append(jnp.zeros(o.shape, o.dtype))
                     else:
                         cots.append(np.zeros(o.shape, dtype=jax.dtypes.float0))
-                cot = tuple(cots) if len(cots) > 1 else cots[0]
+                if fwd_type in _BLOCK_OPS:
+                    cot = tuple(cots)  # closure output is always a tuple
+                else:
+                    cot = tuple(cots) if len(cots) > 1 else cots[0]
                 grads = vjp_fn(cot)
                 results = []
                 for g in grads:
-                    results.append(None if (g is None or g.dtype == jax.dtypes.float0) else g)
+                    results.append(
+                        None if (g is None or g.dtype == jax.dtypes.float0) else g
+                    )
             else:
                 f_attrs = dict(attrs)
                 if op.attrs.get("__rng__"):
-                    f_attrs["key"] = jax.random.fold_in(base_key, op.attrs["__rng_id__"])
+                    f_attrs["key"] = _op_key(base_key, op, iter_idx)
                 fn_k = kernel(op.type)
                 arrays = [env[n] for n in in_names]
                 out = fn_k(*arrays, **f_attrs)
@@ -115,9 +274,34 @@ def _trace_block(block, op_list, feed_names, fetch_names, persist_in, rng_ops):
                 if not name or value is None:
                     continue
                 env[name] = value
+                if block is None:
+                    continue
                 if block.has_var(name) and block.var(name).persistable:
+                    if block.idx != 0:
+                        # sub-block writes to persistables cannot reach the
+                        # Scope (only top-block writes are threaded out);
+                        # fail loudly instead of silently dropping the
+                        # update (e.g. batch_norm stats under cond)
+                        raise NotImplementedError(
+                            f"op {op.type!r} writes persistable var "
+                            f"{name!r} inside a control-flow sub-block; "
+                            "move the stateful update out of the "
+                            "while/cond/scan body"
+                        )
                     written_persist[name] = value
 
+
+def _trace_block(program, block, op_list, feed_names, fetch_names, persist_in):
+    """Build the pure function for the top block. Returns
+    fn(feeds, persists, key) -> (fetches, updated_persists)."""
+    runner = _BlockRunner(program)
+
+    def fn(feed_arrays, persist_arrays, base_key):
+        env = {}
+        env.update(dict(zip(feed_names, feed_arrays)))
+        env.update(dict(zip(persist_in, persist_arrays)))
+        written_persist = {}
+        runner.exec_ops(op_list, env, base_key, written_persist, block=block)
         fetches = [env[n] for n in fetch_names]
         return fetches, written_persist
 
@@ -156,23 +340,30 @@ class Executor:
                 np.asarray(v, dtype=block.var(n).dtype if block.has_var(n) else None))
             feed_arrays.append(arr)
 
-        # persistable inputs: every persistable var referenced by ops & present in scope
-        referenced = set()
-        for op in op_list:
-            referenced.update(op.inputs.get("X", []))
-            referenced.update(op.outputs.get("Out", []))
+        # persistable inputs: every persistable var referenced by any op
+        # (incl. nested control-flow blocks) & present in scope
+        referenced = {}  # name -> owning block for persistable lookup
+        for blk, op in _walk_ops(program, 0):
+            for names in list(op.inputs.values()) + list(op.outputs.values()):
+                for n in names:
+                    referenced.setdefault(n, blk)
         persist_in = sorted(
-            n for n in referenced
-            if block.has_var(n) and block.var(n).persistable and scope.has(n)
+            n for n, blk in referenced.items()
+            if blk.has_var(n) and blk.var(n).persistable and scope.has(n)
             and n not in feed_names
         )
 
-        # assign rng ids deterministically by op position
-        rng_id = 0
-        for op in op_list:
-            if op.attrs.get("__rng__"):
-                op.attrs["__rng_id__"] = rng_id
-                rng_id += 1
+        # rng ids are assigned at build time (op_append.py) so grad ops
+        # share their forward op's id; assign here only for ops that
+        # predate that (e.g. hand-built/deserialized programs)
+        next_id = 1 + max(
+            (op.attrs.get("__rng_id__", -1) for _, op in _walk_ops(program, 0)),
+            default=-1,
+        )
+        for _, op in _walk_ops(program, 0):
+            if op.attrs.get("__rng__") and "__rng_id__" not in op.attrs:
+                op.attrs["__rng_id__"] = next_id
+                next_id += 1
 
         sig = (
             id(program), program._version, tuple(fetch_names), tuple(feed_names),
@@ -181,8 +372,8 @@ class Executor:
         )
         entry = self._cache.get(sig)
         if entry is None:
-            traced = _trace_block(block, list(op_list), feed_names, fetch_names,
-                                  persist_in, rng_id)
+            traced = _trace_block(program, block, list(op_list), feed_names,
+                                  fetch_names, persist_in)
             jitted = jax.jit(traced)
             entry = (jitted, persist_in)
             self._cache[sig] = entry

@@ -90,3 +90,13 @@ def layer_norm(x, begin_norm_axis=-1, epsilon=1e-5, weight_attr=None, bias_attr=
 
 def dropout(x, dropout_prob=0.5, is_test=False):
     return ops.dropout(x, p=dropout_prob, training=not is_test)
+
+
+# -- control flow (operators/controlflow/, fluid/layers/control_flow.py) -----
+from .control_flow import (  # noqa: E402,F401
+    case,
+    cond,
+    scan,
+    switch_case,
+    while_loop,
+)

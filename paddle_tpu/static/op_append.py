@@ -39,6 +39,24 @@ def _spec_of(t):
     return jax.ShapeDtypeStruct(tuple(t._array.shape), t._array.dtype)
 
 
+def capture_constant(t, block=None):
+    """Capture an eager Tensor as a persistable constant Variable.
+
+    Globally unique across programs: two captured programs must never share
+    a constant name in the (shared) global scope.
+    """
+    prog = default_main_program()
+    block = block or prog.current_block()
+    _GLOBAL_CONST_ID[0] += 1
+    cname = prog._unique_name(f"const{_GLOBAL_CONST_ID[0]}")
+    cvar = block.create_var(name=cname, shape=list(t._array.shape),
+                            dtype=str(t._array.dtype), persistable=True)
+    if not hasattr(prog, "_constants"):
+        prog._constants = {}
+    prog._constants[cname] = np.asarray(t._array)
+    return cvar
+
+
 def append_static_op(op_type, tensors, attrs, alias_outputs=None):
     """Append an OpDesc to the current block; returns output Variable(s)."""
     block = default_main_program().current_block()
@@ -49,17 +67,7 @@ def append_static_op(op_type, tensors, attrs, alias_outputs=None):
         if isinstance(t, Variable):
             in_names.append(t.name)
         else:
-            # eager Tensor constant captured into the program
-            # globally unique across programs: two captured programs must
-            # never share a constant name in the (shared) global scope
-            _GLOBAL_CONST_ID[0] += 1
-            cname = prog._unique_name(f"const{_GLOBAL_CONST_ID[0]}")
-            cvar = block.create_var(name=cname, shape=list(t._array.shape),
-                                    dtype=str(t._array.dtype), persistable=True)
-            if not hasattr(prog, "_constants"):
-                prog._constants = {}
-            prog._constants[cname] = np.asarray(t._array)
-            in_names.append(cname)
+            in_names.append(capture_constant(t, block).name)
 
     run_attrs = dict(attrs)
     is_rng = op_type in RNG_OPS or "key" in run_attrs
@@ -104,5 +112,11 @@ def append_static_op(op_type, tensors, attrs, alias_outputs=None):
     desc_attrs = dict(run_attrs)
     if is_rng:
         desc_attrs["__rng__"] = True
+        # stable per-op id assigned at build time: the grad op copies the
+        # forward attrs, so its vjp replay folds the SAME id and reproduces
+        # the forward's dropout mask (key = fold_in(step_key, id))
+        counter = getattr(prog, "_rng_counter", 0)
+        desc_attrs["__rng_id__"] = counter
+        prog._rng_counter = counter + 1
     block.append_op(op_type, {"X": in_names}, {"Out": out_names}, desc_attrs)
     return tuple(out_vars) if multi else out_vars[0]

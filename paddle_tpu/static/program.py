@@ -246,6 +246,15 @@ class Program:
     def current_block(self) -> Block:
         return self.blocks[_current_block_idx[-1]] if _current_block_idx else self.blocks[0]
 
+    def _create_block(self, parent_idx=None) -> Block:
+        """New nested block (BlockDesc with parent, framework.proto:174) —
+        the unit consumed by control-flow ops (while/cond/scan)."""
+        parent = self.current_block().idx if parent_idx is None else parent_idx
+        blk = Block(self, len(self.blocks), parent)
+        self.blocks.append(blk)
+        self._version += 1
+        return blk
+
     def _unique_name(self, prefix):
         i = self._name_counter.get(prefix, 0)
         self._name_counter[prefix] = i + 1
@@ -271,7 +280,19 @@ class Program:
 
     # serialization ---------------------------------------------------------
     def to_dict(self):
-        return dict(blocks=[b.to_dict() for b in self.blocks], version=1)
+        d = dict(blocks=[b.to_dict() for b in self.blocks], version=1)
+        consts = getattr(self, "_constants", None)
+        if consts:
+            # captured eager constants (op_append.capture_constant) are part
+            # of the program's meaning — without them a deserialized
+            # program cannot run (every numpy literal in a control-flow
+            # body becomes one)
+            d["constants"] = {
+                k: {"__ndarray__": np.asarray(v).tolist(),
+                    "dtype": str(np.asarray(v).dtype)}
+                for k, v in consts.items()
+            }
+        return d
 
     @classmethod
     def from_dict(cls, data):
@@ -289,6 +310,11 @@ class Program:
                                v.stop_gradient, v.is_data)
                 blk.vars[v.name] = var
             blk.ops = [OpDesc.from_dict(od) for od in bd["ops"]]
+        if data.get("constants"):
+            prog._constants = {
+                k: np.asarray(v["__ndarray__"], dtype=v["dtype"])
+                for k, v in data["constants"].items()
+            }
         return prog
 
     def serialize_to_string(self) -> bytes:
@@ -323,6 +349,16 @@ def reset_default_programs():
     global _default_main_program, _default_startup_program
     _default_main_program = Program()
     _default_startup_program = Program()
+
+
+@contextlib.contextmanager
+def block_guard(block):
+    """Make ``block`` the current append target (control-flow sub-blocks)."""
+    _current_block_idx.append(block.idx)
+    try:
+        yield block
+    finally:
+        _current_block_idx.pop()
 
 
 @contextlib.contextmanager

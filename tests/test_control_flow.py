@@ -1,0 +1,239 @@
+"""Static-graph control flow tests.
+
+Reference test pattern: the reference exercises while/conditional_block via
+fluid/layers/control_flow.py tests; here we check build, execution parity
+with numpy, autodiff through cond/scan, RNN training to a decreasing loss,
+and save/load_inference_model round-trips of programs with nested blocks.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu import ops
+
+
+def setup_function(_):
+    static.reset_default_programs()
+    static.enable_static()
+
+
+def teardown_function(_):
+    static.disable_static()
+    static.reset_default_programs()
+    # per-program param names (param_0, ...) collide across tests in the
+    # shared global scope; a fresh scope mirrors the reference's fresh-Scope
+    # test pattern (test_dist_base.py style)
+    static.global_scope().clear()
+
+
+def _run(feed, fetch, program=None):
+    exe = static.Executor()
+    exe.run_startup()
+    return exe.run(program or static.default_main_program(), feed=feed,
+                   fetch_list=fetch)
+
+
+def test_while_loop_counts():
+    i = static.data("i", [], "int64")
+    limit = static.data("limit", [], "int64")
+
+    def cond_fn(i, s):
+        return ops.less_than(i, limit)
+
+    def body_fn(i, s):
+        return [ops.add(i, np.int64(1)), ops.add(s, ops.cast(i, "float32"))]
+
+    s0 = static.data("s0", [], "float32")
+    out = static.nn.while_loop(cond_fn, body_fn, [i, s0])
+    res = _run({"i": np.int64(0), "limit": np.int64(5),
+                "s0": np.float32(0)}, [out[0], out[1]])
+    assert int(res[0]) == 5
+    assert float(res[1]) == 0 + 1 + 2 + 3 + 4
+
+
+def test_while_loop_vector_state():
+    x = static.data("x", [4], "float32")
+    n = static.data("n", [], "int64")
+    i0 = static.data("i0", [], "int64")
+
+    # repeated doubling: x * 2^n
+    out = static.nn.while_loop(
+        lambda i, v: ops.less_than(i, n),
+        lambda i, v: [ops.add(i, np.int64(1)), ops.scale(v, 2.0)],
+        [i0, x],
+    )
+    xs = np.array([1.0, -2.0, 0.5, 3.0], np.float32)
+    res = _run({"x": xs, "n": np.int64(3), "i0": np.int64(0)}, [out[1]])
+    np.testing.assert_allclose(res[0], xs * 8.0)
+
+
+def test_cond_selects_branch():
+    pred = static.data("pred", [], "bool")
+    x = static.data("x", [3], "float32")
+    out = static.nn.cond(pred, lambda: ops.scale(x, 2.0),
+                         lambda: ops.scale(x, -1.0))
+    xs = np.array([1.0, 2.0, 3.0], np.float32)
+    r_t = _run({"pred": np.bool_(True), "x": xs}, [out])[0]
+    np.testing.assert_allclose(r_t, xs * 2)
+    r_f = _run({"pred": np.bool_(False), "x": xs}, [out])[0]
+    np.testing.assert_allclose(r_f, -xs)
+
+
+def test_cond_backward():
+    pred = static.data("pred", [], "bool")
+    x = static.data("x", [3], "float32")
+    x.stop_gradient = False
+    y = static.nn.cond(pred, lambda: ops.sum(ops.square(x)),
+                       lambda: ops.sum(ops.scale(x, 3.0)))
+    grads = static.gradients(y, [x])
+    xs = np.array([1.0, 2.0, 3.0], np.float32)
+    g_t = _run({"pred": np.bool_(True), "x": xs}, [grads[0]])[0]
+    np.testing.assert_allclose(g_t, 2 * xs)
+    g_f = _run({"pred": np.bool_(False), "x": xs}, [grads[0]])[0]
+    np.testing.assert_allclose(g_f, np.full(3, 3.0, np.float32))
+
+
+def test_scan_cumsum_and_backward():
+    seq = static.data("seq", [6, 2], "float32")
+    seq.stop_gradient = False
+    c0 = static.data("c0", [2], "float32")
+
+    def body(c, x):
+        nc = ops.add(c, x)
+        return [nc], [nc]
+
+    finals, ys = static.nn.scan(body, [c0], [seq])
+    loss = ops.sum(finals[0])
+    grads = static.gradients(loss, [seq])
+
+    rng = np.random.RandomState(0)
+    s = rng.randn(6, 2).astype("float32")
+    res = _run({"seq": s, "c0": np.zeros(2, np.float32)},
+               [finals[0], ys[0], grads[0]])
+    np.testing.assert_allclose(res[0], s.sum(0), rtol=1e-5)
+    np.testing.assert_allclose(res[1], np.cumsum(s, 0), rtol=1e-5)
+    np.testing.assert_allclose(res[2], np.ones_like(s))  # d(sum)/dseq = 1
+
+
+def test_scan_rnn_trains_and_roundtrips(tmp_path):
+    """RNN-style loop model: builds, trains (loss decreases), and round-trips
+    through save/load_inference_model — the verdict's done-criterion."""
+    T, B, D, H = 5, 8, 3, 16
+    seq = static.data("seq", [T, B, D], "float32")
+    target = static.data("target", [B, 1], "float32")
+
+    w_ih = static.nn.create_parameter([D, H], "float32")
+    w_hh = static.nn.create_parameter([H, H], "float32")
+    b_h = static.nn.create_parameter([H], "float32", is_bias=True)
+    w_out = static.nn.create_parameter([H, 1], "float32")
+
+    h0 = ops.zeros([B, H], "float32")
+
+    def cell(h, x):
+        nh = ops.tanh(
+            ops.add(ops.add(ops.matmul(x, w_ih), ops.matmul(h, w_hh)), b_h)
+        )
+        return [nh], []
+
+    finals, _ = static.nn.scan(cell, [h0], [seq])
+    pred = ops.matmul(finals[0], w_out)
+    loss = ops.mean(ops.square(ops.subtract(pred, target)))
+
+    opt = static.optimizer.SGD(learning_rate=0.1)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run_startup()
+    rng = np.random.RandomState(0)
+    s = rng.randn(T, B, D).astype("float32")
+    t = rng.randn(B, 1).astype("float32")
+    losses = [
+        float(exe.run(feed={"seq": s, "target": t}, fetch_list=[loss])[0])
+        for _ in range(15)
+    ]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    # inference round-trip with the nested-block program
+    path = str(tmp_path / "rnn_model")
+    static.save_inference_model(path, ["seq"], [pred], exe)
+    before = exe.run(feed={"seq": s, "target": t}, fetch_list=[pred])[0]
+
+    static.reset_default_programs()
+    static.global_scope().clear()
+    prog, feeds, fetches = static.load_inference_model(path, exe)
+    after = exe.run(prog, feed={"seq": s}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_while_program_serialization_roundtrip():
+    i = static.data("i", [], "int64")
+    n = static.data("n", [], "int64")
+    out = static.nn.while_loop(
+        lambda i: ops.less_than(i, n),
+        lambda i: [ops.add(i, np.int64(2))],
+        [i],
+    )
+    prog = static.default_main_program()
+    clone = static.Program.parse_from_string(prog.serialize_to_string())
+    assert len(clone.blocks) == len(prog.blocks)
+    # constants travel with the serialized program — the clone runs as-is
+    exe = static.Executor()
+    res = exe.run(clone, feed={"i": np.int64(1), "n": np.int64(9)},
+                  fetch_list=[out[0].name])
+    assert int(res[0]) == 9
+
+
+def test_case_and_switch_case():
+    x = static.data("x", [], "float32")
+    idx = static.data("idx", [], "int64")
+    out = static.nn.switch_case(
+        idx,
+        {0: lambda: ops.scale(x, 10.0),
+         1: lambda: ops.scale(x, 100.0),
+         2: lambda: ops.scale(x, -1.0)},
+    )
+    for i, factor in [(0, 10.0), (1, 100.0), (2, -1.0)]:
+        r = _run({"x": np.float32(2.0), "idx": np.int64(i)}, [out])[0]
+        assert float(r) == 2.0 * factor
+
+
+def test_while_grad_raises_helpfully():
+    x = static.data("x", [2], "float32")
+    x.stop_gradient = False
+    i0 = static.data("i0", [], "int64")
+    out = static.nn.while_loop(
+        lambda i, v: ops.less_than(i, np.int64(3)),
+        lambda i, v: [ops.add(i, np.int64(1)), ops.scale(v, 2.0)],
+        [i0, x],
+    )
+    loss = ops.sum(out[1])
+    # while is a gradient barrier: the loss has no path to any trainable var
+    with pytest.raises(RuntimeError, match="does not depend"):
+        static.gradients(loss, [x])
+
+
+def test_scan_carries_only_with_length():
+    c0 = static.data("c0", [], "float32")
+    finals, ys = static.nn.scan(
+        lambda c: ([ops.scale(c, 2.0)], [c]), [c0], length=4
+    )
+    res = _run({"c0": np.float32(1.0)}, [finals[0], ys[0]])
+    assert float(res[0]) == 16.0
+    np.testing.assert_allclose(res[1], [1.0, 2.0, 4.0, 8.0])
+
+
+def test_dropout_grad_mask_matches_forward():
+    """The grad op's vjp replay must reproduce the forward dropout mask:
+    d(sum(dropout(x)))/dx == 1/(1-p) exactly where the output was kept."""
+    x = static.data("x", [64], "float32")
+    x.stop_gradient = False
+    y = ops.dropout(x, p=0.5, training=True)
+    loss = ops.sum(y)
+    grads = static.gradients(loss, [x])
+    xs = np.ones(64, np.float32)
+    yv, gv = _run({"x": xs}, [y, grads[0]])
+    kept = yv != 0
+    assert 0 < kept.sum() < 64  # nondegenerate draw
+    np.testing.assert_allclose(gv[kept], 2.0)   # 1/(1-p)
+    np.testing.assert_allclose(gv[~kept], 0.0)
