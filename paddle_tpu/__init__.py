@@ -201,3 +201,4 @@ from . import jit_api as jit  # noqa: E402  (paddle.jit.to_static/save/load)
 from .hapi import Model  # noqa: E402
 from . import vision  # noqa: E402
 from . import profiler  # noqa: E402
+from . import distribution  # noqa: E402

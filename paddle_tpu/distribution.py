@@ -1,0 +1,240 @@
+"""Probability distributions.
+
+Reference parity: python/paddle/fluid/layers/distributions.py (Uniform,
+Normal, Categorical, MultivariateNormalDiag — sample/entropy/log_prob/kl)
+— rebuilt over the eager Tensor API so sampling threads through the global
+PRNG (framework/random.py) and everything is differentiable where the
+math allows (reparameterized Normal/Uniform samples).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import ops
+from .framework import random as _random
+from .framework.tensor import Tensor, to_tensor
+
+__all__ = [
+    "Distribution", "Uniform", "Normal", "Bernoulli", "Categorical",
+    "MultivariateNormalDiag", "kl_divergence",
+]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return to_tensor(np.asarray(x, dtype="float32"))
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return ops.exp(self.log_prob(value))
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """U(low, high); reparameterized sampling."""
+
+    def __init__(self, low, high):
+        self.low = _t(low)
+        self.high = _t(high)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.low.shape)
+        u = jax.random.uniform(_random.split_key(), shape, jnp.float32)
+        return Tensor._from_array(
+            self.low._array + u * (self.high._array - self.low._array)
+        )
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = ops.logical_and(
+            ops.greater_equal(value, self.low), ops.less_than(value, self.high)
+        )
+        lp = -ops.log(ops.subtract(self.high, self.low))
+        neg_inf = ops.full_like(lp, -np.inf)
+        return ops.where(inside, lp, neg_inf)
+
+    def entropy(self):
+        return ops.log(ops.subtract(self.high, self.low))
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Uniform):
+            raise TypeError("kl(Uniform || non-Uniform) unsupported")
+        return ops.log(ops.divide(
+            ops.subtract(other.high, other.low),
+            ops.subtract(self.high, self.low),
+        ))
+
+
+class Normal(Distribution):
+    """N(loc, scale); reparameterized sampling."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.loc.shape)
+        eps = jax.random.normal(_random.split_key(), shape, jnp.float32)
+        return Tensor._from_array(self.loc._array + eps * self.scale._array)
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = ops.square(self.scale)
+        return ops.subtract(
+            ops.scale(ops.divide(ops.square(ops.subtract(value, self.loc)),
+                                 var), -0.5),
+            ops.add(ops.log(self.scale),
+                    ops.full_like(self.scale, 0.5 * math.log(2 * math.pi))),
+        )
+
+    def entropy(self):
+        return ops.add(ops.log(self.scale),
+                       ops.full_like(self.scale,
+                                     0.5 * (1.0 + math.log(2 * math.pi))))
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Normal):
+            raise TypeError("kl(Normal || non-Normal) unsupported")
+        var_ratio = ops.square(ops.divide(self.scale, other.scale))
+        t1 = ops.square(ops.divide(ops.subtract(self.loc, other.loc),
+                                   other.scale))
+        return ops.scale(
+            ops.subtract(ops.add(var_ratio, t1),
+                         ops.add(ops.log(var_ratio),
+                                 ops.full_like(var_ratio, 1.0))),
+            0.5,
+        )
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs):
+        self.p = _t(probs)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.p.shape)
+        u = jax.random.uniform(_random.split_key(), shape, jnp.float32)
+        return Tensor._from_array((u < self.p._array).astype(jnp.float32))
+
+    def log_prob(self, value):
+        value = _t(value)
+        eps = 1e-8
+        return ops.add(
+            ops.multiply(value, ops.log(ops.clip(self.p, eps, 1.0))),
+            ops.multiply(
+                ops.subtract(ops.full_like(value, 1.0), value),
+                ops.log(ops.clip(ops.subtract(ops.full_like(self.p, 1.0),
+                                              self.p), eps, 1.0)),
+            ),
+        )
+
+    def entropy(self):
+        eps = 1e-8
+        q = ops.subtract(ops.full_like(self.p, 1.0), self.p)
+        return ops.scale(
+            ops.add(ops.multiply(self.p, ops.log(ops.clip(self.p, eps, 1.0))),
+                    ops.multiply(q, ops.log(ops.clip(q, eps, 1.0)))),
+            -1.0,
+        )
+
+
+class Categorical(Distribution):
+    def __init__(self, logits):
+        self.logits = _t(logits)
+
+    def _log_p(self):
+        return ops.log_softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(
+            _random.split_key(), self.logits._array, axis=-1,
+            shape=tuple(shape) + tuple(self.logits.shape[:-1]),
+        )
+        return Tensor._from_array(out)
+
+    def log_prob(self, value):
+        value = _t(value)
+        lp = self._log_p()
+        idx = ops.cast(value, "int64")
+        return ops.take_along_axis(
+            lp, ops.unsqueeze(idx, -1), axis=-1
+        ).squeeze(-1)
+
+    def entropy(self):
+        lp = self._log_p()
+        return ops.scale(ops.sum(ops.multiply(ops.exp(lp), lp), axis=-1), -1.0)
+
+    def kl_divergence(self, other):
+        if not isinstance(other, Categorical):
+            raise TypeError("kl(Categorical || non-Categorical) unsupported")
+        lp = self._log_p()
+        lq = other._log_p()
+        return ops.sum(ops.multiply(ops.exp(lp), ops.subtract(lp, lq)),
+                       axis=-1)
+
+
+class MultivariateNormalDiag(Distribution):
+    """N(loc, diag(scale^2)) (distributions.py MultivariateNormalDiag)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)  # diagonal stds [.., D]
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self.loc.shape)
+        eps = jax.random.normal(_random.split_key(), shape, jnp.float32)
+        return Tensor._from_array(self.loc._array + eps * self.scale._array)
+
+    def log_prob(self, value):
+        value = _t(value)
+        d = self.loc.shape[-1]
+        z = ops.divide(ops.subtract(value, self.loc), self.scale)
+        return ops.subtract(
+            ops.scale(ops.sum(ops.square(z), axis=-1), -0.5),
+            ops.add(ops.sum(ops.log(self.scale), axis=-1),
+                    ops.full([], 0.5 * d * math.log(2 * math.pi))),
+        )
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        return ops.add(
+            ops.sum(ops.log(self.scale), axis=-1),
+            ops.full([], 0.5 * d * (1.0 + math.log(2 * math.pi))),
+        )
+
+    def kl_divergence(self, other):
+        if not isinstance(other, MultivariateNormalDiag):
+            raise TypeError("kl between different families unsupported")
+        var_ratio = ops.square(ops.divide(self.scale, other.scale))
+        t1 = ops.square(ops.divide(ops.subtract(self.loc, other.loc),
+                                   other.scale))
+        return ops.scale(
+            ops.sum(
+                ops.subtract(ops.add(var_ratio, t1),
+                             ops.add(ops.log(var_ratio),
+                                     ops.full_like(var_ratio, 1.0))),
+                axis=-1,
+            ),
+            0.5,
+        )
+
+
+def kl_divergence(p, q):
+    return p.kl_divergence(q)

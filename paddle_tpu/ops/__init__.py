@@ -19,6 +19,13 @@ from ..framework.autograd import apply_op, no_grad  # noqa: F401
 from ..framework.dtype import convert_dtype, get_default_dtype
 from ..framework.tensor import Tensor, to_tensor
 from . import kernels as _k  # registers all kernels  # noqa: F401
+from . import beam_search as _bs  # noqa: F401
+from . import detection as _det  # noqa: F401
+from . import linalg_kernels as _la  # noqa: F401
+from . import math_extra as _mx  # noqa: F401
+from . import metrics_kernels as _mk  # noqa: F401
+from . import optimizer_kernels as _ok  # noqa: F401
+from . import sequence as _seq  # noqa: F401
 from .registry import all_ops, get_op, has_op, kernel  # noqa: F401
 
 
@@ -876,3 +883,482 @@ def bernoulli(x):
 def multinomial(x, num_samples=1, replacement=False):
     return _run("multinomial", _t(x), num_samples=num_samples, replacement=replacement,
                 key=_random.split_key())
+
+
+# -- sequence (ragged) family ------------------------------------------------
+# Dense padded [B, T, ...] + lengths [B] replaces LoD (see ops/sequence.py).
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    return _run("sequence_mask", _t(lengths), maxlen=maxlen, out_dtype=str(dtype))
+
+
+def sequence_pad(x, lengths, maxlen=None, pad_value=0.0):
+    return _run("sequence_pad", _t(x), _t(lengths), maxlen=maxlen,
+                pad_value=float(pad_value))
+
+
+def sequence_unpad(x, lengths):
+    return _run("sequence_unpad", _t(x), _t(lengths))
+
+
+def sequence_pool(x, lengths, pooltype="SUM"):
+    return _run("sequence_pool", _t(x), _t(lengths), pooltype=pooltype)
+
+
+def segment_pool(x, segment_ids, num_segments, pooltype="SUM"):
+    return _run("segment_pool", _t(x), _t(segment_ids),
+                num_segments=int(num_segments), pooltype=pooltype)
+
+
+def sequence_softmax(x, lengths):
+    return _run("sequence_softmax", _t(x), _t(lengths))
+
+
+def sequence_reverse(x, lengths):
+    return _run("sequence_reverse", _t(x), _t(lengths))
+
+
+def sequence_slice(x, offset, length, maxlen=None):
+    return _run("sequence_slice", _t(x), _t(offset), _t(length), maxlen=maxlen)
+
+
+def sequence_concat(x, xlen, y, ylen):
+    return _run("sequence_concat", _t(x), _t(xlen), _t(y), _t(ylen))
+
+
+def sequence_expand(x, rep):
+    return _run("sequence_expand", _t(x), _t(rep))
+
+
+def sequence_enumerate(x, win_size, pad_value=0):
+    return _run("sequence_enumerate", _t(x), win_size=int(win_size),
+                pad_value=pad_value)
+
+
+def sequence_erase(x, tokens=()):
+    return _run("sequence_erase", _t(x), tokens=tuple(tokens))
+
+
+def sequence_conv(x, lengths, weight, context_length, context_start=None):
+    return _run("sequence_conv", _t(x), _t(lengths), _t(weight),
+                context_length=int(context_length), context_start=context_start)
+
+
+def sequence_first_step(x, lengths):
+    return _run("sequence_first_step", _t(x), _t(lengths))
+
+
+def sequence_last_step(x, lengths):
+    return _run("sequence_last_step", _t(x), _t(lengths))
+
+
+# -- beam search -------------------------------------------------------------
+
+
+def beam_search_step(log_probs, beam_scores, beam_size, end_id=None,
+                     first_step=False):
+    return _run("beam_search_step", _t(log_probs), _t(beam_scores),
+                beam_size=int(beam_size), end_id=end_id, first_step=first_step)
+
+
+def beam_search_decode(parents, tokens, final_scores, end_id=None):
+    return _run("beam_search_decode", _t(parents), _t(tokens), _t(final_scores),
+                end_id=end_id)
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def auc(predict, label, num_thresholds=4095, stat_pos=None, stat_neg=None,
+        curve="ROC"):
+    from .registry import kernel as _kernel
+    # stats are optional arrays -> pass via attrs to keep arity fixed
+    return _run("auc", _t(predict), _t(label), num_thresholds=num_thresholds,
+                stat_pos=None if stat_pos is None else _t(stat_pos)._array,
+                stat_neg=None if stat_neg is None else _t(stat_neg)._array,
+                curve=curve)
+
+
+def precision_recall(predict, label, num_classes):
+    return _run("precision_recall", _t(predict), _t(label),
+                num_classes=int(num_classes))
+
+
+# -- detection ---------------------------------------------------------------
+
+
+def iou_similarity(x, y, box_normalized=True):
+    return _run("iou_similarity", _t(x), _t(y), box_normalized=box_normalized)
+
+
+def bbox_overlaps(x, y):
+    return _run("bbox_overlaps", _t(x), _t(y))
+
+
+def box_clip(boxes, im_info):
+    return _run("box_clip", _t(boxes), _t(im_info))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    return _run("box_coder", _t(prior_box), _t(prior_box_var), _t(target_box),
+                code_type=code_type, box_normalized=box_normalized)
+
+
+def prior_box(input, image, min_sizes, max_sizes=(), aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5):
+    return _run("prior_box", _t(input), _t(image), min_sizes=tuple(min_sizes),
+                max_sizes=tuple(max_sizes), aspect_ratios=tuple(aspect_ratios),
+                variances=tuple(variances), flip=flip, clip=clip,
+                step_w=steps[0], step_h=steps[1], offset=offset)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    return _run("yolo_box", _t(x), _t(img_size), anchors=tuple(anchors),
+                class_num=int(class_num), conf_thresh=conf_thresh,
+                downsample_ratio=downsample_ratio, clip_bbox=clip_bbox,
+                scale_x_y=scale_x_y)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=False):
+    if not isinstance(output_size, (tuple, list)):
+        output_size = (output_size, output_size)
+    return _run("roi_align", _t(x), _t(boxes), _t(boxes_num),
+                pooled_height=output_size[0], pooled_width=output_size[1],
+                spatial_scale=spatial_scale, sampling_ratio=sampling_ratio,
+                aligned=aligned)
+
+
+def nms(boxes, scores, iou_threshold=0.5, top_k=-1):
+    return _run("nms", _t(boxes), _t(scores), iou_threshold=iou_threshold,
+                top_k=top_k)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_threshold=0.3,
+                   keep_top_k=100, background_label=-1):
+    return _run("multiclass_nms", _t(bboxes), _t(scores),
+                score_threshold=score_threshold, nms_threshold=nms_threshold,
+                keep_top_k=keep_top_k, background_label=background_label)
+
+
+# -- linalg ------------------------------------------------------------------
+
+
+def det(x):
+    return _run("det", _t(x))
+
+
+def slogdet(x):
+    return _run("slogdet", _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    return _run("matrix_rank", _t(x), tol=tol, hermitian=hermitian)
+
+
+def solve(a, b):
+    return _run("solve", _t(a), _t(b))
+
+
+def triangular_solve(a, b, upper=True, transpose=False, unitriangular=False):
+    return _run("triangular_solve", _t(a), _t(b), upper=upper,
+                transpose=transpose, unitriangular=unitriangular)
+
+
+def cholesky_solve(b, l, upper=False):
+    return _run("cholesky_solve", _t(b), _t(l), upper=upper)
+
+
+def lstsq(a, b, rcond=None):
+    return _run("lstsq", _t(a), _t(b), rcond=rcond)
+
+
+def svd(x, full_matrices=False):
+    return _run("svd", _t(x), full_matrices=full_matrices)
+
+
+def qr(x, mode="reduced"):
+    return _run("qr", _t(x), mode=mode)
+
+
+def lu(x):
+    return _run("lu", _t(x))
+
+
+def eig(x):
+    return _run("eig", _t(x))
+
+
+def eigh(x, UPLO="L"):
+    return _run("eigh", _t(x), UPLO=UPLO)
+
+
+def eigvalsh(x, UPLO="L"):
+    return _run("eigvalsh", _t(x), UPLO=UPLO)
+
+
+def pinv(x, rcond=1e-15, hermitian=False):
+    return _run("pinv", _t(x), rcond=rcond, hermitian=hermitian)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return _run("matrix_norm", _t(x), p=p, axis=tuple(axis), keepdim=keepdim)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return _run("trace", _t(x), offset=offset, axis1=axis1, axis2=axis2)
+
+
+def kron(x, y):
+    return _run("kron", _t(x), _t(y))
+
+
+def cov(x, rowvar=True, ddof=True):
+    return _run("cov", _t(x), rowvar=rowvar, ddof=ddof)
+
+
+def corrcoef(x, rowvar=True):
+    return _run("corrcoef", _t(x), rowvar=rowvar)
+
+
+def householder_product(x, tau):
+    return _run("householder_product", _t(x), _t(tau))
+
+
+def multi_dot(arrays):
+    return _run("multi_dot", *[_t(a) for a in arrays])
+
+
+# -- statistics / search extras ----------------------------------------------
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return _run("std", _t(x), axis=axis, unbiased=unbiased, keepdim=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return _run("var", _t(x), axis=axis, unbiased=unbiased, keepdim=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return _run("median", _t(x), axis=axis, keepdim=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return _run("nanmedian", _t(x), axis=axis, keepdim=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return _run("quantile", _t(x), q=q, axis=axis, keepdim=keepdim,
+                interpolation=interpolation)
+
+
+def mode(x, axis=-1, keepdim=False):
+    return _run("mode", _t(x), axis=axis, keepdim=keepdim)
+
+
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    return _run("histogram", _t(x), bins=bins, min=min, max=max,
+                weight=None if weight is None else _t(weight)._array,
+                density=density)
+
+
+def bincount(x, weights=None, minlength=0, length=None):
+    return _run("bincount", _t(x),
+                weights=None if weights is None else _t(weights)._array,
+                minlength=minlength, length=length)
+
+
+def nansum(x, axis=None, keepdim=False):
+    return _run("nansum", _t(x), axis=axis, keepdim=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return _run("nanmean", _t(x), axis=axis, keepdim=keepdim)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    return _run("searchsorted", _t(sorted_sequence), _t(values),
+                out_int32=out_int32, right=right)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    out = _run("unique", _t(x), return_index=return_index,
+               return_inverse=return_inverse, return_counts=return_counts,
+               axis=axis)
+    vals, index, inverse, counts = out
+    res = [vals]
+    if return_index:
+        res.append(index)
+    if return_inverse:
+        res.append(inverse)
+    if return_counts:
+        res.append(counts)
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    out = _run("unique_consecutive", _t(x), return_inverse=return_inverse,
+               return_counts=return_counts, axis=axis)
+    vals, inverse, counts = out
+    res = [vals]
+    if return_inverse:
+        res.append(inverse)
+    if return_counts:
+        res.append(counts)
+    return res[0] if len(res) == 1 else tuple(res)
+
+
+def nonzero(x, as_tuple=False):
+    return _run("nonzero", _t(x), as_tuple=as_tuple)
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _run("allclose", _t(x), _t(y), rtol=rtol, atol=atol,
+                equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return _run("isclose", _t(x), _t(y), rtol=rtol, atol=atol,
+                equal_nan=equal_nan)
+
+
+def equal_all(x, y):
+    return _run("equal_all", _t(x), _t(y))
+
+
+# -- pointwise extras --------------------------------------------------------
+
+
+def lerp(x, y, weight):
+    return _run("lerp", _t(x), _t(y), _t(weight))
+
+
+def logit(x, eps=None):
+    return _run("logit", _t(x), eps=eps)
+
+
+def logaddexp(x, y):
+    return _run("logaddexp", _t(x), _t(y))
+
+
+def heaviside(x, y):
+    return _run("heaviside", _t(x), _t(y))
+
+
+def frac(x):
+    return _run("frac", _t(x))
+
+
+def gcd(x, y):
+    return _run("gcd", _t(x), _t(y))
+
+
+def lcm(x, y):
+    return _run("lcm", _t(x), _t(y))
+
+
+def rad2deg(x):
+    return _run("rad2deg", _t(x))
+
+
+def deg2rad(x):
+    return _run("deg2rad", _t(x))
+
+
+def diff(x, n=1, axis=-1):
+    return _run("diff", _t(x), n=n, axis=axis)
+
+
+def amax(x, axis=None, keepdim=False):
+    return _run("amax", _t(x), axis=axis, keepdim=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return _run("amin", _t(x), axis=axis, keepdim=keepdim)
+
+
+def angle(x):
+    return _run("angle", _t(x))
+
+
+def conj(x):
+    return _run("conj", _t(x))
+
+
+def real(x):
+    return _run("real", _t(x))
+
+
+def imag(x):
+    return _run("imag", _t(x))
+
+
+def as_complex(x):
+    return _run("as_complex", _t(x))
+
+
+def as_real(x):
+    return _run("as_real", _t(x))
+
+
+def nextafter(x, y):
+    return _run("nextafter", _t(x), _t(y))
+
+
+def ldexp(x, y):
+    return _run("ldexp", _t(x), _t(y))
+
+
+def copysign(x, y):
+    return _run("copysign", _t(x), _t(y))
+
+
+def hypot(x, y):
+    return _run("hypot", _t(x), _t(y))
+
+
+def i0(x):
+    return _run("i0", _t(x))
+
+
+def sinc(x):
+    return _run("sinc", _t(x))
+
+
+def signbit(x):
+    return _run("signbit", _t(x))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    return _run("label_smooth", _t(label), epsilon=epsilon,
+                prior_dist=None if prior_dist is None else _t(prior_dist)._array)
+
+
+def glu(x, axis=-1):
+    return _run("glu", _t(x), axis=axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return _run("rot90", _t(x), k=k, axes=tuple(axes))
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    return _run("pad3d", _t(x), paddings=tuple(paddings), mode=mode,
+                value=value, data_format=data_format)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    return _run("grid_sample", _t(x), _t(grid), mode=mode,
+                padding_mode=padding_mode, align_corners=align_corners)
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    return _run("affine_grid", _t(theta), out_shape=tuple(out_shape),
+                align_corners=align_corners)

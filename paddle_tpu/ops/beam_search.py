@@ -1,0 +1,64 @@
+"""Beam search ops.
+
+Reference parity: operators/beam_search_op.cc (one expansion step over
+LoD-organized candidates) + beam_search_decode_op.cc (backtrack to full
+hypotheses).
+
+TPU-native design: fixed beam width everywhere — a step is one
+top-k over [batch, beam*vocab] (MXU-free, but single fused XLA op), and
+decoding is a reverse lax.scan over stored parent pointers. No LoD: the
+batch of beams is a dense [batch, beam] lattice, finished beams are kept
+alive with a -inf continuation mask (the standard dense-beam trick on
+accelerators).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+@register_op("beam_search_step", num_outputs=3)
+def beam_search_step(log_probs, beam_scores, *, beam_size, end_id=None,
+                     first_step=False):
+    """One beam expansion.
+
+    log_probs  [batch, beam, vocab] — next-token log probabilities
+    beam_scores [batch, beam]       — running hypothesis scores
+    Returns (scores, parent_idx, token_ids), each [batch, beam].
+    """
+    b, k, v = log_probs.shape
+    total = beam_scores[:, :, None] + log_probs            # [B, K, V]
+    if first_step:
+        # all beams start identical: expand only beam 0 to avoid duplicates
+        mask = jnp.full((1, k, 1), -jnp.inf, total.dtype).at[0, 0, 0].set(0.0)
+        total = total + mask
+    flat = total.reshape(b, k * v)
+    scores, idx = lax.top_k(flat, int(beam_size))          # [B, beam]
+    parent = idx // v
+    token = idx % v
+    return scores, parent, token
+
+
+@register_op("beam_search_decode", num_outputs=2)
+def beam_search_decode(parents, tokens, final_scores, *, end_id=None):
+    """Backtrack stored pointers to token sequences.
+
+    parents/tokens [T, batch, beam] — per-step outputs of beam_search_step
+    final_scores   [batch, beam]
+    Returns (sequences [T, batch, beam], final_scores); sequences read
+    time-major, best hypothesis at beam index of max score.
+    """
+    t, b, k = tokens.shape
+    last = jnp.broadcast_to(jnp.arange(k)[None, :], (b, k))
+
+    def step(beam_idx, pt):
+        parent_t, token_t = pt
+        tok = jnp.take_along_axis(token_t, beam_idx, axis=1)   # [B, K]
+        prev = jnp.take_along_axis(parent_t, beam_idx, axis=1)
+        return prev.astype(beam_idx.dtype), tok
+
+    _, seqs = lax.scan(step, last, (parents, tokens), reverse=True)
+    return seqs, final_scores

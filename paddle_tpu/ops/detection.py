@@ -1,0 +1,336 @@
+"""Detection op family.
+
+Reference parity: paddle/fluid/operators/detection/ (iou_similarity_op,
+box_coder_op, box_clip_op, prior_box_op, yolo_box_op, roi_align_op,
+multiclass_nms_op, bipartite_match_op). Boxes are [x1, y1, x2, y2].
+
+TPU-native notes: everything except NMS is dense elementwise/gather math
+that jits directly. NMS has data-dependent output size; ``nms``/
+``multiclass_nms`` return a FIXED-size top-k list plus a validity count
+(the accelerator-friendly contract — mask, don't shrink), exact host
+semantics available eagerly via keep counts.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+def _area(boxes):
+    return jnp.maximum(boxes[..., 2] - boxes[..., 0], 0) * jnp.maximum(
+        boxes[..., 3] - boxes[..., 1], 0
+    )
+
+
+def _pairwise_iou(a, b):
+    """a [N, 4], b [M, 4] -> [N, M]."""
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _area(a)[:, None] + _area(b)[None, :] - inter
+    return jnp.where(union > 0, inter / jnp.maximum(union, 1e-10), 0.0)
+
+
+@register_op("iou_similarity")
+def iou_similarity(x, y, *, box_normalized=True):
+    """detection/iou_similarity_op.cc: pairwise IoU [N, M]."""
+    return _pairwise_iou(x, y)
+
+
+@register_op("bbox_overlaps")
+def bbox_overlaps(x, y):
+    return _pairwise_iou(x, y)
+
+
+@register_op("box_clip")
+def box_clip(boxes, im_info):
+    """detection/box_clip_op.cc: clip to image (im_info [.., (h, w, ...)])."""
+    h = im_info[..., 0:1] - 1
+    w = im_info[..., 1:2] - 1
+    x1 = jnp.clip(boxes[..., 0], 0, w[..., 0])
+    y1 = jnp.clip(boxes[..., 1], 0, h[..., 0])
+    x2 = jnp.clip(boxes[..., 2], 0, w[..., 0])
+    y2 = jnp.clip(boxes[..., 3], 0, h[..., 0])
+    return jnp.stack([x1, y1, x2, y2], axis=-1)
+
+
+@register_op("box_coder")
+def box_coder(prior_box, prior_box_var, target_box, *, code_type="encode_center_size",
+              box_normalized=True):
+    """detection/box_coder_op.cc: encode/decode boxes against priors.
+
+    encode: target [N, 4] against priors [M, 4] -> [N, M, 4] deltas
+    decode: deltas [N, M, 4] (or [N, 4] with M=N) -> boxes
+    """
+    pw = prior_box[:, 2] - prior_box[:, 0] + (0 if box_normalized else 1)
+    ph = prior_box[:, 3] - prior_box[:, 1] + (0 if box_normalized else 1)
+    pcx = prior_box[:, 0] + pw * 0.5
+    pcy = prior_box[:, 1] + ph * 0.5
+    var = prior_box_var if prior_box_var is not None else jnp.ones_like(prior_box)
+
+    if code_type.lower().startswith("encode"):
+        tw = target_box[:, 2] - target_box[:, 0] + (0 if box_normalized else 1)
+        th = target_box[:, 3] - target_box[:, 1] + (0 if box_normalized else 1)
+        tcx = target_box[:, 0] + tw * 0.5
+        tcy = target_box[:, 1] + th * 0.5
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.maximum(tw[:, None] / pw[None, :], 1e-10))
+        dh = jnp.log(jnp.maximum(th[:, None] / ph[None, :], 1e-10))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)
+        return out / var[None, :, :]
+    # decode
+    d = target_box * (var[None, :, :] if target_box.ndim == 3 else var)
+    if d.ndim == 2:
+        d = d[:, None, :]
+        squeeze = True
+    else:
+        squeeze = False
+    cx = d[..., 0] * pw[None, :] + pcx[None, :]
+    cy = d[..., 1] * ph[None, :] + pcy[None, :]
+    w = jnp.exp(d[..., 2]) * pw[None, :]
+    h = jnp.exp(d[..., 3]) * ph[None, :]
+    off = 0 if box_normalized else 0.5
+    out = jnp.stack(
+        [cx - w * 0.5, cy - h * 0.5,
+         cx + w * 0.5 - (0 if box_normalized else 1),
+         cy + h * 0.5 - (0 if box_normalized else 1)], axis=-1
+    )
+    return out[:, 0, :] if squeeze else out
+
+
+@register_op("prior_box", num_outputs=2)
+def prior_box(input, image, *, min_sizes, max_sizes=(), aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              step_w=0.0, step_h=0.0, offset=0.5, min_max_aspect_ratios_order=False):
+    """detection/prior_box_op.cc: SSD anchor boxes for one feature map.
+
+    input [N, C, H, W] feature map, image [N, C, Him, Wim]. Returns
+    (boxes [H, W, A, 4], variances [H, W, A, 4]).
+    """
+    h, w = input.shape[2], input.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    sw = float(step_w) or img_w / w
+    sh = float(step_h) or img_h / h
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []
+    for ms in min_sizes:
+        ms = float(ms)
+        for ar in ars:
+            whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            mx = float(max_sizes[list(min_sizes).index(ms)])
+            whs.append((np.sqrt(ms * mx), np.sqrt(ms * mx)))
+    whs = jnp.asarray(whs)                                    # [A, 2]
+    a = whs.shape[0]
+
+    cx = (jnp.arange(w) + float(offset)) * sw                 # [W]
+    cy = (jnp.arange(h) + float(offset)) * sh                 # [H]
+    cxg, cyg = jnp.meshgrid(cx, cy)                           # [H, W]
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    bw = whs[None, None, :, 0] / 2.0
+    bh = whs[None, None, :, 1] / 2.0
+    boxes = jnp.stack(
+        [(cxg - bw) / img_w, (cyg - bh) / img_h,
+         (cxg + bw) / img_w, (cyg + bh) / img_h], axis=-1
+    )                                                         # [H, W, A, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances), boxes.shape)
+    return boxes, var
+
+
+@register_op("yolo_box", num_outputs=2)
+def yolo_box(x, img_size, *, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """detection/yolo_box_op.cc: decode YOLOv3 head output.
+
+    x [N, A*(5+C), H, W], img_size [N, 2] (h, w). Returns
+    (boxes [N, H*W*A, 4], scores [N, H*W*A, C]).
+    """
+    n, _, h, w = x.shape
+    a = len(anchors) // 2
+    c = int(class_num)
+    x = x.reshape(n, a, 5 + c, h, w)
+    grid_x = jnp.arange(w).reshape(1, 1, 1, w)
+    grid_y = jnp.arange(h).reshape(1, 1, h, 1)
+    sxy = float(scale_x_y)
+    bias = -0.5 * (sxy - 1.0)
+    cx = (jax.nn.sigmoid(x[:, :, 0]) * sxy + bias + grid_x) / w    # [N,A,H,W]
+    cy = (jax.nn.sigmoid(x[:, :, 1]) * sxy + bias + grid_y) / h
+    anc = jnp.asarray(anchors, x.dtype).reshape(a, 2)
+    input_h = float(downsample_ratio) * h
+    input_w = float(downsample_ratio) * w
+    bw = jnp.exp(x[:, :, 2]) * anc[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * anc[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+
+    img_h = img_size[:, 0].reshape(n, 1, 1, 1).astype(x.dtype)
+    img_w = img_size[:, 1].reshape(n, 1, 1, 1).astype(x.dtype)
+    x1 = (cx - bw / 2) * img_w
+    y1 = (cy - bh / 2) * img_h
+    x2 = (cx + bw / 2) * img_w
+    y2 = (cy + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+        x2 = jnp.clip(x2, 0, img_w - 1)
+        y2 = jnp.clip(y2, 0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)             # [N,A,H,W,4]
+    keep = conf > conf_thresh
+    boxes = boxes * keep[..., None].astype(x.dtype)
+    probs = probs * keep[:, :, None].astype(x.dtype)
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, h * w * a, 4)
+    scores = probs.transpose(0, 3, 4, 2, 1).reshape(n, h * w * a, c)
+    return boxes, scores
+
+
+@register_op("roi_align")
+def roi_align(x, rois, rois_num, *, pooled_height, pooled_width,
+              spatial_scale=1.0, sampling_ratio=-1, aligned=False):
+    """detection/roi_align_op.cc: bilinear ROI pooling.
+
+    x [N, C, H, W]; rois [R, 4] in image coords; rois_num [N] rois per
+    image (defines each roi's batch index). Output [R, C, ph, pw].
+    """
+    n, c, h, w = x.shape
+    r = rois.shape[0]
+    ph, pw = int(pooled_height), int(pooled_width)
+    scale = float(spatial_scale)
+    off = 0.5 if aligned else 0.0
+
+    batch_idx = jnp.repeat(
+        jnp.arange(rois_num.shape[0]), rois_num, total_repeat_length=r
+    )
+
+    x1 = rois[:, 0] * scale - off
+    y1 = rois[:, 1] * scale - off
+    x2 = rois[:, 2] * scale - off
+    y2 = rois[:, 3] * scale - off
+    rw = x2 - x1
+    rh = y2 - y1
+    if not aligned:
+        rw = jnp.maximum(rw, 1.0)
+        rh = jnp.maximum(rh, 1.0)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    ns = int(sampling_ratio) if int(sampling_ratio) > 0 else 2
+
+    # sample grid: [R, ph, ns] y coords x [R, pw, ns] x coords
+    iy = (jnp.arange(ph)[None, :, None]
+          + (jnp.arange(ns)[None, None, :] + 0.5) / ns)
+    sy = y1[:, None, None] + iy * bin_h[:, None, None]       # [R, ph, ns]
+    ix = (jnp.arange(pw)[None, :, None]
+          + (jnp.arange(ns)[None, None, :] + 0.5) / ns)
+    sx = x1[:, None, None] + ix * bin_w[:, None, None]       # [R, pw, ns]
+
+    def bilinear(img, yy, xx):
+        """img [C, H, W]; yy [ph*ns], xx [pw*ns] -> [C, ph*ns, pw*ns]"""
+        y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1).astype(jnp.int32)
+        x1i = jnp.clip(x0 + 1, 0, w - 1).astype(jnp.int32)
+        y0i = y0.astype(jnp.int32)
+        x0i = x0.astype(jnp.int32)
+        wy1 = jnp.clip(yy - y0, 0, 1)
+        wx1 = jnp.clip(xx - x0, 0, 1)
+        wy0, wx0 = 1 - wy1, 1 - wx1
+        v00 = img[:, y0i][:, :, x0i]
+        v01 = img[:, y0i][:, :, x1i]
+        v10 = img[:, y1i][:, :, x0i]
+        v11 = img[:, y1i][:, :, x1i]
+        return (v00 * (wy0[:, None] * wx0[None, :])
+                + v01 * (wy0[:, None] * wx1[None, :])
+                + v10 * (wy1[:, None] * wx0[None, :])
+                + v11 * (wy1[:, None] * wx1[None, :]))
+
+    def per_roi(bi, yy, xx):
+        img = x[bi]                                           # [C, H, W]
+        vals = bilinear(img, yy.reshape(-1), xx.reshape(-1))  # [C, ph*ns, pw*ns]
+        vals = vals.reshape(c, ph, ns, pw, ns)
+        return vals.mean(axis=(2, 4))                         # [C, ph, pw]
+
+    return jax.vmap(per_roi)(batch_idx, sy, sx)
+
+
+@register_op("nms", num_outputs=2)
+def nms(boxes, scores, *, iou_threshold=0.5, top_k=-1):
+    """Greedy NMS with a FIXED output size: returns (keep_idx [K], num_kept)
+    where K = top_k (or N). Suppressed slots hold -1 — the accelerator
+    contract (mask, don't shrink); exact host semantics via num_kept.
+    """
+    n = boxes.shape[0]
+    k = n if top_k in (-1, None) else min(int(top_k), n)
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    iou = _pairwise_iou(boxes_s, boxes_s)
+
+    def body(i, keep):
+        # box i survives iff no higher-scoring kept box overlaps it
+        earlier = jnp.arange(n) < i
+        sup = jnp.sum(
+            jnp.where(earlier, (iou[i] > iou_threshold) & keep.astype(bool),
+                      False)
+        ) > 0
+        return keep.at[i].set(jnp.where(sup, 0, 1))
+
+    keep = lax.fori_loop(0, n, body, jnp.zeros(n, jnp.int32))
+    # compact kept entries to the front, preserving score order
+    rank = jnp.cumsum(keep) - 1
+    out = jnp.full(k, -1, jnp.int32)
+    valid = (keep.astype(bool)) & (rank < k)
+    out = out.at[jnp.where(valid, rank, k)].set(
+        jnp.where(valid, order, -1).astype(jnp.int32), mode="drop"
+    )
+    return out, jnp.minimum(jnp.sum(keep), k)
+
+
+@register_op("multiclass_nms", num_outputs=2)
+def multiclass_nms(bboxes, scores, *, score_threshold=0.05, nms_threshold=0.3,
+                   keep_top_k=100, background_label=-1):
+    """detection/multiclass_nms_op.cc with the fixed-size contract.
+
+    bboxes [N, 4]; scores [C, N]. Returns (out [keep_top_k, 6], num_kept):
+    rows are (class, score, x1, y1, x2, y2), padded rows are -1.
+    """
+    c, n = scores.shape
+    k = int(keep_top_k)
+    all_rows = []
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        s = jnp.where(scores[cls] >= score_threshold, scores[cls], -1.0)
+        keep_idx, _ = nms(bboxes, s, iou_threshold=nms_threshold, top_k=n)
+        valid = (keep_idx >= 0) & (s[jnp.clip(keep_idx, 0, n - 1)] > 0)
+        row = jnp.concatenate(
+            [jnp.full((n, 1), cls, bboxes.dtype),
+             s[jnp.clip(keep_idx, 0, n - 1)][:, None],
+             bboxes[jnp.clip(keep_idx, 0, n - 1)]], axis=1
+        )
+        row = jnp.where(valid[:, None], row, -1.0)
+        all_rows.append(row)
+    stacked = jnp.concatenate(all_rows, axis=0)
+    order = jnp.argsort(-stacked[:, 1])
+    stacked = stacked[order][:k]
+    num = jnp.sum(stacked[:, 1] > 0)
+    pad = k - stacked.shape[0]
+    if pad > 0:
+        stacked = jnp.concatenate(
+            [stacked, jnp.full((pad, 6), -1.0, stacked.dtype)], axis=0
+        )
+    return stacked, num

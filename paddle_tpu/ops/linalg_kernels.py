@@ -1,0 +1,145 @@
+"""Linear-algebra op kernels.
+
+Reference parity: the reference's linalg ops live across paddle/fluid/
+operators/ (determinant_op, svd_op (later forks), cholesky_op, matrix_rank,
+solve family) and python/paddle/tensor/linalg.py. Each kernel is the
+jnp/jax.scipy lowering — XLA ships native TPU implementations (QR/SVD/eigh
+via Jacobi kernels), so these are direct registrations, with paddle
+attr/shape conventions at the wrapper layer (ops/__init__.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("det")
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@register_op("slogdet", num_outputs=2)
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return sign, logabs
+
+
+@register_op("matrix_rank")
+def matrix_rank(x, *, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+@register_op("solve")
+def solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@register_op("triangular_solve")
+def triangular_solve(a, b, *, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        a, b, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular,
+    )
+
+
+@register_op("cholesky_solve")
+def cholesky_solve(b, l, *, upper=False):
+    return jax.scipy.linalg.cho_solve((l, not upper), b)
+
+
+@register_op("lstsq", num_outputs=4)
+def lstsq(a, b, *, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op("svd", num_outputs=3)
+def svd(x, *, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+@register_op("qr", num_outputs=2)
+def qr(x, *, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+@register_op("lu", num_outputs=3)
+def lu(x):
+    p, l, u = jax.scipy.linalg.lu(x)
+    return p, l, u
+
+
+@register_op("eig", num_outputs=2)
+def eig(x):
+    # CPU-only in XLA; TPU users should prefer eigh for symmetric inputs
+    w, v = jnp.linalg.eig(x)
+    return w, v
+
+
+@register_op("eigh", num_outputs=2)
+def eigh(x, *, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, symmetrize_input=True)
+    return w, v
+
+
+@register_op("eigvalsh")
+def eigvalsh(x, *, UPLO="L"):
+    return jnp.linalg.eigvalsh(x)
+
+
+@register_op("pinv")
+def pinv(x, *, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register_op("matrix_norm")
+def matrix_norm(x, *, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+@register_op("trace")
+def trace(x, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op("kron")
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+@register_op("cov")
+def cov(x, *, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@register_op("corrcoef")
+def corrcoef(x, *, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register_op("householder_product")
+def householder_product(x, tau):
+    """paddle.linalg.householder_product: accumulate Householder reflectors
+    (the Q factor from a packed QR): Q = H_0 H_1 ... H_{k-1}."""
+    m, n = x.shape[-2], x.shape[-1]
+
+    def apply(q, args):
+        i, = args
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[..., :, i])
+        v = v.at[i].set(1.0)
+        q = q - tau[i] * jnp.outer(v, v @ q)
+        return q, None
+
+    q = jnp.eye(m, dtype=x.dtype)
+    q, _ = jax.lax.scan(apply, q, (jnp.arange(n),))
+    return q[..., :, :n]
+
+
+@register_op("multi_dot")
+def multi_dot(*arrays):
+    return jnp.linalg.multi_dot(list(arrays))

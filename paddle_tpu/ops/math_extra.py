@@ -1,0 +1,416 @@
+"""Additional math/statistics/search op kernels.
+
+Reference parity: scattered across paddle/fluid/operators/ (e.g.
+histogram, bincount-like counting, searchsorted in later forks, isclose,
+lerp) and python/paddle/tensor/{math,stat,search,logic}.py. Direct jnp
+lowerings; ops whose OUTPUT SHAPE depends on data (unique, nonzero,
+masked_select) follow the eager-only contract with a clear error under
+tracing — the TPU-native alternative is the masked/padded form.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _eager_only(name, *arrays):
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        raise NotImplementedError(
+            f"{name} has a data-dependent output shape; call it eagerly or "
+            "use the masked/padded equivalent under jit"
+        )
+
+
+# -- statistics --------------------------------------------------------------
+
+
+@register_op("std")
+def std(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op("var")
+def var(x, *, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+@register_op("median")
+def median(x, *, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("nanmedian")
+def nanmedian(x, *, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("quantile")
+def quantile(x, *, q, axis=None, keepdim=False, interpolation="linear"):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim,
+                        method=interpolation)
+
+
+@register_op("mode", num_outputs=2)
+def mode(x, *, axis=-1, keepdim=False):
+    """Most frequent value along axis (+ its index)."""
+    def mode1d(v):
+        vals, _, counts = jnp.unique(
+            v, return_inverse=True, return_counts=True, size=v.shape[0]
+        )
+        m = vals[jnp.argmax(counts)]
+        idx = jnp.max(jnp.where(v == m, jnp.arange(v.shape[0]), -1))
+        return m, idx
+
+    moved = jnp.moveaxis(x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    m, i = jax.vmap(mode1d)(flat)
+    out_shape = moved.shape[:-1]
+    m = m.reshape(out_shape)
+    i = i.reshape(out_shape)
+    if keepdim:
+        m = jnp.expand_dims(m, axis)
+        i = jnp.expand_dims(i, axis)
+    return m, i
+
+
+@register_op("histogram")
+def histogram(x, *, bins=100, min=0, max=0, weight=None, density=False):
+    lo, hi = float(min), float(max)
+    if lo == 0 and hi == 0:
+        lo, hi = None, None
+    h, _ = jnp.histogram(
+        x.reshape(-1), bins=int(bins),
+        range=None if lo is None else (lo, hi), weights=weight,
+        density=density,
+    )
+    return h
+
+
+@register_op("bincount")
+def bincount(x, *, weights=None, minlength=0, length=None):
+    """length (static) overrides data-dependent sizing so the op jits."""
+    if length is None:
+        _eager_only("bincount (without static length=)", x)
+        length = max(int(jnp.max(x)) + 1 if x.size else 0, int(minlength))
+    return jnp.bincount(x.reshape(-1), weights=weights, length=int(length))
+
+
+@register_op("nansum")
+def nansum(x, *, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("nanmean")
+def nanmean(x, *, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+# -- search / comparison -----------------------------------------------------
+
+
+@register_op("searchsorted")
+def searchsorted(sorted_sequence, values, *, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, values,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+@register_op("unique", num_outputs=4)
+def unique(x, *, return_index=False, return_inverse=False,
+           return_counts=False, axis=None):
+    """Eager-only (data-dependent size); returns (out, index, inverse,
+    counts) — callers slice what they asked for."""
+    _eager_only("unique", x)
+    out, index, inverse, counts = np.unique(
+        np.asarray(x), return_index=True, return_inverse=True,
+        return_counts=True, axis=axis,
+    )
+    return (jnp.asarray(out), jnp.asarray(index), jnp.asarray(inverse),
+            jnp.asarray(counts))
+
+
+@register_op("unique_consecutive", num_outputs=3)
+def unique_consecutive(x, *, return_inverse=False, return_counts=False,
+                       axis=None):
+    _eager_only("unique_consecutive", x)
+    xs = np.asarray(x).reshape(-1) if axis is None else np.asarray(x)
+    keep = np.ones(xs.shape[0], bool)
+    keep[1:] = np.any(
+        xs[1:].reshape(xs.shape[0] - 1, -1)
+        != xs[:-1].reshape(xs.shape[0] - 1, -1), axis=1
+    ) if xs.ndim > 1 else xs[1:] != xs[:-1]
+    out = xs[keep]
+    grp = np.cumsum(keep) - 1
+    counts = np.bincount(grp)
+    return jnp.asarray(out), jnp.asarray(grp), jnp.asarray(counts)
+
+
+@register_op("masked_select")
+def masked_select(x, mask):
+    _eager_only("masked_select", x, mask)
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+@register_op("nonzero")
+def nonzero(x, *, as_tuple=False):
+    _eager_only("nonzero", x)
+    nz = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(i) for i in nz)
+    return jnp.asarray(np.stack(nz, axis=1))
+
+
+@register_op("allclose")
+def allclose(x, y, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("isclose")
+def isclose(x, y, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+@register_op("equal_all")
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+# -- pointwise extras --------------------------------------------------------
+
+
+@register_op("lerp")
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+@register_op("logit")
+def logit(x, *, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1 - eps)
+    return jnp.log(x / (1 - x))
+
+
+@register_op("logaddexp")
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+@register_op("heaviside")
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+@register_op("frac")
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+@register_op("gcd")
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+@register_op("lcm")
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+@register_op("rad2deg")
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+@register_op("deg2rad")
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+@register_op("diff")
+def diff(x, *, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+@register_op("amax")
+def amax(x, *, axis=None, keepdim=False):
+    return jnp.amax(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("amin")
+def amin(x, *, axis=None, keepdim=False):
+    return jnp.amin(x, axis=axis, keepdims=keepdim)
+
+
+@register_op("angle")
+def angle(x):
+    return jnp.angle(x)
+
+
+@register_op("conj")
+def conj(x):
+    return jnp.conj(x)
+
+
+@register_op("real")
+def real(x):
+    return jnp.real(x)
+
+
+@register_op("imag")
+def imag(x):
+    return jnp.imag(x)
+
+
+@register_op("as_complex")
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op("as_real")
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op("nextafter")
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+@register_op("ldexp")
+def ldexp(x, y):
+    return jnp.ldexp(x, y)
+
+
+@register_op("copysign")
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+@register_op("hypot")
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+@register_op("i0")
+def i0(x):
+    return jnp.i0(x)
+
+
+@register_op("sinc")
+def sinc(x):
+    return jnp.sinc(x)
+
+
+@register_op("signbit")
+def signbit(x):
+    return jnp.signbit(x)
+
+
+@register_op("label_smooth")
+def label_smooth(label, *, epsilon=0.1, prior_dist=None):
+    """operators/label_smooth_op.cc."""
+    c = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / c
+
+
+@register_op("glu")
+def glu(x, *, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register_op("rot90")
+def rot90(x, *, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op("expand_as")
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("pad3d")
+def pad3d(x, *, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    """operators/pad3d_op.cc: pad last three spatial dims
+    (paddings = [l, r, top, bottom, front, back])."""
+    l, r, t, b, f, bk = [int(p) for p in paddings]
+    if data_format == "NCDHW":
+        cfg = [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]
+    else:  # NDHWC
+        cfg = [(0, 0), (f, bk), (t, b), (l, r), (0, 0)]
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode=jmode, constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+@register_op("grid_sample")
+def grid_sample(x, grid, *, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """operators/grid_sampler_op.cc: sample x [N,C,H,W] at normalized grid
+    [N,Hg,Wg,2] locations (x, y in [-1, 1])."""
+    n, c, h, w = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1) / 2 * (size - 1)
+        return ((coord + 1) * size - 1) / 2
+
+    gx = unnormalize(grid[..., 0], w)                         # [N, Hg, Wg]
+    gy = unnormalize(grid[..., 1], h)
+
+    def sample(img, yy, xx):
+        """img [C,H,W], yy/xx [Hg,Wg]"""
+        if mode == "nearest":
+            yi = jnp.clip(jnp.round(yy), 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(jnp.round(xx), 0, w - 1).astype(jnp.int32)
+            vals = img[:, yi, xi]
+            if padding_mode == "zeros":
+                inb = ((yy >= 0) & (yy <= h - 1) & (xx >= 0) & (xx <= w - 1))
+                vals = vals * inb[None].astype(img.dtype)
+            return vals
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy1 = yy - y0
+        wx1 = xx - x0
+
+        def at(yi, xi):
+            yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+            xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+            v = img[:, yc, xc]
+            if padding_mode == "zeros":
+                inb = ((yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1))
+                v = v * inb[None].astype(img.dtype)
+            return v
+
+        return (at(y0, x0) * ((1 - wy1) * (1 - wx1))[None]
+                + at(y0, x0 + 1) * ((1 - wy1) * wx1)[None]
+                + at(y0 + 1, x0) * (wy1 * (1 - wx1))[None]
+                + at(y0 + 1, x0 + 1) * (wy1 * wx1)[None])
+
+    return jax.vmap(sample)(x, gy, gx)
+
+
+@register_op("affine_grid")
+def affine_grid(theta, *, out_shape, align_corners=True):
+    """operators/affine_grid_op.cc: theta [N, 2, 3] -> grid [N, H, W, 2]."""
+    n, _, h, w = [int(s) for s in out_shape]
+
+    def linspace(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = linspace(h)
+    xs = linspace(w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)                 # [H, W, 3]
+    return jnp.einsum("hwk,njk->nhwj", base, theta)

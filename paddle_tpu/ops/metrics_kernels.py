@@ -1,0 +1,85 @@
+"""Metric op kernels.
+
+Reference parity: operators/metrics/ (auc_op.cc, precision_recall_op.cc;
+accuracy_op.cc already exists in kernels.py). Streaming statistics are
+returned as arrays the caller accumulates — matching the reference's
+stat-tensor in/out design — so the ops stay pure and jittable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("auc", num_outputs=3)
+def auc(predict, label, *, num_thresholds=4095, stat_pos=None, stat_neg=None,
+        curve="ROC"):
+    """auc_op.cc: bucketed ROC-AUC.
+
+    predict [N, 2] (prob of classes 0/1) or [N] positive-class scores;
+    label [N] in {0, 1}. Returns (auc_value, stat_pos', stat_neg') where the
+    stats are per-bucket positive/negative counts (bucket = floor(p * T)).
+    Pass the previous stats back in for streaming evaluation.
+    """
+    p = predict[:, 1] if predict.ndim == 2 else predict
+    lbl = label.reshape(-1).astype(jnp.int32)
+    t = int(num_thresholds)
+    bucket = jnp.clip((p * t).astype(jnp.int32), 0, t)
+    pos = jnp.zeros(t + 1, jnp.float64 if p.dtype == jnp.float64 else jnp.float32)
+    pos = pos.at[bucket].add(lbl.astype(pos.dtype))
+    neg = jnp.zeros_like(pos).at[bucket].add((1 - lbl).astype(pos.dtype))
+    if stat_pos is not None:
+        pos = pos + stat_pos
+    if stat_neg is not None:
+        neg = neg + stat_neg
+    # integrate TPR over FPR with the trapezoid rule, descending threshold
+    tp = jnp.cumsum(pos[::-1])
+    fp = jnp.cumsum(neg[::-1])
+    tot_pos = jnp.maximum(tp[-1], 1e-12)
+    tot_neg = jnp.maximum(fp[-1], 1e-12)
+    tpr = tp / tot_pos
+    fpr = fp / tot_neg
+    area = jnp.sum(
+        (fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) / 2.0
+    ) + fpr[0] * tpr[0] / 2.0
+    return area, pos, neg
+
+
+@register_op("precision_recall", num_outputs=2)
+def precision_recall(predict, label, *, num_classes):
+    """precision_recall_op.cc: per-class and macro/micro P/R/F1.
+
+    predict [N, C] scores (argmax = predicted class) or [N] class ids;
+    label [N]. Returns:
+      per_class [C, 3]  — precision, recall, F1 per class
+      macro_micro [6]   — macro P/R/F1, micro P/R/F1
+    """
+    c = int(num_classes)
+    pred = jnp.argmax(predict, axis=-1) if predict.ndim == 2 else predict
+    pred = pred.astype(jnp.int32).reshape(-1)
+    lbl = label.astype(jnp.int32).reshape(-1)
+    f32 = jnp.float32
+
+    onehot_p = jax.nn.one_hot(pred, c, dtype=f32)
+    onehot_l = jax.nn.one_hot(lbl, c, dtype=f32)
+    tp = jnp.sum(onehot_p * onehot_l, axis=0)
+    fp = jnp.sum(onehot_p, axis=0) - tp
+    fn = jnp.sum(onehot_l, axis=0) - tp
+
+    def safe_div(a, b):
+        return jnp.where(b > 0, a / jnp.maximum(b, 1e-12), 0.0)
+
+    prec = safe_div(tp, tp + fp)
+    rec = safe_div(tp, tp + fn)
+    f1 = safe_div(2 * prec * rec, prec + rec)
+    per_class = jnp.stack([prec, rec, f1], axis=1)
+
+    macro = jnp.stack([prec.mean(), rec.mean(), f1.mean()])
+    micro_p = safe_div(tp.sum(), (tp + fp).sum())
+    micro_r = safe_div(tp.sum(), (tp + fn).sum())
+    micro_f = safe_div(2 * micro_p * micro_r, micro_p + micro_r)
+    return per_class, jnp.concatenate(
+        [macro, jnp.stack([micro_p, micro_r, micro_f])]
+    )

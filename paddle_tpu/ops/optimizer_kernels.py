@@ -1,0 +1,141 @@
+"""Optimizer update op kernels.
+
+Reference parity: paddle/fluid/operators/optimizers/ — the 17 update
+kernels (sgd_op, momentum_op + lars_momentum_op, adam_op, adamax_op,
+adagrad_op, adadelta_op, rmsprop_op, ftrl_op, lamb_op, dpsgd_op, proximal
+ops). sgd/momentum/adam already live in kernels.py; this module adds the
+rest as pure update rules: (param, grad, accumulators, lr) -> new values.
+The Python optimizer classes (optimizer/__init__.py) are the user surface;
+these ops exist so static programs and custom loops can apply the same
+math as single fused XLA kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("adamax_update", num_outputs=3)
+def adamax_update(param, grad, moment, inf_norm, lr, step, *, beta1=0.9,
+                  beta2=0.999, epsilon=1e-8):
+    """optimizers/adamax_op.cc."""
+    m = beta1 * moment + (1 - beta1) * grad
+    u = jnp.maximum(beta2 * inf_norm, jnp.abs(grad))
+    t = step.astype(param.dtype)
+    new_p = param - lr / (1 - beta1**t) * m / (u + epsilon)
+    return new_p, m, u
+
+
+@register_op("adagrad_update", num_outputs=2)
+def adagrad_update(param, grad, moment, lr, *, epsilon=1e-6):
+    """optimizers/adagrad_op.cc."""
+    g2 = moment + grad * grad
+    new_p = param - lr * grad / (jnp.sqrt(g2) + epsilon)
+    return new_p, g2
+
+
+@register_op("adadelta_update", num_outputs=3)
+def adadelta_update(param, grad, avg_squared_grad, avg_squared_update, lr,
+                    *, rho=0.95, epsilon=1e-6):
+    """optimizers/adadelta_op.cc."""
+    g2 = rho * avg_squared_grad + (1 - rho) * grad * grad
+    update = -jnp.sqrt((avg_squared_update + epsilon) / (g2 + epsilon)) * grad
+    u2 = rho * avg_squared_update + (1 - rho) * update * update
+    return param + lr * update, g2, u2
+
+
+@register_op("rmsprop_update", num_outputs=3)
+def rmsprop_update(param, grad, mean_square, moment, lr, *, rho=0.95,
+                   epsilon=1e-6, momentum=0.0, centered=False,
+                   mean_grad=None):
+    """optimizers/rmsprop_op.cc (uncentered form)."""
+    ms = rho * mean_square + (1 - rho) * grad * grad
+    mom = momentum * moment + lr * grad / jnp.sqrt(ms + epsilon)
+    return param - mom, ms, mom
+
+
+@register_op("ftrl_update", num_outputs=3)
+def ftrl_update(param, grad, squared_accum, linear_accum, lr, *, l1=0.0,
+                l2=0.0, lr_power=-0.5):
+    """optimizers/ftrl_op.cc."""
+    new_sq = squared_accum + grad * grad
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(squared_accum)) / lr
+    else:
+        sigma = (new_sq ** (-lr_power) - squared_accum ** (-lr_power)) / lr
+    new_lin = linear_accum + grad - sigma * param
+    pre = jnp.clip(new_lin, -l1, l1) - new_lin
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = new_sq ** (-lr_power) / lr + 2 * l2
+    return pre / denom, new_sq, new_lin
+
+
+@register_op("lamb_update", num_outputs=3)
+def lamb_update(param, grad, moment1, moment2, lr, step, *, beta1=0.9,
+                beta2=0.999, epsilon=1e-6, weight_decay=0.01):
+    """optimizers/lamb_op.cc: layer-adaptive moment scaling."""
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad * grad
+    t = step.astype(param.dtype)
+    mhat = m / (1 - beta1**t)
+    vhat = v / (1 - beta2**t)
+    r = mhat / (jnp.sqrt(vhat) + epsilon) + weight_decay * param
+    w_norm = jnp.linalg.norm(param)
+    r_norm = jnp.linalg.norm(r)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return param - lr * ratio * r, m, v
+
+
+@register_op("lars_momentum_update", num_outputs=2)
+def lars_momentum_update(param, grad, velocity, lr, *, mu=0.9,
+                         lars_coeff=0.001, lars_weight_decay=0.0005,
+                         epsilon=0.0):
+    """optimizers/lars_momentum_op.cc: layer-wise adaptive rate scaling."""
+    w_norm = jnp.linalg.norm(param)
+    g_norm = jnp.linalg.norm(grad)
+    local_lr = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        lars_coeff * w_norm
+        / (g_norm + lars_weight_decay * w_norm + epsilon),
+        1.0,
+    )
+    v = mu * velocity + lr * local_lr * (grad + lars_weight_decay * param)
+    return param - v, v
+
+
+@register_op("proximal_gd_update")
+def proximal_gd_update(param, grad, lr, *, l1=0.0, l2=0.0):
+    """optimizers/proximal_gd_op.cc: prox step of l1/l2-regularized GD."""
+    prox = param - lr * grad
+    if l1 > 0:
+        shrink = jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+        return jnp.sign(prox) * shrink / (1.0 + lr * l2)
+    return prox / (1.0 + lr * l2)
+
+
+@register_op("proximal_adagrad_update", num_outputs=2)
+def proximal_adagrad_update(param, grad, moment, lr, *, l1=0.0, l2=0.0):
+    """optimizers/proximal_adagrad_op.cc."""
+    g2 = moment + grad * grad
+    adapted_lr = lr / jnp.sqrt(g2)
+    prox = param - adapted_lr * grad
+    if l1 > 0:
+        shrink = jnp.maximum(jnp.abs(prox) - adapted_lr * l1, 0.0)
+        return jnp.sign(prox) * shrink / (1.0 + adapted_lr * l2), g2
+    return prox / (1.0 + adapted_lr * l2), g2
+
+
+@register_op("dpsgd_update")
+def dpsgd_update(param, grad, lr, *, clip=10.0, batch_size=16.0,
+                 sigma=1.0, key=None):
+    """optimizers/dpsgd_op.cc: differentially-private SGD — clip the grad
+    norm and add calibrated Gaussian noise."""
+    import jax
+
+    g_norm = jnp.linalg.norm(grad)
+    scale = jnp.minimum(1.0, clip / jnp.maximum(g_norm, 1e-12))
+    noise = sigma * clip * jax.random.normal(key, grad.shape, grad.dtype)
+    return param - lr * (grad * scale + noise) / batch_size

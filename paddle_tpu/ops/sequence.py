@@ -1,0 +1,276 @@
+"""Sequence (ragged) op family — the LoD replacement.
+
+Reference parity: paddle/fluid/operators/sequence_ops/ (sequence_pad, pool,
+expand, softmax, concat, reverse, slice, conv, mask, enumerate, erase,
+first/last step) over LoDTensor offsets (framework/lod_tensor.h:241).
+
+TPU-native ragged design (SURVEY.md §5/§7): XLA wants static shapes, so a
+ragged batch is represented ONE of two ways instead of LoD offsets:
+
+1. **padded + lengths** — dense ``[B, T, ...]`` plus ``lengths [B]`` (the
+   representation every op here consumes/produces). Masking against
+   ``lengths`` replaces offset arithmetic, and everything jits.
+2. **flat + segment_ids** — ``[N, ...]`` values with a ``segment_ids [N]``
+   row map, for pooling over variable rows (``segment_pool``), backed by
+   ``jax.ops.segment_*`` which lower to efficient sorted-scatter on TPU.
+
+Conversions between the reference's flat-LoD world and this one:
+``sequence_pad`` (flat+lengths -> padded), ``sequence_unpad`` (padded ->
+flat; output size is data-dependent so it is eager-only, like
+masked_select). Ops whose output *shape* depends on data (expand, erase)
+are eager-only and documented as such; everything else traces.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+_NEG_INF = -1e9
+
+
+def _time_mask(lengths, maxlen, dtype=jnp.bool_):
+    """[B, T] validity mask from lengths."""
+    t = jnp.arange(maxlen)
+    return (t[None, :] < lengths[:, None]).astype(dtype)
+
+
+@register_op("sequence_mask")
+def sequence_mask(lengths, *, maxlen=None, out_dtype="int64"):
+    """operators/sequence_ops/sequence_mask_op.cc."""
+    maxlen = int(maxlen) if maxlen is not None else int(lengths.max())
+    return _time_mask(lengths, maxlen, jnp.dtype(out_dtype))
+
+
+@register_op("sequence_pad", num_outputs=2)
+def sequence_pad(x, lengths, *, maxlen=None, pad_value=0.0):
+    """Flat [N, ...] + lengths [B] -> padded [B, maxlen, ...] + lengths.
+
+    sequence_pad_op.cc consumes LoD offsets; offsets here are cumsum of
+    lengths. Gather indices are clipped so the op stays jittable.
+    """
+    b = lengths.shape[0]
+    maxlen = int(maxlen) if maxlen is not None else int(lengths.max())
+    offsets = jnp.concatenate([jnp.zeros(1, lengths.dtype),
+                               jnp.cumsum(lengths)[:-1]])
+    idx = offsets[:, None] + jnp.arange(maxlen)[None, :]      # [B, T]
+    idx = jnp.clip(idx, 0, x.shape[0] - 1)
+    out = x[idx]                                              # [B, T, ...]
+    mask = _time_mask(lengths, maxlen)
+    mask = mask.reshape(mask.shape + (1,) * (out.ndim - 2))
+    out = jnp.where(mask, out, jnp.asarray(pad_value, out.dtype))
+    return out, lengths
+
+
+@register_op("sequence_unpad")
+def sequence_unpad(x, lengths):
+    """Padded [B, T, ...] -> flat [N, ...]. Output length is data-dependent
+    (sum of lengths) — eager-only, mirroring masked_select's contract."""
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            "sequence_unpad output shape depends on lengths; call it "
+            "eagerly or keep the padded+lengths representation under jit"
+        )
+    xs = np.asarray(x)
+    ls = np.asarray(lengths)
+    return jnp.asarray(
+        np.concatenate([xs[i, : ls[i]] for i in range(ls.shape[0])], axis=0)
+    )
+
+
+@register_op("sequence_pool")
+def sequence_pool(x, lengths, *, pooltype="SUM"):
+    """sequence_pool_op.cc over padded [B, T, ...] + lengths.
+
+    SUM/AVERAGE/SQRT/MAX/MIN/FIRST/LAST; SQRT divides by sqrt(len) (the
+    reference's scaling for attention-style pooling).
+    """
+    t = x.shape[1]
+    mask = _time_mask(lengths, t)
+    mask_e = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    pool = pooltype.upper()
+    if pool in ("SUM", "AVERAGE", "SQRT"):
+        s = jnp.sum(jnp.where(mask_e, x, 0), axis=1)
+        if pool == "SUM":
+            return s
+        denom = jnp.maximum(lengths, 1).astype(s.dtype)
+        denom = denom.reshape((-1,) + (1,) * (s.ndim - 1))
+        return s / (denom if pool == "AVERAGE" else jnp.sqrt(denom))
+    if pool == "MAX":
+        return jnp.max(jnp.where(mask_e, x, -jnp.inf), axis=1)
+    if pool == "MIN":
+        return jnp.min(jnp.where(mask_e, x, jnp.inf), axis=1)
+    if pool == "FIRST":
+        return x[:, 0]
+    if pool == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        return jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1
+        ).squeeze(1)
+    raise ValueError(f"unknown pooltype {pooltype}")
+
+
+@register_op("segment_pool")
+def segment_pool(x, segment_ids, *, num_segments, pooltype="SUM"):
+    """Flat+segment-ids pooling (the second ragged representation); lowers
+    to jax.ops.segment_* (sorted scatter — MXU/VPU friendly on TPU)."""
+    pool = pooltype.upper()
+    if pool == "SUM":
+        return jax.ops.segment_sum(x, segment_ids, num_segments)
+    if pool == "AVERAGE":
+        s = jax.ops.segment_sum(x, segment_ids, num_segments)
+        cnt = jax.ops.segment_sum(
+            jnp.ones(x.shape[0], x.dtype), segment_ids, num_segments
+        )
+        return s / jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (s.ndim - 1))
+    if pool == "MAX":
+        return jax.ops.segment_max(x, segment_ids, num_segments)
+    if pool == "MIN":
+        return jax.ops.segment_min(x, segment_ids, num_segments)
+    raise ValueError(f"unknown pooltype {pooltype}")
+
+
+@register_op("sequence_softmax")
+def sequence_softmax(x, lengths):
+    """Masked softmax over the time axis (sequence_softmax_op.cc)."""
+    mask = _time_mask(lengths, x.shape[1])
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    scores = jnp.where(mask, x, _NEG_INF)
+    w = jax.nn.softmax(scores.astype(jnp.float32), axis=1)
+    return jnp.where(mask, w, 0.0).astype(x.dtype)
+
+
+@register_op("sequence_reverse")
+def sequence_reverse(x, lengths):
+    """Reverse each valid prefix, keep padding in place
+    (sequence_reverse_op.h)."""
+    t = x.shape[1]
+    ar = jnp.arange(t)
+    # index of the element to pull: len-1-t inside the prefix, identity after
+    src = jnp.where(
+        ar[None, :] < lengths[:, None], lengths[:, None] - 1 - ar[None, :],
+        ar[None, :],
+    )
+    src = jnp.clip(src, 0, t - 1)
+    return jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1
+    )
+
+
+@register_op("sequence_slice")
+def sequence_slice(x, offset, length, *, maxlen=None):
+    """Per-sequence slice: out[b, t] = x[b, offset[b]+t] for t < length[b]
+    (sequence_slice_op.h), padded with zeros to a static maxlen."""
+    t = x.shape[1]
+    maxlen = int(maxlen) if maxlen is not None else t
+    ar = jnp.arange(maxlen)
+    src = offset.reshape(-1, 1) + ar[None, :]
+    valid = ar[None, :] < length.reshape(-1, 1)
+    src = jnp.clip(src, 0, t - 1)
+    out = jnp.take_along_axis(
+        x, src.reshape(src.shape + (1,) * (x.ndim - 2)), axis=1
+    )
+    vm = valid.reshape(valid.shape + (1,) * (x.ndim - 2))
+    return jnp.where(vm, out, 0)
+
+
+@register_op("sequence_concat", num_outputs=2)
+def sequence_concat(x, xlen, y, ylen):
+    """Concatenate two padded ragged batches along time
+    (sequence_concat_op.cc): out[b] = x[b][:xlen] ++ y[b][:ylen]."""
+    t_out = x.shape[1] + y.shape[1]
+    ar = jnp.arange(t_out)
+    in_x = ar[None, :] < xlen[:, None]
+    y_idx = ar[None, :] - xlen[:, None]
+    x_src = jnp.clip(ar[None, :] + jnp.zeros_like(xlen[:, None]), 0,
+                     x.shape[1] - 1)
+    y_src = jnp.clip(y_idx, 0, y.shape[1] - 1)
+
+    def take(v, src):
+        return jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2)), axis=1
+        )
+
+    out = jnp.where(
+        in_x.reshape(in_x.shape + (1,) * (x.ndim - 2)),
+        take(x, x_src), take(y, y_src),
+    )
+    lengths = xlen + ylen
+    mask = _time_mask(lengths, t_out)
+    out = jnp.where(mask.reshape(mask.shape + (1,) * (x.ndim - 2)), out, 0)
+    return out, lengths
+
+
+@register_op("sequence_expand")
+def sequence_expand(x, rep):
+    """Repeat row b of x rep[b] times (sequence_expand_op.cc). Output row
+    count is data-dependent — eager-only."""
+    if isinstance(x, jax.core.Tracer) or isinstance(rep, jax.core.Tracer):
+        raise NotImplementedError(
+            "sequence_expand output shape depends on rep; eager-only — "
+            "under jit use repeat_interleave with a static total"
+        )
+    return jnp.asarray(np.repeat(np.asarray(x), np.asarray(rep), axis=0))
+
+
+@register_op("sequence_enumerate")
+def sequence_enumerate(x, *, win_size, pad_value=0):
+    """All win_size windows per position (sequence_enumerate_op.cc):
+    [N] -> [N, win], padding past the end."""
+    n = x.shape[0]
+    idx = jnp.arange(n)[:, None] + jnp.arange(int(win_size))[None, :]
+    valid = idx < n
+    idx = jnp.clip(idx, 0, n - 1)
+    return jnp.where(valid, x[idx], jnp.asarray(pad_value, x.dtype))
+
+
+@register_op("sequence_erase")
+def sequence_erase(x, *, tokens=()):
+    """Remove listed tokens (sequence_erase_op.cc). Output size is
+    data-dependent — eager-only."""
+    if isinstance(x, jax.core.Tracer):
+        raise NotImplementedError(
+            "sequence_erase output shape depends on data; eager-only — "
+            "under jit mask instead of erasing"
+        )
+    xs = np.asarray(x)
+    keep = ~np.isin(xs, np.asarray(list(tokens), dtype=xs.dtype))
+    return jnp.asarray(xs[keep])
+
+
+@register_op("sequence_conv")
+def sequence_conv(x, lengths, weight, *, context_length, context_start=None):
+    """Context-window convolution over time (sequence_conv_op.cc): for each
+    step, concat [t+start, t+start+context_length) features (zeros outside
+    the valid range) and project with weight [ctx*D, M]."""
+    b, t, d = x.shape
+    start = -((context_length - 1) // 2) if context_start is None else int(
+        context_start
+    )
+    mask = _time_mask(lengths, t)
+    xm = x * mask[:, :, None].astype(x.dtype)  # zero past each length
+    cols = []
+    for k in range(int(context_length)):
+        shift = start + k
+        pos = jnp.arange(t) + shift
+        idx = jnp.clip(pos, 0, t - 1)
+        in_range = ((pos >= 0) & (pos < t))[None, :]
+        col = xm[:, idx] * in_range[:, :, None].astype(x.dtype)
+        cols.append(col)
+    ctx = jnp.concatenate(cols, axis=-1)          # [B, T, ctx*D]
+    out = jnp.einsum("btc,cm->btm", ctx, weight)
+    return out * mask[:, :, None].astype(out.dtype)
+
+
+@register_op("sequence_first_step")
+def sequence_first_step(x, lengths):
+    return sequence_pool(x, lengths, pooltype="FIRST")
+
+
+@register_op("sequence_last_step")
+def sequence_last_step(x, lengths):
+    return sequence_pool(x, lengths, pooltype="LAST")

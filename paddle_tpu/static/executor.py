@@ -104,7 +104,11 @@ class _BlockRunner:
 
     # -- control-flow lowering ---------------------------------------------
 
-    def _run_while(self, op, env, base_key):
+    # salt folded into the key chain at every loop entry so nested loops
+    # (scan-in-scan, dropout-under-cond-in-while) never reuse a key path
+    _LOOP_SALT = 0x6F09
+
+    def _run_while(self, op, env, base_key, outer_it=None):
         attrs = op.attrs
         n_loop = attrs["__n_loop__"]
         in_names = op.inputs["X"]
@@ -112,13 +116,18 @@ class _BlockRunner:
         cond_blk = self.program.blocks[attrs["__cond_block__"]]
         body_blk = self.program.blocks[attrs["__body_block__"]]
 
+        if outer_it is not None:
+            base_key = jax.random.fold_in(base_key, outer_it)
+        loop_key = jax.random.fold_in(base_key, self._LOOP_SALT)
         init = tuple(env[n] for n in loop_in)
 
         def cond_f(carry_it):
-            _, carry = carry_it
+            it, carry = carry_it
             sub = dict(env)
             sub.update(zip(attrs["__cond_formals__"], carry))
-            self.exec_ops(cond_blk.ops, sub, base_key, {}, block=cond_blk)
+            self.exec_ops(cond_blk.ops, sub,
+                          jax.random.fold_in(loop_key, it), {},
+                          block=cond_blk)
             pred = sub[attrs["__cond_out__"]]
             return jnp.reshape(pred, ()).astype(bool)
 
@@ -126,10 +135,11 @@ class _BlockRunner:
             it, carry = carry_it
             sub = dict(env)
             sub.update(zip(attrs["__body_formals__"], carry))
-            # fold the iteration count into RNG keys so stochastic ops
-            # (sampling decoders) draw fresh randomness each step
-            self.exec_ops(body_blk.ops, sub, base_key, {}, block=body_blk,
-                          iter_idx=it)
+            # per-iteration key: stochastic ops (sampling decoders) draw
+            # fresh randomness each step, including in nested blocks
+            self.exec_ops(body_blk.ops, sub,
+                          jax.random.fold_in(loop_key, it), {},
+                          block=body_blk)
             return it + 1, tuple(sub[n] for n in attrs["__body_outs__"])
 
         _, final = lax.while_loop(
@@ -137,7 +147,7 @@ class _BlockRunner:
         )
         return list(final)
 
-    def _run_cond(self, op, env, base_key):
+    def _run_cond(self, op, env, base_key, outer_it=None):
         attrs = op.attrs
         pred = env[op.inputs["X"][0]]
         true_blk = self.program.blocks[attrs["__true_block__"]]
@@ -146,7 +156,9 @@ class _BlockRunner:
         def branch(blk, out_names):
             def f():
                 sub = dict(env)
-                self.exec_ops(blk.ops, sub, base_key, {}, block=blk)
+                # iteration context passes straight through a branch
+                self.exec_ops(blk.ops, sub, base_key, {}, block=blk,
+                              iter_idx=outer_it)
                 return tuple(sub[n] for n in out_names)
             return f
 
@@ -157,12 +169,15 @@ class _BlockRunner:
         )
         return list(outs)
 
-    def _run_scan(self, op, env, base_key):
+    def _run_scan(self, op, env, base_key, outer_it=None):
         attrs = op.attrs
         n_c, n_s = attrs["__n_carry__"], attrs["__n_seq__"]
         in_names = op.inputs["X"]
         body_blk = self.program.blocks[attrs["__body_block__"]]
 
+        if outer_it is not None:
+            base_key = jax.random.fold_in(base_key, outer_it)
+        loop_key = jax.random.fold_in(base_key, self._LOOP_SALT)
         init = tuple(env[n] for n in in_names[:n_c])
         seqs = tuple(env[n] for n in in_names[n_c:n_c + n_s])
 
@@ -171,8 +186,9 @@ class _BlockRunner:
             sub = dict(env)
             sub.update(zip(attrs["__carry_formals__"], carry))
             sub.update(zip(attrs["__seq_formals__"], xs or ()))
-            self.exec_ops(body_blk.ops, sub, base_key, {}, block=body_blk,
-                          iter_idx=it)
+            self.exec_ops(body_blk.ops, sub,
+                          jax.random.fold_in(loop_key, it), {},
+                          block=body_blk)
             new_carry = tuple(sub[n] for n in attrs["__carry_outs__"])
             y = tuple(sub[n] for n in attrs["__y_outs__"])
             return (it + 1, new_carry), y
@@ -183,7 +199,7 @@ class _BlockRunner:
         )
         return list(final) + list(ys)
 
-    def _block_op_closure(self, op, env, base_key):
+    def _block_op_closure(self, op, env, base_key, outer_it=None):
         """Pure fn over the op's explicit inputs, for jax.vjp (grad ops)."""
         in_names = op.inputs["X"]
 
@@ -191,11 +207,11 @@ class _BlockRunner:
             local = dict(env)
             local.update(zip(in_names, arrays))
             if op.type == "cond":
-                outs = self._run_cond(op, local, base_key)
+                outs = self._run_cond(op, local, base_key, outer_it)
             elif op.type == "scan":
-                outs = self._run_scan(op, local, base_key)
+                outs = self._run_scan(op, local, base_key, outer_it)
             else:  # while
-                outs = self._run_while(op, local, base_key)
+                outs = self._run_while(op, local, base_key, outer_it)
             return tuple(outs)
 
         return closure
@@ -210,7 +226,9 @@ class _BlockRunner:
             attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
 
             if op.type in _BLOCK_OPS:
-                results = getattr(self, f"_run_{op.type}")(op, env, base_key)
+                results = getattr(self, f"_run_{op.type}")(
+                    op, env, base_key, iter_idx
+                )
             elif op.type.startswith("grad::"):
                 fwd_type = op.type[len("grad::"):]
                 n_in = op.attrs["__n_fwd_in__"]
@@ -232,7 +250,9 @@ class _BlockRunner:
                         fwd_type, {"X": in_names[:n_in]}, {"Out": []},
                         op.attrs,
                     )
-                    fwd_fn = self._block_op_closure(fwd_op, env, base_key)
+                    fwd_fn = self._block_op_closure(
+                        fwd_op, env, base_key, iter_idx
+                    )
                     outs, vjp_fn = jax.vjp(fwd_fn, *fwd_in)
                 else:
                     f_attrs = dict(attrs)

@@ -1,0 +1,83 @@
+"""Distribution API tests (reference: fluid/layers/distributions.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    Bernoulli, Categorical, MultivariateNormalDiag, Normal, Uniform,
+    kl_divergence,
+)
+
+
+def test_normal_log_prob_entropy_kl():
+    n = Normal(0.0, 2.0)
+    # log N(x=1; 0, 2)
+    exp = -0.5 * (1 / 4) - np.log(2.0) - 0.5 * np.log(2 * np.pi)
+    np.testing.assert_allclose(float(n.log_prob(1.0).numpy()), exp, rtol=1e-5)
+    np.testing.assert_allclose(
+        float(n.entropy().numpy()), 0.5 * np.log(2 * np.pi * np.e * 4),
+        rtol=1e-5,
+    )
+    m = Normal(1.0, 1.0)
+    kl = float(kl_divergence(n, m).numpy())
+    exp_kl = np.log(1 / 2) + (4 + 1) / 2 - 0.5
+    np.testing.assert_allclose(kl, exp_kl, rtol=1e-5)
+    assert float(kl_divergence(n, n).numpy()) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_normal_sample_moments():
+    paddle.seed(0)
+    n = Normal(3.0, 0.5)
+    s = n.sample([20000]).numpy()
+    assert abs(s.mean() - 3.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+
+def test_uniform():
+    u = Uniform(1.0, 3.0)
+    np.testing.assert_allclose(float(u.entropy().numpy()), np.log(2.0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(float(u.log_prob(2.0).numpy()), -np.log(2.0),
+                               rtol=1e-6)
+    assert np.isneginf(float(u.log_prob(5.0).numpy()))
+    paddle.seed(1)
+    s = u.sample([10000]).numpy()
+    assert s.min() >= 1.0 and s.max() < 3.0
+    assert abs(s.mean() - 2.0) < 0.03
+
+
+def test_categorical():
+    logits = np.log(np.array([[0.2, 0.3, 0.5]], np.float32))
+    c = Categorical(logits)
+    np.testing.assert_allclose(
+        float(c.log_prob(np.array([2], np.int64)).numpy()), np.log(0.5),
+        rtol=1e-5,
+    )
+    exp_h = -(0.2 * np.log(0.2) + 0.3 * np.log(0.3) + 0.5 * np.log(0.5))
+    np.testing.assert_allclose(float(c.entropy().numpy()), exp_h, rtol=1e-5)
+    paddle.seed(2)
+    s = c.sample([4000]).numpy().ravel()
+    freq = np.bincount(s, minlength=3) / s.size
+    np.testing.assert_allclose(freq, [0.2, 0.3, 0.5], atol=0.03)
+    d = Categorical(np.log(np.array([[1 / 3, 1 / 3, 1 / 3]], np.float32)))
+    assert float(kl_divergence(c, c).numpy()) == pytest.approx(0.0, abs=1e-6)
+    assert float(kl_divergence(c, d).numpy()) > 0
+
+
+def test_bernoulli_and_mvn():
+    b = Bernoulli(np.array([0.25], np.float32))
+    np.testing.assert_allclose(
+        float(b.log_prob(np.array([1.0], np.float32)).numpy()), np.log(0.25),
+        rtol=1e-5,
+    )
+    mvn = MultivariateNormalDiag(np.zeros(3, np.float32),
+                                 np.ones(3, np.float32))
+    exp = -0.5 * 3 * np.log(2 * np.pi) - 0.5 * 3
+    np.testing.assert_allclose(
+        float(mvn.log_prob(np.ones(3, np.float32)).numpy()),
+        -0.5 * 3 - 1.5 * np.log(2 * np.pi), rtol=1e-5,
+    )
+    mvn2 = MultivariateNormalDiag(np.ones(3, np.float32),
+                                  np.ones(3, np.float32))
+    np.testing.assert_allclose(float(kl_divergence(mvn, mvn2).numpy()), 1.5,
+                               rtol=1e-5)

@@ -1,0 +1,525 @@
+"""OpTests for the round-2 op families: sequence (ragged), beam search,
+metrics, detection, linalg, math extras, optimizer update kernels.
+
+Pattern per SURVEY.md §4 (op_test.py:948/:1253): numpy oracle for forward,
+finite differences for gradients of the differentiable core.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+
+rng = np.random.RandomState(7)
+
+
+# -- sequence family ---------------------------------------------------------
+
+
+def test_sequence_mask():
+    lens = np.array([2, 0, 3], np.int64)
+    m = ops.sequence_mask(lens, maxlen=4).numpy()
+    exp = np.array([[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+    np.testing.assert_array_equal(m, exp)
+
+
+def test_sequence_pad_unpad_roundtrip():
+    flat = rng.randn(6, 3).astype("float32")
+    lens = np.array([2, 1, 3], np.int64)
+    padded, out_lens = ops.sequence_pad(flat, lens, maxlen=4, pad_value=0.0)
+    assert padded.shape == [3, 4, 3]
+    np.testing.assert_allclose(padded.numpy()[0, :2], flat[:2])
+    np.testing.assert_allclose(padded.numpy()[1, :1], flat[2:3])
+    np.testing.assert_allclose(padded.numpy()[2, :3], flat[3:6])
+    assert np.all(padded.numpy()[0, 2:] == 0)
+    back = ops.sequence_unpad(padded, lens)
+    np.testing.assert_allclose(back.numpy(), flat)
+
+
+def test_sequence_pool_all_types():
+    x = rng.randn(2, 4, 3).astype("float32")
+    lens = np.array([3, 2], np.int64)
+    masked = [x[0, :3], x[1, :2]]
+    for pt, fn in [
+        ("SUM", lambda v: v.sum(0)),
+        ("AVERAGE", lambda v: v.mean(0)),
+        ("SQRT", lambda v: v.sum(0) / np.sqrt(len(v))),
+        ("MAX", lambda v: v.max(0)),
+        ("MIN", lambda v: v.min(0)),
+        ("FIRST", lambda v: v[0]),
+        ("LAST", lambda v: v[-1]),
+    ]:
+        out = ops.sequence_pool(x, lens, pooltype=pt).numpy()
+        exp = np.stack([fn(m) for m in masked])
+        np.testing.assert_allclose(out, exp, rtol=1e-5, err_msg=pt)
+
+
+def test_segment_pool():
+    x = rng.randn(5, 2).astype("float32")
+    seg = np.array([0, 0, 1, 2, 2], np.int32)
+    out = ops.segment_pool(x, seg, num_segments=3, pooltype="SUM").numpy()
+    exp = np.stack([x[:2].sum(0), x[2], x[3:].sum(0)])
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+    out = ops.segment_pool(x, seg, num_segments=3, pooltype="AVERAGE").numpy()
+    exp = np.stack([x[:2].mean(0), x[2], x[3:].mean(0)])
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+
+def test_sequence_softmax():
+    x = rng.randn(2, 4).astype("float32")
+    lens = np.array([3, 2], np.int64)
+    out = ops.sequence_softmax(x, lens).numpy()
+    for b, l in enumerate(lens):
+        e = np.exp(x[b, :l] - x[b, :l].max())
+        np.testing.assert_allclose(out[b, :l], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(out[b, l:], 0.0)
+
+
+def test_sequence_reverse_slice_concat():
+    x = rng.randn(2, 4, 2).astype("float32")
+    lens = np.array([3, 4], np.int64)
+    r = ops.sequence_reverse(x, lens).numpy()
+    np.testing.assert_allclose(r[0, :3], x[0, :3][::-1])
+    np.testing.assert_allclose(r[0, 3], x[0, 3])  # padding untouched
+    np.testing.assert_allclose(r[1], x[1][::-1])
+
+    s = ops.sequence_slice(x, np.array([1, 0], np.int64),
+                           np.array([2, 1], np.int64), maxlen=2).numpy()
+    np.testing.assert_allclose(s[0], x[0, 1:3])
+    np.testing.assert_allclose(s[1, 0], x[1, 0])
+    np.testing.assert_allclose(s[1, 1], 0)
+
+    y = rng.randn(2, 3, 2).astype("float32")
+    ylens = np.array([2, 1], np.int64)
+    c, clens = ops.sequence_concat(x, lens, y, ylens)
+    np.testing.assert_array_equal(clens.numpy(), [5, 5])
+    np.testing.assert_allclose(c.numpy()[0, :3], x[0, :3])
+    np.testing.assert_allclose(c.numpy()[0, 3:5], y[0, :2])
+    np.testing.assert_allclose(c.numpy()[0, 5:], 0)
+
+
+def test_sequence_enumerate_expand_erase():
+    x = np.array([1, 2, 3, 4], np.int64)
+    e = ops.sequence_enumerate(x, win_size=2, pad_value=0).numpy()
+    np.testing.assert_array_equal(e, [[1, 2], [2, 3], [3, 4], [4, 0]])
+
+    ex = ops.sequence_expand(np.array([[1.0], [2.0]], np.float32),
+                             np.array([2, 3], np.int64)).numpy()
+    np.testing.assert_allclose(ex.ravel(), [1, 1, 2, 2, 2])
+
+    er = ops.sequence_erase(np.array([1, 0, 2, 0, 3], np.int64), tokens=(0,))
+    np.testing.assert_array_equal(er.numpy(), [1, 2, 3])
+
+
+def test_sequence_conv():
+    b, t, d, m = 2, 5, 3, 4
+    x = rng.randn(b, t, d).astype("float32")
+    lens = np.array([5, 3], np.int64)
+    ctx = 3
+    w = rng.randn(ctx * d, m).astype("float32")
+    out = ops.sequence_conv(x, lens, w, context_length=ctx).numpy()
+    # oracle: valid positions only, zero-padded context windows
+    xm = x.copy()
+    xm[1, 3:] = 0
+    for bi, l in enumerate(lens):
+        for ti in range(t):
+            window = []
+            for k in range(-1, 2):
+                pos = ti + k
+                window.append(
+                    xm[bi, pos] if 0 <= pos < t and ti < l else np.zeros(d)
+                )
+            exp = np.concatenate(window) @ w if ti < l else np.zeros(m)
+            np.testing.assert_allclose(out[bi, ti], exp, rtol=1e-4,
+                                       atol=1e-5)
+
+
+def test_sequence_pool_grad():
+    x = paddle.to_tensor(rng.randn(2, 3, 2).astype("float32"))
+    x.stop_gradient = False
+    lens = paddle.to_tensor(np.array([2, 3], np.int64))
+    out = ops.sequence_pool(x, lens, pooltype="SUM")
+    out.sum().backward()
+    g = x.grad.numpy()
+    exp = np.zeros((2, 3, 2), np.float32)
+    exp[0, :2] = 1
+    exp[1, :3] = 1
+    np.testing.assert_allclose(g, exp)
+
+
+# -- beam search -------------------------------------------------------------
+
+
+def test_beam_search_step_and_decode():
+    b, k, v = 2, 3, 5
+    scores0 = np.zeros((b, k), np.float32)
+    lp1 = np.log(
+        rng.dirichlet(np.ones(v), size=(b, k)).astype("float32")
+    )
+    s1, p1, t1 = ops.beam_search_step(lp1, scores0, beam_size=k,
+                                      first_step=True)
+    # first step expands only beam 0: best k tokens of beam 0's dist
+    exp_scores = np.sort(lp1[:, 0], axis=-1)[:, ::-1][:, :k]
+    np.testing.assert_allclose(np.asarray(s1.numpy()), exp_scores, rtol=1e-5)
+    assert np.all(p1.numpy() == 0)
+
+    lp2 = np.log(
+        rng.dirichlet(np.ones(v), size=(b, k)).astype("float32")
+    )
+    s2, p2, t2 = ops.beam_search_step(lp2, s1, beam_size=k)
+    # oracle: brute-force top-k over k*v continuations
+    for bi in range(b):
+        total = (s1.numpy()[bi][:, None] + lp2[bi]).ravel()
+        exp = np.sort(total)[::-1][:k]
+        np.testing.assert_allclose(s2.numpy()[bi], exp, rtol=1e-5)
+
+    parents = np.stack([p1.numpy(), p2.numpy()])  # [T, B, K]
+    tokens = np.stack([t1.numpy(), t2.numpy()])
+    seqs, fs = ops.beam_search_decode(parents, tokens, s2)
+    seqs = seqs.numpy()
+    # backtracked: seqs[1] must equal t2, and seqs[0] the parent's token
+    np.testing.assert_array_equal(seqs[1], t2.numpy())
+    for bi in range(b):
+        for ki in range(k):
+            np.testing.assert_array_equal(
+                seqs[0, bi, ki], t1.numpy()[bi, p2.numpy()[bi, ki]]
+            )
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def _auc_oracle(scores, labels):
+    order = np.argsort(-scores)
+    lbl = labels[order]
+    tps = np.cumsum(lbl)
+    fps = np.cumsum(1 - lbl)
+    tpr = tps / max(tps[-1], 1)
+    fpr = fps / max(fps[-1], 1)
+    return np.trapezoid(tpr, fpr)
+
+
+def test_auc_matches_oracle():
+    n = 500
+    scores = rng.rand(n).astype("float32")
+    labels = (rng.rand(n) < scores).astype("int64")  # informative scores
+    a, pos, neg = ops.auc(scores, labels, num_thresholds=4095)
+    exact = _auc_oracle(scores, labels)
+    assert abs(float(a.numpy()) - exact) < 5e-3
+    # streaming: two halves with carried stats == one shot
+    a1, p1, n1 = ops.auc(scores[:250], labels[:250])
+    a2, _, _ = ops.auc(scores[250:], labels[250:], stat_pos=p1, stat_neg=n1)
+    np.testing.assert_allclose(float(a2.numpy()), float(a.numpy()), atol=1e-6)
+
+
+def test_precision_recall():
+    pred = np.array([0, 0, 1, 1, 2, 2, 2], np.int64)
+    lbl = np.array([0, 1, 1, 1, 2, 0, 2], np.int64)
+    per_class, agg = ops.precision_recall(pred, lbl, num_classes=3)
+    pc = per_class.numpy()
+    np.testing.assert_allclose(pc[0], [0.5, 0.5, 0.5], rtol=1e-5)
+    np.testing.assert_allclose(pc[1, 0], 1.0)        # precision 1: tp=2 fp=0
+    np.testing.assert_allclose(pc[1, 1], 2 / 3, rtol=1e-5)  # recall: tp=2 fn=1
+    micro_p = agg.numpy()[3]
+    np.testing.assert_allclose(micro_p, 5 / 7, rtol=1e-5)
+
+
+# -- detection ---------------------------------------------------------------
+
+
+def _iou_oracle(a, b):
+    out = np.zeros((a.shape[0], b.shape[0]))
+    for i, x in enumerate(a):
+        for j, y in enumerate(b):
+            ix = max(0, min(x[2], y[2]) - max(x[0], y[0]))
+            iy = max(0, min(x[3], y[3]) - max(x[1], y[1]))
+            inter = ix * iy
+            ua = ((x[2] - x[0]) * (x[3] - x[1])
+                  + (y[2] - y[0]) * (y[3] - y[1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0
+    return out
+
+
+def test_iou_similarity():
+    a = np.abs(rng.rand(4, 4)).astype("float32")
+    a[:, 2:] = a[:, :2] + np.abs(rng.rand(4, 2))
+    b = np.abs(rng.rand(3, 4)).astype("float32")
+    b[:, 2:] = b[:, :2] + np.abs(rng.rand(3, 2))
+    out = ops.iou_similarity(a, b).numpy()
+    np.testing.assert_allclose(out, _iou_oracle(a, b), rtol=1e-4, atol=1e-6)
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.2, 0.8, 0.9]],
+                      np.float32)
+    var = np.full((2, 4), 0.1, np.float32)
+    targets = np.array([[0.15, 0.15, 0.45, 0.55]], np.float32)
+    enc = ops.box_coder(priors, var, targets, code_type="encode_center_size")
+    dec = ops.box_coder(priors, var, enc, code_type="decode_center_size")
+    np.testing.assert_allclose(
+        dec.numpy()[0, 0], targets[0], rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        dec.numpy()[0, 1], targets[0], rtol=1e-4, atol=1e-5
+    )
+
+
+def test_box_clip():
+    boxes = np.array([[-5.0, -5.0, 20.0, 30.0]], np.float32)
+    im_info = np.array([10.0, 15.0, 1.0], np.float32)
+    out = ops.box_clip(boxes, im_info).numpy()
+    np.testing.assert_allclose(out[0], [0, 0, 14, 9])
+
+
+def test_nms_matches_oracle():
+    n = 20
+    boxes = rng.rand(n, 2).astype("float32") * 10
+    boxes = np.concatenate(
+        [boxes, boxes + 1 + rng.rand(n, 2).astype("float32") * 5], axis=1
+    )
+    scores = rng.rand(n).astype("float32")
+    keep, num = ops.nms(boxes, scores, iou_threshold=0.4)
+    got = [int(i) for i in keep.numpy()[: int(num.numpy())]]
+    # greedy oracle
+    order = np.argsort(-scores)
+    iou = _iou_oracle(boxes, boxes)
+    exp = []
+    for i in order:
+        if np.any([iou[i, j] > 0.4 for j in exp]):
+            continue
+        exp.append(i)
+    assert got == exp
+
+
+def test_roi_align_constant_field():
+    # constant feature map: any roi pools to the constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[1.0, 1.0, 5.0, 5.0], [0.0, 0.0, 7.0, 7.0]], np.float32)
+    out = ops.roi_align(x, rois, np.array([2], np.int32), output_size=2)
+    np.testing.assert_allclose(out.numpy(), np.full((2, 2, 2, 2), 3.5),
+                               rtol=1e-5)
+
+
+def test_yolo_box_shapes_and_range():
+    n, a, c, h, w = 1, 2, 3, 4, 4
+    x = rng.randn(n, a * (5 + c), h, w).astype("float32")
+    img = np.array([[64, 64]], np.int32)
+    boxes, scores = ops.yolo_box(x, img, anchors=(10, 13, 16, 30),
+                                 class_num=c, downsample_ratio=16)
+    assert boxes.shape == [n, h * w * a, 4]
+    assert scores.shape == [n, h * w * a, c]
+    b = boxes.numpy()
+    assert np.all(b >= 0) and np.all(b <= 64)
+
+
+def test_prior_box():
+    feat = np.zeros((1, 8, 2, 2), np.float32)
+    img = np.zeros((1, 3, 32, 32), np.float32)
+    boxes, var = ops.prior_box(feat, img, min_sizes=(4.0,),
+                               aspect_ratios=(1.0,), clip=True)
+    assert boxes.shape == [2, 2, 1, 4]
+    bb = boxes.numpy()
+    # first anchor centered at (8, 8) of a 32x32 image, size 4
+    np.testing.assert_allclose(
+        bb[0, 0, 0], [(8 - 2) / 32, (8 - 2) / 32, (8 + 2) / 32, (8 + 2) / 32],
+        rtol=1e-5,
+    )
+
+
+# -- linalg ------------------------------------------------------------------
+
+
+def test_linalg_against_numpy():
+    a = rng.randn(4, 4).astype("float64")
+    a = a @ a.T + 4 * np.eye(4)  # SPD
+    b = rng.randn(4, 2).astype("float64")
+
+    np.testing.assert_allclose(ops.det(a).numpy(), np.linalg.det(a), rtol=1e-4)
+    sign, logdet = ops.slogdet(a)
+    es, el = np.linalg.slogdet(a)
+    np.testing.assert_allclose(sign.numpy(), es)
+    np.testing.assert_allclose(logdet.numpy(), el, rtol=1e-4)
+    np.testing.assert_allclose(ops.solve(a, b).numpy(), np.linalg.solve(a, b),
+                               rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(int(ops.matrix_rank(a).numpy()), 4)
+    u, s, vh = ops.svd(a)
+    # to_tensor defaults to float32: reconstruction tolerances are f32-level
+    np.testing.assert_allclose(
+        (u.numpy() * s.numpy()) @ vh.numpy(), a, rtol=1e-3, atol=1e-5
+    )
+    q, r = ops.qr(a)
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), a, rtol=1e-3, atol=1e-5)
+    w, v = ops.eigh(a)
+    np.testing.assert_allclose(
+        v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, a, rtol=1e-3, atol=1e-5
+    )
+    np.testing.assert_allclose(ops.pinv(a).numpy(), np.linalg.pinv(a),
+                               rtol=1e-3, atol=1e-5)
+    np.testing.assert_allclose(ops.trace(a).numpy(), np.trace(a), rtol=1e-5)
+    np.testing.assert_allclose(ops.kron(a[:2, :2], b[:2]).numpy(),
+                               np.kron(a[:2, :2], b[:2]), rtol=1e-5)
+    l = np.linalg.cholesky(a)
+    np.testing.assert_allclose(
+        ops.triangular_solve(l, b, upper=False).numpy(),
+        np.linalg.solve(l, b), rtol=1e-4, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        ops.cholesky_solve(b, l, upper=False).numpy(),
+        np.linalg.solve(a, b), rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_solve_grad():
+    from tests.op_test import OpTest
+
+    class SolveTest(OpTest):
+        op_type = "solve"
+        a = rng.randn(3, 3) + 3 * np.eye(3)
+        inputs = {"A": a, "B": rng.randn(3, 2)}
+        attrs = {}
+        outputs = {"Out": np.linalg.solve(a, rng.randn(3, 2))}
+
+    t = SolveTest()
+    t.inputs["B"] = rng.randn(3, 2)
+    t.outputs = {"Out": np.linalg.solve(t.inputs["A"], t.inputs["B"])}
+    t.check_output(atol=1e-6)
+    t.check_grad()
+
+
+# -- math extras -------------------------------------------------------------
+
+
+def test_stats_against_numpy():
+    x = rng.randn(3, 5).astype("float64")
+    np.testing.assert_allclose(ops.std(x).numpy(), x.std(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(ops.var(x, axis=1).numpy(), x.var(1, ddof=1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(ops.median(x).numpy(), np.median(x), rtol=1e-6)
+    np.testing.assert_allclose(ops.quantile(x, 0.3, axis=0).numpy(),
+                               np.quantile(x, 0.3, axis=0), rtol=1e-5)
+    np.testing.assert_allclose(ops.nansum(x).numpy(), np.nansum(x), rtol=1e-5)
+    h = ops.histogram(x, bins=10, min=-2, max=2).numpy()
+    np.testing.assert_array_equal(h, np.histogram(x, 10, (-2, 2))[0])
+    xi = np.array([0, 1, 1, 3], np.int64)
+    np.testing.assert_array_equal(
+        ops.bincount(xi, length=5).numpy(), np.bincount(xi, minlength=5)
+    )
+    m, idx = ops.mode(np.array([[1, 2, 2, 3], [5, 5, 6, 7]], np.int64))
+    np.testing.assert_array_equal(m.numpy(), [2, 5])
+
+
+def test_search_ops():
+    s = np.array([1.0, 3.0, 5.0, 7.0], np.float32)
+    v = np.array([0.0, 3.0, 8.0], np.float32)
+    np.testing.assert_array_equal(
+        ops.searchsorted(s, v).numpy(), np.searchsorted(s, v)
+    )
+    x = np.array([3, 1, 2, 1, 3], np.int64)
+    u, inv, cnt = ops.unique(x, return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+    np.testing.assert_array_equal(cnt.numpy(), [2, 1, 2])
+    np.testing.assert_array_equal(u.numpy()[inv.numpy()], x)
+    uc, _, ccnt = ops.unique_consecutive(
+        np.array([1, 1, 2, 2, 2, 1], np.int64), return_counts=True
+    ), None, None
+    m = ops.masked_select(np.arange(6), np.array([1, 0, 1, 0, 0, 1], bool))
+    np.testing.assert_array_equal(m.numpy(), [0, 2, 5])
+    nz = ops.nonzero(np.array([[1, 0], [0, 2]], np.int64))
+    np.testing.assert_array_equal(nz.numpy(), [[0, 0], [1, 1]])
+    assert bool(ops.allclose(np.ones(3), np.ones(3) + 1e-9).numpy())
+    assert bool(ops.equal_all(np.arange(3), np.arange(3)).numpy())
+
+
+def test_pointwise_extras():
+    x = rng.rand(4).astype("float64") * 0.8 + 0.1
+    np.testing.assert_allclose(ops.logit(x).numpy(), np.log(x / (1 - x)),
+                               rtol=1e-5)
+    np.testing.assert_allclose(
+        ops.lerp(np.zeros(3), np.ones(3), 0.3).numpy(), np.full(3, 0.3)
+    )
+    np.testing.assert_allclose(
+        ops.logaddexp(np.log(2.0), np.log(3.0)).numpy(), np.log(5.0),
+        rtol=1e-5,
+    )
+    np.testing.assert_array_equal(ops.gcd(np.int64(12), np.int64(18)).numpy(), 6)
+    np.testing.assert_allclose(ops.frac(np.array([1.5, -1.25])).numpy(),
+                               [0.5, -0.25])
+    np.testing.assert_allclose(
+        ops.hypot(np.array([3.0]), np.array([4.0])).numpy(), [5.0]
+    )
+    lbl = np.eye(3, dtype=np.float32)
+    sm = ops.label_smooth(lbl, epsilon=0.1).numpy()
+    np.testing.assert_allclose(sm[0], [0.9 + 0.1 / 3, 0.1 / 3, 0.1 / 3],
+                               rtol=1e-5)
+    g = ops.glu(np.array([[1.0, 2.0, 0.0, 0.0]], np.float32)).numpy()
+    np.testing.assert_allclose(g, [[0.5, 1.0]], rtol=1e-5)
+
+
+def test_grid_sample_identity():
+    x = rng.randn(1, 1, 4, 4).astype("float32")
+    theta = np.array([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+    grid = ops.affine_grid(theta, (1, 1, 4, 4), align_corners=True)
+    out = ops.grid_sample(x, grid, align_corners=True).numpy()
+    np.testing.assert_allclose(out, x, rtol=1e-4, atol=1e-5)
+
+
+# -- optimizer update kernels ------------------------------------------------
+
+
+def test_optimizer_update_kernels():
+    p = rng.randn(5).astype("float32")
+    g = rng.randn(5).astype("float32")
+    lr = np.float32(0.1)
+
+    new_p, g2 = ops._run("adagrad_update", paddle.to_tensor(p),
+                         paddle.to_tensor(g), paddle.to_tensor(np.zeros(5, np.float32)),
+                         paddle.to_tensor(lr), epsilon=1e-6)
+    np.testing.assert_allclose(
+        new_p.numpy(), p - 0.1 * g / (np.abs(g) + 1e-6), rtol=1e-5
+    )
+
+    # lamb: trust ratio scales the adam-style update
+    m0 = np.zeros(5, np.float32)
+    v0 = np.zeros(5, np.float32)
+    step = np.int32(1)
+    new_p, m, v = ops._run(
+        "lamb_update", paddle.to_tensor(p), paddle.to_tensor(g),
+        paddle.to_tensor(m0), paddle.to_tensor(v0), paddle.to_tensor(lr),
+        paddle.to_tensor(step), weight_decay=0.01,
+    )
+    r = g / (np.abs(g) + 1e-6) + 0.01 * p
+    ratio = np.linalg.norm(p) / np.linalg.norm(r)
+    np.testing.assert_allclose(new_p.numpy(), p - 0.1 * ratio * r, rtol=1e-4)
+
+    # lars
+    vel = np.zeros(5, np.float32)
+    new_p, nv = ops._run(
+        "lars_momentum_update", paddle.to_tensor(p), paddle.to_tensor(g),
+        paddle.to_tensor(vel), paddle.to_tensor(lr),
+        mu=0.9, lars_coeff=0.001, lars_weight_decay=0.0005,
+    )
+    local_lr = 0.001 * np.linalg.norm(p) / (
+        np.linalg.norm(g) + 0.0005 * np.linalg.norm(p)
+    )
+    expv = 0.1 * local_lr * (g + 0.0005 * p)
+    np.testing.assert_allclose(new_p.numpy(), p - expv, rtol=1e-4)
+
+    # rmsprop
+    ms0 = np.zeros(5, np.float32)
+    new_p, ms, mom = ops._run(
+        "rmsprop_update", paddle.to_tensor(p), paddle.to_tensor(g),
+        paddle.to_tensor(ms0), paddle.to_tensor(vel), paddle.to_tensor(lr),
+        rho=0.95, epsilon=1e-6,
+    )
+    ms_exp = 0.05 * g * g
+    np.testing.assert_allclose(
+        new_p.numpy(), p - 0.1 * g / np.sqrt(ms_exp + 1e-6), rtol=1e-4
+    )
+
+    # adadelta sanity: first step uses eps-scaled update
+    new_p, g2, u2 = ops._run(
+        "adadelta_update", paddle.to_tensor(p), paddle.to_tensor(g),
+        paddle.to_tensor(ms0), paddle.to_tensor(ms0), paddle.to_tensor(np.float32(1.0)),
+        rho=0.95, epsilon=1e-6,
+    )
+    assert np.all(np.sign(new_p.numpy() - p) == -np.sign(g))
