@@ -13,6 +13,13 @@ ops have no equivalent because there are no streams to sync.
 Outside traced code they operate on the global view directly (a sharded
 jax.Array already *is* the collective result's layout), so single-process
 "world" calls are identity transforms, matching paddle's nranks==1 path.
+
+Migration note (deviation from the reference API): inside traced SPMD code
+``send``/``recv`` need *both* endpoints — ``send(t, dst, src=...)`` /
+``recv(t, src, dst=...)`` — because the matched pair lowers to a single
+static ``lax.ppermute`` pair. Prefer the explicit :func:`p2p` helper for
+new code; reference-style one-sided calls keep working in eager code and
+raise a descriptive error under tracing.
 """
 from __future__ import annotations
 

@@ -1002,7 +1002,11 @@ def box_clip(boxes, im_info):
 
 def box_coder(prior_box, prior_box_var, target_box,
               code_type="encode_center_size", box_normalized=True):
-    return _run("box_coder", _t(prior_box), _t(prior_box_var), _t(target_box),
+    pb = _t(prior_box)
+    # prior_box_var=None is part of the reference API (ones variance)
+    var = (_t(prior_box_var) if prior_box_var is not None
+           else ones(pb.shape, str(pb.dtype)))
+    return _run("box_coder", pb, var, _t(target_box),
                 code_type=code_type, box_normalized=box_normalized)
 
 

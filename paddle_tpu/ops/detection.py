@@ -310,24 +310,32 @@ def multiclass_nms(bboxes, scores, *, score_threshold=0.05, nms_threshold=0.3,
     """
     c, n = scores.shape
     k = int(keep_top_k)
-    all_rows = []
+    neg_inf = jnp.asarray(-jnp.inf, bboxes.dtype)
+    all_rows, all_valid = [], []
     for cls in range(c):
         if cls == background_label:
             continue
-        s = jnp.where(scores[cls] >= score_threshold, scores[cls], -1.0)
-        keep_idx, _ = nms(bboxes, s, iou_threshold=nms_threshold, top_k=n)
-        valid = (keep_idx >= 0) & (s[jnp.clip(keep_idx, 0, n - 1)] > 0)
+        s_raw = scores[cls]
+        passes = s_raw >= score_threshold
+        # ordering key only — validity is the explicit mask, so legitimate
+        # zero/negative scores above the threshold are kept (ADVICE r2)
+        s_key = jnp.where(passes, s_raw, neg_inf)
+        keep_idx, _ = nms(bboxes, s_key, iou_threshold=nms_threshold, top_k=n)
+        gi = jnp.clip(keep_idx, 0, n - 1)
+        valid = (keep_idx >= 0) & passes[gi]
         row = jnp.concatenate(
             [jnp.full((n, 1), cls, bboxes.dtype),
-             s[jnp.clip(keep_idx, 0, n - 1)][:, None],
-             bboxes[jnp.clip(keep_idx, 0, n - 1)]], axis=1
+             s_raw[gi][:, None],
+             bboxes[gi]], axis=1
         )
-        row = jnp.where(valid[:, None], row, -1.0)
-        all_rows.append(row)
+        all_rows.append(jnp.where(valid[:, None], row, -1.0))
+        all_valid.append(valid)
     stacked = jnp.concatenate(all_rows, axis=0)
-    order = jnp.argsort(-stacked[:, 1])
+    valid = jnp.concatenate(all_valid, axis=0)
+    order = jnp.argsort(-jnp.where(valid, stacked[:, 1], neg_inf))
     stacked = stacked[order][:k]
-    num = jnp.sum(stacked[:, 1] > 0)
+    valid = valid[order][:k]
+    num = jnp.sum(valid)
     pad = k - stacked.shape[0]
     if pad > 0:
         stacked = jnp.concatenate(

@@ -39,10 +39,23 @@ def _time_mask(lengths, maxlen, dtype=jnp.bool_):
     return (t[None, :] < lengths[:, None]).astype(dtype)
 
 
+def _eager_only_maxlen(name, lengths):
+    if isinstance(lengths, jax.core.Tracer):
+        raise NotImplementedError(
+            f"{name} with maxlen=None derives the output length from the "
+            "data; pass a static maxlen= under jit/tracing, or call it "
+            "eagerly"
+        )
+
+
 @register_op("sequence_mask")
 def sequence_mask(lengths, *, maxlen=None, out_dtype="int64"):
     """operators/sequence_ops/sequence_mask_op.cc."""
-    maxlen = int(maxlen) if maxlen is not None else int(lengths.max())
+    if maxlen is None:
+        _eager_only_maxlen("sequence_mask", lengths)
+        maxlen = int(lengths.max())
+    else:
+        maxlen = int(maxlen)
     return _time_mask(lengths, maxlen, jnp.dtype(out_dtype))
 
 
@@ -54,7 +67,11 @@ def sequence_pad(x, lengths, *, maxlen=None, pad_value=0.0):
     lengths. Gather indices are clipped so the op stays jittable.
     """
     b = lengths.shape[0]
-    maxlen = int(maxlen) if maxlen is not None else int(lengths.max())
+    if maxlen is None:
+        _eager_only_maxlen("sequence_pad", lengths)
+        maxlen = int(lengths.max())
+    else:
+        maxlen = int(maxlen)
     offsets = jnp.concatenate([jnp.zeros(1, lengths.dtype),
                                jnp.cumsum(lengths)[:-1]])
     idx = offsets[:, None] + jnp.arange(maxlen)[None, :]      # [B, T]

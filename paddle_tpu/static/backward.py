@@ -32,14 +32,24 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     ops = block.ops
     no_grad_set = set(no_grad_set or [])
 
-    # forward pass: which vars require grad
+    # forward pass: which vars require grad. `tainted` tracks values whose
+    # gradient path runs through a while op (lax.while_loop has no VJP):
+    # any loss depending on a tainted value must fail loudly, or the
+    # while-path contribution would be silently dropped from the total.
     requires = set()
+    tainted = set()
     for v in block.vars.values():
         if not v.stop_gradient and _is_float_var(block, v.name):
             requires.add(v.name)
     for op in ops:
+        all_ins = [n for names in op.inputs.values() for n in names]
+        all_outs = [n for names in op.outputs.values() for n in names]
         if op.type == "while":
+            if any(n in requires or n in tainted for n in all_ins):
+                tainted.update(all_outs)
             continue  # gradient barrier: lax.while_loop has no reverse mode
+        if any(n in tainted for n in all_ins):
+            tainted.update(all_outs)
         ins = op.inputs.get("X", [])
         outs = op.outputs.get("Out", [])
         if any(n in requires for n in ins):
@@ -47,6 +57,13 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
                 if _is_float_var(block, n) and n not in no_grad_set:
                     requires.add(n)
 
+    if loss.name in tainted:
+        raise RuntimeError(
+            f"loss {loss.name!r} depends on the output of a while op, which "
+            "is not reverse-differentiable in static autodiff "
+            "(lax.while_loop has no VJP rule). Rewrite the loop with "
+            "static.nn.scan, or detach the while outputs from the loss."
+        )
     if loss.name not in requires:
         raise RuntimeError(
             f"loss {loss.name!r} does not depend on any trainable variable")
@@ -63,7 +80,7 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
     for i in range(n_fwd_ops - 1, -1, -1):
         op = ops[i]
         if op.type == "while":
-            continue  # see gradient-barrier note above
+            continue  # loss does not flow through it (taint-checked above)
         in_names = op.inputs.get("X", [])
         out_names = op.outputs.get("Out", [])
         out_grads = [grad_map.get(n) for n in out_names]

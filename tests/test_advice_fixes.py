@@ -135,3 +135,53 @@ def test_load_returns_tensors(tmp_path):
     assert obj["n"] == 3
     obj_np = paddle.load(path, return_numpy=True)
     assert isinstance(obj_np["w"], np.ndarray)
+
+
+# -- round-2 advisor findings -----------------------------------------------
+
+
+def test_box_coder_none_variance():
+    """ADVICE r2: prior_box_var=None must fall back to ones variance."""
+    from paddle_tpu import ops
+
+    priors = np.array([[0.0, 0.0, 2.0, 2.0], [1.0, 1.0, 3.0, 3.0]], "float32")
+    targets = np.array([[0.5, 0.5, 1.5, 1.5]], "float32")
+    out_none = ops.box_coder(priors, None, targets)
+    out_ones = ops.box_coder(priors, np.ones((2, 4), "float32"), targets)
+    np.testing.assert_allclose(out_none.numpy(), out_ones.numpy(), rtol=1e-6)
+
+
+def test_sequence_mask_maxlen_none_under_jit_raises():
+    """ADVICE r2: maxlen=None under tracing must raise the clear
+    eager-only error, not a raw ConcretizationTypeError."""
+    import pytest
+    from paddle_tpu.ops import sequence
+
+    lengths = jnp.array([2, 3])
+
+    def f(ls):
+        return sequence.sequence_mask(ls)
+
+    with pytest.raises(NotImplementedError, match="maxlen"):
+        jax.jit(f)(lengths)
+    # eager still works
+    m = sequence.sequence_mask(lengths)
+    assert m.shape == (2, 3)
+
+
+def test_multiclass_nms_zero_score_kept():
+    """ADVICE r2: detections with zero/negative scores passing
+    score_threshold must be kept and counted."""
+    from paddle_tpu.ops import detection
+
+    boxes = jnp.array(
+        [[0.0, 0.0, 1.0, 1.0], [5.0, 5.0, 6.0, 6.0]], "float32"
+    )
+    # scores 0.0 and -0.1, threshold -0.5: both pass
+    scores = jnp.array([[0.0, -0.1]], "float32")
+    out, num = detection.multiclass_nms(
+        boxes, scores, score_threshold=-0.5, nms_threshold=0.5, keep_top_k=4
+    )
+    assert int(num) == 2
+    kept_scores = sorted(float(s) for s in np.asarray(out)[: int(num), 1])
+    np.testing.assert_allclose(kept_scores, [-0.1, 0.0], atol=1e-6)

@@ -208,8 +208,24 @@ def test_while_grad_raises_helpfully():
         [i0, x],
     )
     loss = ops.sum(out[1])
-    # while is a gradient barrier: the loss has no path to any trainable var
-    with pytest.raises(RuntimeError, match="does not depend"):
+    # while is a gradient barrier: the loss path runs through the while
+    with pytest.raises(RuntimeError, match="while"):
+        static.gradients(loss, [x])
+
+
+def test_while_partial_grad_path_raises():
+    """ADVICE r2 (medium): loss = sum(while(x)) + sum(x^2) must raise, not
+    silently return only the 2x contribution."""
+    x = static.data("x", [2], "float32")
+    x.stop_gradient = False
+    i0 = static.data("i0", [], "int64")
+    out = static.nn.while_loop(
+        lambda i, v: ops.less_than(i, np.int64(3)),
+        lambda i, v: [ops.add(i, np.int64(1)), ops.scale(v, 2.0)],
+        [i0, x],
+    )
+    loss = ops.add(ops.sum(out[1]), ops.sum(ops.square(x)))
+    with pytest.raises(RuntimeError, match="while"):
         static.gradients(loss, [x])
 
 
