@@ -313,28 +313,116 @@ class Fleet:
 class DistributedOptimizer:
     """Wraps a user optimizer per strategy (meta_optimizers/ equivalent).
 
-    In eager/dygraph usage it behaves like the wrapped optimizer; its main
-    job is carrying the strategy so train-step builders (hapi Model,
-    parallel.sharded_train_step, amp decorators) can read it.
+    The strategy is consumed, not just carried (reference: the
+    StrategyCompiler composes meta-optimizers, base/strategy_compiler.py):
+
+    - validation happens eagerly at construction — unimplementable flags
+      (dgc, a_sync) raise here, never silently no-op
+      (parallel.train.consume_strategy);
+    - ``lars``/``lamb`` swap the update rule the way
+      meta_optimizers/lars_optimizer.py replaces Momentum→LarsMomentum;
+    - ``gradient_merge`` works in eager ``step()``/``minimize()`` too:
+      grads accumulate across backward calls (the eager tape sums), and
+      the inner optimizer is applied every ``k_steps``-th call;
+    - ``recompute``/``sharding``/``localsgd`` are compiled-step behaviors:
+      train-step builders (hapi Model, parallel.sharded_train_step) read
+      ``user_defined_strategy`` and configure jax.checkpoint / ZeRO-1
+      shardings / LocalSGD accordingly.
     """
 
     def __init__(self, fleet_obj, inner, strategy):
+        from ...parallel.train import consume_strategy
+
         self._fleet = fleet_obj
-        self.inner_opt = inner
         self.user_defined_strategy = strategy
+        self._opts = consume_strategy(strategy)  # raises on dgc/a_sync
+        self.inner_opt = self._maybe_swap_update_rule(inner, strategy)
+        self._gm_k = self._opts.get("grad_accum_steps", 1) or 1
+        self._gm_avg = self._opts.get("grad_accum_avg", True)
+        self._gm_count = 0
+
+    @staticmethod
+    def _maybe_swap_update_rule(inner, strategy):
+        """lars/lamb meta-optimizer equivalents: swap the update kernel."""
+        if strategy is None or not (
+            getattr(strategy, "lars", False) or getattr(strategy, "lamb", False)
+        ):
+            return inner
+        from ... import optimizer as opt_mod
+        from ...ops import optimizer_kernels as ok
+
+        params = inner._parameter_list
+        lr = inner._learning_rate
+        clip = inner._grad_clip
+        if getattr(strategy, "lamb", False):
+            # weight decay comes from lamb_configs (reference
+            # lamb_optimizer.py replaces the inner regularization the
+            # same way); grad clipping is preserved from the inner opt
+            wd = strategy.lamb_configs.lamb_weight_decay
+            return opt_mod.Lamb(
+                learning_rate=lr, parameters=params, lamb_weight_decay=wd,
+                grad_clip=clip,
+            )
+        # lars: momentum with LARS local-lr scaling
+        cfg = strategy.lars_configs
+
+        class _LarsMomentum(opt_mod.Momentum):
+            def _apply_one(self, index, param, grad, lr_v):
+                vel = self._ensure_accumulator("velocity")[index]
+                new_p, new_v = ok.lars_momentum_update(
+                    param, grad, vel, lr_v,
+                    mu=self._momentum,
+                    lars_coeff=cfg.lars_coeff,
+                    lars_weight_decay=cfg.lars_weight_decay,
+                )
+                self._accumulators["velocity"][index] = new_v
+                return new_p
+
+        mu = getattr(inner, "_momentum", 0.9)
+        if getattr(inner, "_use_nesterov", False):
+            raise NotImplementedError(
+                "strategy.lars replaces the update rule with LARS momentum "
+                "(operators/optimizers/lars_momentum_op.cc), which has no "
+                "nesterov variant; unset use_nesterov or lars"
+            )
+        return _LarsMomentum(
+            learning_rate=lr, momentum=mu, parameters=params,
+            grad_clip=clip,
+        )
 
     def __getattr__(self, name):
         return getattr(self.inner_opt, name)
 
     def step(self):
-        return self.inner_opt.step()
+        """Eager step honoring gradient_merge: grads keep accumulating on
+        the tape; the inner optimizer runs every k-th call with 1/k-scaled
+        grads (meta_optimizers/gradient_merge_optimizer.py semantics)."""
+        if self._gm_k <= 1:
+            return self.inner_opt.step()
+        self._gm_count += 1
+        if self._gm_count < self._gm_k:
+            return None  # keep accumulating; do NOT clear grads
+        self._gm_count = 0
+        if self._gm_avg:
+            from ...framework.tensor import Tensor
+
+            for p in self.inner_opt._parameter_list:
+                if p.grad is not None:
+                    p.grad = Tensor._from_array(p.grad._array / self._gm_k)
+        out = self.inner_opt.step()
+        self.inner_opt.clear_grad()
+        return out
 
     def clear_grad(self):
+        if self._gm_k > 1 and self._gm_count != 0:
+            return None  # mid-accumulation: grads must survive
         return self.inner_opt.clear_grad()
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        return self.inner_opt.minimize(loss)
+        loss.backward()
+        self.step()
+        return None, None
 
 
 fleet = Fleet()

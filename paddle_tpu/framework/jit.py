@@ -247,11 +247,29 @@ class TrainStepFn:
     into the eager model/optimizer (for checkpointing etc).
     """
 
-    def __init__(self, model, optimizer, loss_fn, jit=True, donate=True):
+    def __init__(self, model, optimizer, loss_fn, jit=True, donate=True,
+                 recompute=False, grad_accum_steps=1, grad_accum_avg=True):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
+        # DistributedStrategy-driven behaviors (fleet meta-optimizer parity,
+        # python/paddle/fluid/optimizer.py:4685 RecomputeOptimizer and
+        # distributed/fleet/meta_optimizers/gradient_merge_optimizer.py):
+        # recompute → jax.checkpoint over the forward (trade FLOPs for HBM);
+        # grad_accum_steps=k → k-step gradient accumulation inside the
+        # compiled step, optimizer applied every k-th call.
+        self.recompute = bool(recompute)
+        self.grad_accum_steps = int(grad_accum_steps)
+        self.grad_accum_avg = bool(grad_accum_avg)
         self.state = init_opt_state(model, optimizer)
+        if self.grad_accum_steps > 1:
+            self.state["gm"] = {
+                "acc": OrderedDict(
+                    (n, jnp.zeros_like(a))
+                    for n, a in self.state["params"].items()
+                ),
+                "count": jnp.asarray(0, jnp.int32),
+            }
         if donate:
             # the initial state aliases the live model's arrays; donation
             # would invalidate them on TPU — copy once so the eager objects
@@ -268,6 +286,9 @@ class TrainStepFn:
 
     def _build_pure(self):
         model, optimizer, loss_fn = self.model, self.optimizer, self.loss_fn
+        recompute = getattr(self, "recompute", False)
+        k = getattr(self, "grad_accum_steps", 1)
+        avg = getattr(self, "grad_accum_avg", True)
 
         def pure(state, batch, lr, rng):
             frozen, buffers = state["frozen"], state["buffers"]
@@ -291,17 +312,69 @@ class TrainStepFn:
                 loss_arr = loss._array if isinstance(loss, Tensor) else loss
                 return loss_arr, st["buffers"]
 
+            if recompute:
+                # RecomputeOptimizer equivalent (fluid/optimizer.py:4685):
+                # forward activations are not saved for backward — XLA
+                # rematerializes them, trading MXU FLOPs for HBM.
+                loss_of = jax.checkpoint(loss_of)
+
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(state["params"])
-            new_params, new_opt = _apply_optimizer(
-                model, optimizer, state, grads, lr
+
+            if k <= 1:
+                new_params, new_opt = _apply_optimizer(
+                    model, optimizer, state, grads, lr
+                )
+                new_state = {
+                    "params": new_params,
+                    "frozen": frozen,
+                    "buffers": new_buffers,
+                    "opt": new_opt,
+                }
+                return new_state, {"loss": loss}
+
+            # gradient merge (meta_optimizers/gradient_merge_optimizer.py):
+            # accumulate k micro-grads, apply the optimizer on the k-th.
+            acc = jax.tree_util.tree_map(jnp.add, state["gm"]["acc"], grads)
+            count = state["gm"]["count"] + 1
+
+            def apply_branch(_):
+                g = (
+                    jax.tree_util.tree_map(lambda a: a / k, acc)
+                    if avg
+                    else acc
+                )
+                new_params, new_opt = _apply_optimizer(
+                    model, optimizer, state, g, lr
+                )
+                zeros = jax.tree_util.tree_map(jnp.zeros_like, acc)
+                return new_params, new_opt, zeros, jnp.asarray(0, jnp.int32)
+
+            def skip_branch(_):
+                opt_state = {
+                    "accums": {
+                        kk: list(v)
+                        for kk, v in state["opt"]["accums"].items()
+                    },
+                    "step": jnp.asarray(state["opt"]["step"], jnp.int32),
+                }
+                return (
+                    OrderedDict(state["params"]),
+                    opt_state,
+                    acc,
+                    jnp.asarray(count, jnp.int32),
+                )
+
+            new_params, new_opt, new_acc, new_count = jax.lax.cond(
+                count >= k, apply_branch, skip_branch, None
             )
             new_state = {
                 "params": new_params,
                 "frozen": frozen,
                 "buffers": new_buffers,
                 "opt": new_opt,
+                "gm": {"acc": new_acc, "count": new_count},
             }
             return new_state, {"loss": loss}
 
@@ -358,6 +431,8 @@ class TrainStepFn:
             return
         for nm in unused:
             self.state["frozen"][nm] = self.state["params"].pop(nm)
+            if "gm" in self.state:
+                self.state["gm"]["acc"].pop(nm, None)
         # rebuild: the pure fn closes over nothing stateful, but the pytree
         # structure of `state` changed, so recompilation happens naturally
 
@@ -389,12 +464,17 @@ def _noop_grads_probe(model, loss_fn, params, frozen, buffers, batch, rng):
     return out, None
 
 
-def train_step(model, optimizer, loss_fn, jit=True, donate=True):
+def train_step(model, optimizer, loss_fn, jit=True, donate=True,
+               recompute=False, grad_accum_steps=1, grad_accum_avg=True):
     """Build a compiled train step.
 
     ``loss_fn(model, *batch) -> scalar loss Tensor`` runs the eager forward.
     """
-    return TrainStepFn(model, optimizer, loss_fn, jit=jit, donate=donate)
+    return TrainStepFn(
+        model, optimizer, loss_fn, jit=jit, donate=donate,
+        recompute=recompute, grad_accum_steps=grad_accum_steps,
+        grad_accum_avg=grad_accum_avg,
+    )
 
 
 def eval_step(model, fn=None, jit=True):

@@ -63,14 +63,38 @@ class Model:
 
         from ..parallel.mesh import get_mesh
 
+        # fleet.distributed_optimizer carries a DistributedStrategy; the
+        # step builder consumes it (recompute/gradient_merge/ZeRO-1/localsgd)
+        strategy = getattr(self._optimizer, "user_defined_strategy", None)
+        opt = getattr(self._optimizer, "inner_opt", self._optimizer)
+
         mesh = get_mesh()
         if mesh is not None:
             from ..parallel import sharded_train_step
 
             return sharded_train_step(
-                self.network, self._optimizer, loss_fn, mesh
+                self.network, opt, loss_fn, mesh, strategy=strategy
             )
-        return fjit.train_step(self.network, self._optimizer, loss_fn)
+        if strategy is not None:
+            from ..parallel.train import consume_strategy
+
+            o = consume_strategy(strategy)
+            if o.get("localsgd") or o.get("zero1"):
+                raise RuntimeError(
+                    "strategy.localsgd/sharding need a device mesh: wrap "
+                    "training in parallel.mesh_scope(create_mesh(dp=...))"
+                )
+            if o.get("amp"):
+                from ..parallel.train import _amp_wrap
+
+                loss_fn = _amp_wrap(loss_fn, strategy)
+            return fjit.train_step(
+                self.network, opt, loss_fn,
+                recompute=o["recompute"],
+                grad_accum_steps=o["grad_accum_steps"],
+                grad_accum_avg=o["grad_accum_avg"],
+            )
+        return fjit.train_step(self.network, opt, loss_fn)
 
     def train_batch(self, inputs, labels=None):
         if self._train_step is None:

@@ -29,9 +29,15 @@ from .sharding import (  # noqa: F401
     shard_state,
     shard_batch,
     with_sharding_constraint,
+    zero1_shard_opt,
     DEFAULT_RULES,
 )
-from .train import sharded_train_step  # noqa: F401
+from .train import (  # noqa: F401
+    sharded_train_step,
+    ShardedTrainStep,
+    LocalSGDTrainStep,
+    consume_strategy,
+)
 from .ring_attention import ring_attention  # noqa: F401
 from .pipeline import GPipe  # noqa: F401
 from .moe import MoELayer, SwitchFFN  # noqa: F401

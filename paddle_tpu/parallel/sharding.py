@@ -22,6 +22,7 @@ __all__ = [
     "shard_state",
     "shard_batch",
     "with_sharding_constraint",
+    "zero1_shard_opt",
     "DEFAULT_RULES",
 ]
 
@@ -125,7 +126,62 @@ def shard_state(state, rules: ShardingRules | None = None, mesh: Mesh | None = N
             "accums": accums,
             "step": NamedSharding(mesh, P()),
         }
+    if "gm" in state:
+        # gradient-merge accumulation buffers follow their parameter
+        out["gm"] = {
+            "acc": OrderedDict(
+                (name, out["params"][name]) for name in state["gm"]["acc"]
+            ),
+            "count": NamedSharding(mesh, P()),
+        }
     return out
+
+
+def _zero1_spec(spec: P, shape, dp: int, axis="dp") -> P:
+    """Extend ``spec`` to additionally shard the first divisible, still-
+    unsharded dim over the dp axis (ZeRO-1 placement for an optimizer
+    accumulator)."""
+    parts = list(spec) + [None] * (len(shape) - len(tuple(spec)))
+    if any(
+        (axis == p) or (isinstance(p, tuple) and axis in p) for p in parts
+    ):
+        return spec  # already sharded over dp by the param rule
+    for i, (p, d) in enumerate(zip(parts, shape)):
+        if p is None and d % dp == 0 and d >= dp:
+            parts[i] = axis
+            return P(*parts)
+    return spec  # no divisible dim: leave replicated
+
+
+def zero1_shard_opt(shardings, state, mesh: Mesh | None = None, axis="dp"):
+    """ZeRO stage-1: shard optimizer state over the data-parallel axis.
+
+    The reference has no ZeRO (SURVEY.md §2.3 — `sharding` absent from
+    distributed_strategy.proto); this implements the capability TPU-first:
+    each accumulator that matches its parameter's shape gets an extra
+    ``dp`` partition on its first divisible dim. Params/grads stay whole —
+    XLA gathers shards where the update math needs them (the
+    reduce-scatter/all-gather pair ZeRO implementations hand-write falls
+    out of GSPMD).
+
+    Mutates and returns the ``shardings`` pytree produced by shard_state.
+    """
+    mesh = mesh or get_mesh()
+    dp = int(mesh.shape.get(axis, 1))
+    if dp <= 1 or "opt" not in shardings:
+        return shardings
+    pshapes = [a.shape for a in state["params"].values()]
+    for name, accs in shardings["opt"]["accums"].items():
+        arrs = state["opt"]["accums"][name]
+        new = []
+        for sh, arr, pshape in zip(accs, arrs, pshapes):
+            if tuple(arr.shape) == tuple(pshape):
+                spec = _zero1_spec(sh.spec, arr.shape, dp, axis)
+                new.append(NamedSharding(mesh, spec))
+            else:
+                new.append(sh)
+        shardings["opt"]["accums"][name] = new
+    return shardings
 
 
 def shard_batch(batch, mesh: Mesh | None = None, axes=("dp",)):
