@@ -43,6 +43,18 @@ class ParallelEnv:
         return int(os.getenv("FLAGS_selected_tpus", "0").split(",")[0])
 
 
+def _distributed_client_active() -> bool:
+    """Whether jax.distributed.initialize already ran — checked WITHOUT
+    touching the XLA backend (jax.process_count() would initialize it,
+    which forbids a later jax.distributed.initialize)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
 def get_rank() -> int:
     if jax.process_count() > 1:
         return jax.process_index()
@@ -59,13 +71,24 @@ def init_parallel_env():
     """Initialize multi-host coordination (c_comm_init / init_parallel_env
     equivalent). Single-host: no-op. Multi-host: jax.distributed handshake
     using the coordinator from env (replaces gen_nccl_id RPC rendezvous,
-    operators/collective/c_gen_nccl_id_op.cc)."""
+    operators/collective/c_gen_nccl_id_op.cc).
+
+    Must run before any backend-initializing JAX call — like the
+    reference, where c_comm_init precedes every collective; fleet.init()
+    calls this first thing.
+    """
     global _initialized
     if _initialized:
         return ParallelEnv()
     env = ParallelEnv()
     coordinator = os.getenv("PADDLE_COORDINATOR", "")
-    if env.world_size > 1 and jax.process_count() == 1 and coordinator:
+    if env.world_size > 1 and coordinator and not _distributed_client_active():
+        if os.getenv("JAX_PLATFORMS", "").strip() == "cpu":
+            # CPU multi-process needs an explicit cross-host collectives
+            # transport (the reference's Gloo CPU path,
+            # framework/fleet/gloo_wrapper.h:106); TPU rides ICI/DCN and
+            # needs nothing here.
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=env.world_size,
