@@ -15,6 +15,10 @@ from .bert import (  # noqa: F401
     bert_base_config,
     bert_tiny_config,
     bert_sharding_rules,
+    bert_pipeline_stages,
+    BertEmbeddingStage,
+    BertEncoderStage,
+    BertHeadStage,
 )
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .word2vec import Word2Vec  # noqa: F401

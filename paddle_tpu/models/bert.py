@@ -263,6 +263,114 @@ class BertPretrainingCriterion(Layer):
         return mlm.mean() / masked_lm_scale + nsp.mean()
 
 
+class BertEmbeddingStage(Layer):
+    """Heterogeneous-pipeline first stage: embeddings + leading encoder
+    layers; (input_ids, token_type_ids) -> (hidden, additive_mask)."""
+
+    def __init__(self, cfg: BertConfig, layers):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.layers = LayerList(layers)
+        _init_bert_weights(self, cfg.initializer_range)
+
+    def forward(self, input_ids, token_type_ids):
+        mask = ops.cast(
+            ops.not_equal(
+                input_ids, ops.full_like(input_ids, self.config.pad_token_id)
+            ),
+            "float32",
+        )
+        ext = (1.0 - ops.unsqueeze(mask, [1, 2])) * -1e4
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.layers:
+            x = layer(x, ext)
+        return x, ext
+
+
+class BertEncoderStage(Layer):
+    """Middle stage: k encoder layers, (hidden, mask) -> (hidden, mask)."""
+
+    def __init__(self, cfg: BertConfig, layers):
+        super().__init__()
+        self.layers = LayerList(layers)
+        _init_bert_weights(self, cfg.initializer_range)
+
+    def forward(self, x, mask):
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x, mask
+
+
+class BertHeadStage(Layer):
+    """Last stage: trailing encoder layers + pooler + MLM/NSP heads.
+
+    The MLM decoder weight is intentionally *untied* from the embedding
+    (which lives on the first stage's devices): a cross-stage weight tie
+    would need an extra all-gather per microbatch; untying matches what
+    the reference's pipeline can express (params live in exactly one
+    section's scope, pipeline_trainer.cc:122 CopyParameters).
+    """
+
+    def __init__(self, cfg: BertConfig, layers):
+        super().__init__()
+        self.layers = LayerList(layers)
+        self.pooler = BertPooler(cfg)
+        self.cls = BertLMPredictionHead(
+            cfg,
+            self.create_parameter([cfg.vocab_size, cfg.hidden_size]),
+        )
+        self.seq_relationship = Linear(cfg.hidden_size, 2)
+        _init_bert_weights(self, cfg.initializer_range)
+
+    def forward(self, x, mask):
+        for layer in self.layers:
+            x = layer(x, mask)
+        pooled = self.pooler(x)
+        prediction_scores = self.cls(x)
+        seq_relationship_score = self.seq_relationship(pooled)
+        return prediction_scores, seq_relationship_score
+
+
+def bert_pipeline_stages(cfg: BertConfig, n_stages: int):
+    """Split a BERT pretraining model into n heterogeneous pipeline stages
+    (embedding-first, head-last) for parallel.PipelineParallel.
+
+    Encoder layers are distributed as evenly as possible; the first stage
+    additionally carries the embeddings, the last the pooler + MLM/NSP
+    heads (PipelineOptimizer's per-device program split,
+    fluid/optimizer.py:4431, with sections of *different* structure).
+    """
+    assert n_stages >= 2, "need at least an embedding and a head stage"
+
+    def make_layer():
+        return TransformerEncoderLayer(
+            cfg.hidden_size,
+            cfg.num_attention_heads,
+            cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob,
+            activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0,
+        )
+
+    n_layers = cfg.num_hidden_layers
+    counts = [
+        n_layers // n_stages + (1 if i < n_layers % n_stages else 0)
+        for i in range(n_stages)
+    ]
+    stages = []
+    for i, k in enumerate(counts):
+        layers = [make_layer() for _ in range(k)]
+        if i == 0:
+            stages.append(BertEmbeddingStage(cfg, layers))
+        elif i == n_stages - 1:
+            stages.append(BertHeadStage(cfg, layers))
+        else:
+            stages.append(BertEncoderStage(cfg, layers))
+    return stages
+
+
 def bert_sharding_rules() -> ShardingRules:
     """Megatron-style TP partition of BERT weights over the tp axis.
 

@@ -34,9 +34,9 @@ from ..framework import autograd
 from ..framework import jit as fjit
 from ..framework.tensor import Parameter, Tensor
 from ..nn.layer_base import Layer
-from .mesh import AXES, get_mesh
+from .mesh import AXES, get_mesh, mesh_scope
 
-__all__ = ["GPipe"]
+__all__ = ["GPipe", "PipelineParallel", "pipeline_schedule"]
 
 
 class GPipe(Layer):
@@ -199,6 +199,372 @@ def _gpipe_pure(*args, stage0, names, n_stages, n_micro, axis, mesh,
     # already inside an outer trace
     y_mb = jax.jit(sm)(stacked, x_mb, *extras)
     return y_mb.reshape((b,) + y_mb.shape[2:])
+
+
+def pipeline_schedule(n_stages: int, n_micro: int, kind: str = "1f1b"):
+    """Generate a topologically-valid dispatch order of pipeline events.
+
+    Returns a list of ("F"|"B", stage, microbatch) tuples. Mirrors the
+    role of SectionWorker's per-section op scheduling
+    (framework/section_worker.cc:83 — Forward-all/Backward-all per
+    op_role); "1f1b" additionally bounds live activations per stage to
+    ~(n_stages - stage) the way later Paddle 1F1B schedules do.
+
+    The order is a *dispatch* order for the single-controller runtime:
+    device-level overlap comes from async dispatch, correctness from data
+    dependencies, so only topological validity and memory shape matter.
+    """
+    S, M = n_stages, n_micro
+    done_f = [[False] * M for _ in range(S)]
+    done_b = [[False] * M for _ in range(S)]
+    nf = [0] * S  # forwards dispatched per stage
+    nb = [0] * S
+    events = []
+
+    def f_ready(s, m):
+        if done_f[s][m]:
+            return False
+        return s == 0 or done_f[s - 1][m]
+
+    def b_ready(s, m):
+        if done_b[s][m]:
+            return False
+        if s == S - 1:
+            return done_f[s][m]
+        return done_b[s + 1][m]
+
+    total = 2 * S * M
+    while len(events) < total:
+        progressed = False
+        for s in range(S):
+            f_next = nf[s] if nf[s] < M and f_ready(s, nf[s]) else None
+            b_next = nb[s] if nb[s] < M and b_ready(s, nb[s]) else None
+            if f_next is None and b_next is None:
+                continue
+            warm = min(S - s, M)
+            prefer_b = (
+                kind == "1f1b" and b_next is not None
+                and (nf[s] - nb[s] >= warm or nf[s] >= M)
+            ) or f_next is None
+            if prefer_b:
+                events.append(("B", s, b_next))
+                done_b[s][b_next] = True
+                nb[s] += 1
+            else:
+                events.append(("F", s, f_next))
+                done_f[s][f_next] = True
+                nf[s] += 1
+            progressed = True
+        assert progressed, "pipeline schedule deadlock"
+    return events
+
+
+class PipelineParallel:
+    """Heterogeneous pipeline-parallel trainer over pp submeshes.
+
+    Reference parity: PipelineTrainer + SectionWorker
+    (framework/pipeline_trainer.cc:24 — arbitrary per-section
+    ProgramDescs on distinct device groups, microbatch scopes flowing
+    through queues) and PipelineOptimizer's per-device program split
+    (python/paddle/fluid/optimizer.py:4431). Unlike GPipe above, stages
+    may be *different* Layers (embedding-first, head-last), carry
+    buffers, and change activation shape/pytree structure between
+    stages.
+
+    TPU-native single-controller MPMD: each stage's state lives on its
+    own slice of the pp mesh axis (replicated/dp-sharded over the
+    remaining axes); per-stage jitted programs run forward and
+    recompute-based backward (GPipe-paper rematerialization — only
+    stage-boundary activations are stored); the host dispatches events
+    in GPipe or 1F1B order and the async JAX runtime overlaps stages on
+    disjoint devices, replacing SectionWorker's threads+condition-vars.
+    Cross-stage handoffs are device_put reshards over ICI (the scope
+    queues of pipeline_trainer.cc:122).
+
+    API::
+
+        pp = PipelineParallel(
+            [emb_stage, block_stage, block_stage2, head_stage],
+            lambda params: opt.AdamW(1e-4, parameters=params),
+            loss_fn,          # (last_stage_output, *labels) -> scalar
+            num_microbatches=4, schedule="1f1b")
+        metrics = pp.step(input_batch, *label_batches)
+    """
+
+    def __init__(self, stages, opt_factory, loss_fn, num_microbatches,
+                 mesh=None, axis="pp", schedule="1f1b"):
+        from collections import OrderedDict
+
+        from jax.sharding import Mesh, NamedSharding
+
+        mesh = mesh or get_mesh()
+        if mesh is None:
+            raise RuntimeError("PipelineParallel needs a mesh "
+                               "(parallel.mesh_scope)")
+        npp = int(mesh.shape.get(axis, 1))
+        if len(stages) != npp:
+            raise ValueError(
+                f"{len(stages)} stages but mesh {axis}={npp}; one stage "
+                f"per {axis} slice (split or merge your stages)"
+            )
+        self.mesh = mesh
+        self.axis = axis
+        self.stages = list(stages)
+        self.S = len(stages)
+        self.M = int(num_microbatches)
+        self.loss_fn = loss_fn
+        self.schedule = schedule
+        self._events = pipeline_schedule(self.S, self.M, schedule)
+
+        ax_pos = AXES.index(axis)
+        sub_axes = tuple(a for a in AXES if a != axis)
+        self.submeshes = []
+        for i in range(self.S):
+            devs = np.take(mesh.devices, i, axis=ax_pos)
+            self.submeshes.append(Mesh(devs, sub_axes))
+
+        # per-stage functional state, placed on the stage's submesh
+        self.opts = []
+        self.states = []
+        self._fwd = []
+        self._bwd = []
+        self._apply = []
+        for i, stage in enumerate(self.stages):
+            stage.train()
+            opt_i = opt_factory(stage.parameters())
+            st = fjit.init_opt_state(stage, opt_i)
+            repl = NamedSharding(self.submeshes[i], P())
+            st = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, repl), st
+            )
+            self.opts.append(opt_i)
+            self.states.append(st)
+            is_last = i == self.S - 1
+            is_first = i == 0
+
+            def core(params, frozen, buffers, act, rng, _stage=stage):
+                st2 = {
+                    "params": params,
+                    "frozen": frozen,
+                    "buffers": OrderedDict(buffers),
+                }
+                out, new_st = fjit.functional_call(
+                    _stage, st2, *_act_args(act), rng=rng
+                )
+                return out, new_st["buffers"]
+
+            def call_loss(y, labels, _loss=loss_fn):
+                wy = tuple(
+                    Tensor._from_array(a) for a in _act_args(y)
+                )
+                wl = [Tensor._from_array(l) for l in labels]
+                loss = _loss(*wy, *wl)
+                return loss._array if isinstance(loss, Tensor) else loss
+
+            if not is_last:
+
+                def fwd(state, act, rng, _core=core):
+                    y, nb = _core(
+                        state["params"], state["frozen"], state["buffers"],
+                        act, rng,
+                    )
+                    return y, nb
+
+                def bwd(state, act, gy, rng, _core=core, _first=is_first):
+                    frozen, buffers = state["frozen"], state["buffers"]
+
+                    if _first:
+                        # the raw input (int tokens / images) gets no
+                        # cotangent: differentiate w.r.t. params only
+                        def f0(p):
+                            y, _ = _core(p, frozen, buffers, act, rng)
+                            return y
+
+                        _, vjp = jax.vjp(f0, state["params"])
+                        (gp,) = vjp(gy)
+                        return gp, ()
+
+                    def f(p, a):
+                        y, _ = _core(p, frozen, buffers, a, rng)
+                        return y
+
+                    _, vjp = jax.vjp(f, state["params"], act)
+                    gp, gx = vjp(gy)
+                    return gp, gx
+
+            else:
+
+                def fwd(state, act, labels, rng, _core=core,
+                        _loss=call_loss):
+                    y, nb = _core(
+                        state["params"], state["frozen"], state["buffers"],
+                        act, rng,
+                    )
+                    return _loss(y, labels), nb
+
+                def bwd(state, act, labels, rng, _core=core,
+                        _loss=call_loss, _first=is_first):
+                    frozen, buffers = state["frozen"], state["buffers"]
+
+                    if _first:  # S == 1: whole model on one slice
+                        def f0(p):
+                            y, nb = _core(p, frozen, buffers, act, rng)
+                            return _loss(y, labels), nb
+
+                        loss, vjp, nb = jax.vjp(f0, state["params"],
+                                                has_aux=True)
+                        (gp,) = vjp(jnp.ones_like(loss))
+                        return loss, nb, gp, ()
+
+                    def f(p, a):
+                        y, nb = _core(p, frozen, buffers, a, rng)
+                        return _loss(y, labels), nb
+
+                    loss, vjp, nb = jax.vjp(f, state["params"], act,
+                                            has_aux=True)
+                    gp, gx = vjp(jnp.ones_like(loss))
+                    return loss, nb, gp, gx
+
+            self._fwd.append(jax.jit(fwd))
+            self._bwd.append(jax.jit(bwd))
+
+            def apply_fn(state, grads, lr, _stage=stage, _opt=opt_i):
+                new_params, new_opt = fjit._apply_optimizer(
+                    _stage, _opt, state, grads, lr
+                )
+                return new_params, new_opt
+
+            self._apply.append(jax.jit(apply_fn))
+
+        self._rng = default_generator_key()
+
+    # -- data movement ------------------------------------------------------
+    def _place(self, tree, stage_idx, batch_spec=True):
+        """Put an activation pytree onto a stage's submesh (dp-sharded
+        batch dim). The cross-stage reshard — the scope-queue handoff of
+        pipeline_trainer.cc:122 — rides ICI."""
+        from jax.sharding import NamedSharding
+
+        sub = self.submeshes[stage_idx]
+
+        def one(a):
+            spec = P("dp") if (batch_spec and a.ndim >= 1) else P()
+            return jax.device_put(a, NamedSharding(sub, spec))
+
+        return jax.tree_util.tree_map(one, tree)
+
+    # -- the step -----------------------------------------------------------
+    def step(self, x, *labels):
+        """One pipelined optimizer step over num_microbatches."""
+        import jax.random as jrandom
+
+        S, M = self.S, self.M
+
+        def to_arr(t):
+            return t._array if isinstance(t, Tensor) else jnp.asarray(t)
+
+        x = jax.tree_util.tree_map(
+            to_arr, x, is_leaf=lambda t: isinstance(t, Tensor)
+        )
+        labels = [
+            l._array if isinstance(l, Tensor) else jnp.asarray(l)
+            for l in labels
+        ]
+        b = jax.tree_util.tree_leaves(x)[0].shape[0]
+        assert b % M == 0, (b, M)
+        mb = b // M
+        x_mb = [
+            jax.tree_util.tree_map(lambda a: a[m * mb:(m + 1) * mb], x)
+            for m in range(M)
+        ]
+        lab_mb = [
+            [l[m * mb:(m + 1) * mb] for l in labels] for m in range(M)
+        ]
+
+        self._rng, base = jrandom.split(self._rng)
+        keys = [
+            [jrandom.fold_in(base, s * M + m) for m in range(M)]
+            for s in range(S)
+        ]
+
+        acts = [dict() for _ in range(S)]   # (stage) -> {m: input act}
+        for m in range(M):
+            acts[0][m] = self._place(x_mb[m], 0)
+        labs = [self._place(lab_mb[m], S - 1) for m in range(M)]
+        gys = [dict() for _ in range(S)]    # upstream grads per stage
+        gacc = [None] * S
+        losses = []
+
+        for ev, s, m in self._events:
+            st = self.states[s]
+            # stage programs trace under their own submesh so in-model
+            # sharding constraints (P("dp", "sp", ...)) resolve against
+            # the stage's devices, not the global mesh
+            with mesh_scope(self.submeshes[s]):
+                if ev == "F":
+                    if s == S - 1:
+                        # loss+buffers come out of the backward recompute;
+                        # the forward event is pure bookkeeping on the
+                        # last stage (avoids a third pass)
+                        continue
+                    y, nb = self._fwd[s](st, acts[s][m], keys[s][m])
+                    self.states[s] = {**st, "buffers": nb}
+                else:  # backward
+                    if s == S - 1:
+                        loss, nb, gp, gx = self._bwd[s](
+                            st, acts[s][m], labs[m], keys[s][m]
+                        )
+                        self.states[s] = {**st, "buffers": nb}
+                        losses.append(loss)
+                    else:
+                        gp, gx = self._bwd[s](
+                            st, acts[s][m], gys[s].pop(m), keys[s][m]
+                        )
+            if ev == "F":
+                acts[s + 1][m] = self._place(y, s + 1)
+            else:
+                del acts[s][m]  # activation memory freed (1F1B bound)
+                if s > 0:
+                    gys[s - 1][m] = self._place(gx, s - 1)
+                gacc[s] = gp if gacc[s] is None else jax.tree_util.tree_map(
+                    jnp.add, gacc[s], gp
+                )
+
+        # optimizer: mean of microbatch grads == grad of the mean loss
+        lr = jnp.asarray(self.opts[0].get_lr(), jnp.float32)
+        for s in range(S):
+            grads = jax.tree_util.tree_map(lambda g: g / M, gacc[s])
+            new_params, new_opt = self._apply[s](self.states[s], grads, lr)
+            self.states[s] = {
+                **self.states[s], "params": new_params, "opt": new_opt,
+            }
+        loss = jnp.mean(jnp.stack([jnp.asarray(l) for l in losses]))
+        return {"loss": loss}
+
+    __call__ = step
+
+    def sync(self):
+        """Write device state back into the eager stage Layers."""
+        for stage, st, opt_i in zip(self.stages, self.states, self.opts):
+            host = jax.tree_util.tree_map(
+                lambda a: jnp.asarray(np.asarray(a)), st
+            )
+            fjit.restore_state(stage, host, opt_i)
+        return self
+
+
+def _act_args(act):
+    """An activation pytree becomes the stage's positional args: a bare
+    array is one arg; a tuple/list is splatted."""
+    if isinstance(act, (tuple, list)):
+        return tuple(act)
+    return (act,)
+
+
+def default_generator_key():
+    from ..framework.random import default_generator
+
+    return default_generator().split()
 
 
 def _gpipe_body(stacked, x_mb, *extras, stage_fn, names, n_stages, n_micro,

@@ -1,0 +1,291 @@
+"""Heterogeneous pipeline-parallel tests (PipelineParallel, 1F1B/GPipe).
+
+Reference parity: PipelineTrainer with arbitrary per-section programs
+(framework/pipeline_trainer.cc:24, section_worker.cc:83) — stages of
+different structure (embedding-first, head-last), buffers allowed,
+microbatched schedule with optimizer once per minibatch.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu import parallel
+from paddle_tpu.framework import jit as fjit
+from paddle_tpu.parallel.pipeline import pipeline_schedule
+
+
+# -- schedule generator -----------------------------------------------------
+
+
+def _check_valid(events, S, M):
+    done = set()
+    for ev, s, m in events:
+        if ev == "F":
+            if s > 0:
+                assert ("F", s - 1, m) in done, (ev, s, m)
+        else:
+            if s == S - 1:
+                assert ("F", s, m) in done
+            else:
+                assert ("B", s + 1, m) in done, (ev, s, m)
+        done.add((ev, s, m))
+    assert len(done) == 2 * S * M
+
+
+@pytest.mark.parametrize("kind", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("S,M", [(2, 4), (4, 4), (4, 8), (3, 5), (1, 3)])
+def test_schedule_topologically_valid(kind, S, M):
+    _check_valid(pipeline_schedule(S, M, kind), S, M)
+
+
+def test_1f1b_bounds_live_activations():
+    """1F1B keeps at most ~(S - s) forward activations alive per stage;
+    GPipe keeps all M (the schedules' defining memory difference)."""
+    S, M = 4, 8
+
+    def peak_live(events):
+        live = [0] * S
+        peak = [0] * S
+        for ev, s, m in events:
+            if ev == "F":
+                live[s] += 1
+                peak[s] = max(peak[s], live[s])
+            else:
+                live[s] -= 1
+        return peak
+
+    peak_1f1b = peak_live(pipeline_schedule(S, M, "1f1b"))
+    peak_gpipe = peak_live(pipeline_schedule(S, M, "gpipe"))
+    assert peak_gpipe[0] == M
+    assert peak_1f1b[0] <= S  # bounded by depth, not microbatch count
+    assert peak_1f1b[0] < peak_gpipe[0]
+
+
+# -- heterogeneous stages ---------------------------------------------------
+
+
+class EmbStage(nn.Layer):
+    """Embedding-first stage: int tokens -> hidden."""
+
+    def __init__(self, vocab=50, hidden=16):
+        super().__init__()
+        self.emb = nn.Embedding(vocab, hidden)
+        self.fc = nn.Linear(hidden, hidden)
+
+    def forward(self, ids):
+        return F.relu(self.fc(self.emb(ids).mean(axis=1)))
+
+
+class MidStage(nn.Layer):
+    def __init__(self, hidden=16):
+        super().__init__()
+        self.fc1 = nn.Linear(hidden, hidden)
+        self.fc2 = nn.Linear(hidden, hidden)
+
+    def forward(self, x):
+        return x + F.relu(self.fc2(F.relu(self.fc1(x))))
+
+
+class HeadStage(nn.Layer):
+    """Head-last stage: hidden -> logits (different output shape)."""
+
+    def __init__(self, hidden=16, classes=4):
+        super().__init__()
+        self.fc = nn.Linear(hidden, classes)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+class Combined(nn.Layer):
+    """The same stages run sequentially (single-device oracle)."""
+
+    def __init__(self, stages):
+        super().__init__()
+        self.stages = nn.LayerList(stages)
+
+    def forward(self, x):
+        for s in self.stages:
+            x = s(x)
+        return x
+
+
+def _data(n=32, vocab=50, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return (
+        rng.randint(0, vocab, (n, 6)).astype("int64"),
+        rng.randint(0, c, (n,)).astype("int64"),
+    )
+
+
+def _loss(logits, y):
+    return F.cross_entropy(logits, y).mean()
+
+
+def _stages(seed=11):
+    paddle.seed(seed)
+    return [EmbStage(), MidStage(), MidStage(), HeadStage()]
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_hetero_pipeline_matches_sequential(schedule):
+    """4 heterogeneous stages on a pp=4 mesh, M=4 microbatches == one
+    full-batch step of the same stages run sequentially (SGD exactness:
+    mean-of-micro-grads == grad of full-batch mean loss)."""
+    X, Y = _data()
+
+    stages_ref = _stages()
+    ref_model = Combined(stages_ref)
+    ref_opt = opt.SGD(learning_rate=0.1, parameters=ref_model.parameters())
+    ref_step = fjit.train_step(
+        ref_model, ref_opt, lambda m, x, y: _loss(m(x), y)
+    )
+    ref_losses = [float(ref_step(X, Y)["loss"]) for _ in range(3)]
+    ref_step.sync()
+
+    stages = _stages()  # identical init (same seed)
+    mesh = parallel.create_mesh(pp=4, dp=2)
+    with parallel.mesh_scope(mesh):
+        pp = parallel.PipelineParallel(
+            stages,
+            lambda params: opt.SGD(learning_rate=0.1, parameters=params),
+            _loss,
+            num_microbatches=4,
+            schedule=schedule,
+        )
+        got_losses = [float(np.asarray(pp.step(X, Y)["loss"]))
+                      for _ in range(3)]
+    np.testing.assert_allclose(ref_losses, got_losses, rtol=1e-5, atol=1e-6)
+
+    # sync writes trained params back into the eager stages
+    pp.sync()
+    for (n0, p0), (n1, p1) in zip(
+        ref_model.named_parameters(),
+        Combined(stages).named_parameters(),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(p0._array), np.asarray(p1._array),
+            rtol=1e-5, atol=1e-6, err_msg=n0,
+        )
+
+
+class BNStage(nn.Layer):
+    """A stage with batch-norm buffers (running mean/var)."""
+
+    def __init__(self, hidden=16):
+        super().__init__()
+        self.fc = nn.Linear(hidden, hidden)
+        self.bn = nn.BatchNorm1D(hidden)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.fc(x)))
+
+
+def test_pipeline_stage_with_buffers():
+    """Stages with buffers train and the running stats advance — the
+    capability GPipe rejects (its documented restriction)."""
+    X, Y = _data()
+    paddle.seed(1)
+    stages = [EmbStage(), BNStage(), HeadStage()]
+    before = np.asarray(stages[1].bn._mean._array).copy()
+    mesh = parallel.create_mesh(
+        parallel.MeshConfig(pp=3, devices=jax.devices()[:3])
+    )
+    with parallel.mesh_scope(mesh):
+        pp = parallel.PipelineParallel(
+            stages,
+            lambda params: opt.SGD(learning_rate=0.1, parameters=params),
+            _loss,
+            num_microbatches=2,
+        )
+        l0 = float(np.asarray(pp.step(X, Y)["loss"]))
+        l1 = float(np.asarray(pp.step(X, Y)["loss"]))
+        pp.sync()
+    after = np.asarray(stages[1].bn._mean._array)
+    assert not np.allclose(before, after), "BN buffers did not update"
+    assert l1 < l0
+
+
+def test_pipeline_trains_to_lower_loss():
+    X, Y = _data(64)
+    paddle.seed(2)
+    stages = [EmbStage(), MidStage(), HeadStage()]
+    mesh = parallel.create_mesh(
+        parallel.MeshConfig(pp=3, devices=jax.devices()[:3])
+    )
+    with parallel.mesh_scope(mesh):
+        pp = parallel.PipelineParallel(
+            stages,
+            lambda params: opt.Momentum(learning_rate=0.1, parameters=params),
+            _loss,
+            num_microbatches=4,
+            schedule="1f1b",
+        )
+        losses = [float(np.asarray(pp.step(X, Y)["loss"]))
+                  for _ in range(30)]
+    assert losses[-1] < 0.5 * losses[0]
+
+
+def test_stage_count_must_match_pp():
+    mesh = parallel.create_mesh(pp=4, dp=2)
+    with parallel.mesh_scope(mesh):
+        with pytest.raises(ValueError, match="stages"):
+            parallel.PipelineParallel(
+                [EmbStage(), HeadStage()],
+                lambda params: opt.SGD(learning_rate=0.1, parameters=params),
+                _loss,
+                num_microbatches=2,
+            )
+
+
+def test_bert_hetero_stages_pipeline():
+    """BERT embedding/encoder/head split (the dryrun configuration)."""
+    from paddle_tpu.models import (
+        BertPretrainingCriterion,
+        bert_pipeline_stages,
+        bert_tiny_config,
+    )
+
+    cfg = bert_tiny_config()
+    cfg.hidden_dropout_prob = 0.0
+    cfg.attention_probs_dropout_prob = 0.0
+    paddle.seed(0)
+    stages = bert_pipeline_stages(cfg, 4)
+    from paddle_tpu.models.bert import (
+        BertEmbeddingStage, BertEncoderStage, BertHeadStage,
+    )
+
+    assert isinstance(stages[0], BertEmbeddingStage)
+    assert isinstance(stages[-1], BertHeadStage)
+    assert isinstance(stages[1], BertEncoderStage)
+
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+
+    def loss_fn(pred, rel, mlm, nsp):
+        return crit(pred, rel, mlm, nsp)
+
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, cfg.vocab_size, (8, 16)).astype("int64")
+    tt = rng.randint(0, 2, (8, 16)).astype("int64")
+    mlm = rng.randint(0, cfg.vocab_size, (8, 16)).astype("int64")
+    nsp = rng.randint(0, 2, (8, 1)).astype("int64")
+
+    mesh = parallel.create_mesh(pp=4, dp=2)
+    with parallel.mesh_scope(mesh):
+        pp = parallel.PipelineParallel(
+            stages,
+            lambda params: opt.AdamW(learning_rate=1e-3, parameters=params),
+            loss_fn,
+            num_microbatches=2,
+            schedule="1f1b",
+        )
+        l0 = float(np.asarray(pp.step((ids, tt), mlm, nsp)["loss"]))
+        l1 = float(np.asarray(pp.step((ids, tt), mlm, nsp)["loss"]))
+    assert np.isfinite(l0) and np.isfinite(l1)
+    assert l1 < l0
