@@ -202,3 +202,6 @@ from .hapi import Model  # noqa: E402
 from . import vision  # noqa: E402
 from . import profiler  # noqa: E402
 from . import distribution  # noqa: E402
+from . import errors  # noqa: E402  (platform/enforce.h error taxonomy)
+from . import flags as _flags_mod  # noqa: E402
+from .flags import get_flags, set_flags  # noqa: E402  (core.globals() API)

@@ -1,0 +1,134 @@
+"""Structured errors (PADDLE_ENFORCE equivalent).
+
+Reference parity: paddle/fluid/platform/enforce.h (PADDLE_ENFORCE_* +
+EnforceNotMet), platform/errors.cc and error_codes.proto (the canonical
+error-code taxonomy), pybind/exception.cc (mapping to Python types).
+
+Each error carries optional op context (type + io names) the way
+EnforceNotMet carries the op callstack; verbosity follows
+FLAGS_call_stack_level (enforce.h behavior).
+"""
+from __future__ import annotations
+
+import traceback
+
+__all__ = [
+    "EnforceNotMet",
+    "InvalidArgumentError",
+    "NotFoundError",
+    "OutOfRangeError",
+    "AlreadyExistsError",
+    "ResourceExhaustedError",
+    "PreconditionNotMetError",
+    "PermissionDeniedError",
+    "ExecutionTimeoutError",
+    "UnimplementedError",
+    "UnavailableError",
+    "FatalError",
+    "ExternalError",
+    "enforce",
+    "op_error_context",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base structured error (enforce.h EnforceNotMet).
+
+    ``code`` mirrors error_codes.proto; ``op_context`` is a dict with the
+    failing op's type and io names when raised from an executor path.
+    """
+
+    code = "UNKNOWN"
+
+    def __init__(self, message, op_context=None):
+        self.raw_message = str(message)
+        self.op_context = op_context
+        super().__init__(self._format())
+
+    def _format(self):
+        from .flags import flag
+
+        try:
+            level = int(flag("call_stack_level"))
+        except Exception:
+            level = 1
+        parts = [f"[{self.code}] {self.raw_message}"]
+        if level >= 1 and self.op_context:
+            ctx = self.op_context
+            io = ""
+            if ctx.get("inputs") is not None:
+                io = (f" inputs={list(ctx['inputs'])}"
+                      f" outputs={list(ctx.get('outputs', []))}")
+            parts.append(
+                f"  [operator < {ctx.get('op_type', '?')} > error]{io}"
+            )
+        if level >= 2:
+            stack = "".join(traceback.format_stack()[:-3])
+            parts.append("  [python call stack]\n" + stack)
+        return "\n".join(parts)
+
+
+class InvalidArgumentError(EnforceNotMet):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class ResourceExhaustedError(EnforceNotMet):
+    code = "RESOURCE_EXHAUSTED"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+class ExternalError(EnforceNotMet):
+    code = "EXTERNAL"
+
+
+def enforce(condition, message, etype=InvalidArgumentError, op_context=None):
+    """PADDLE_ENFORCE: raise ``etype`` when ``condition`` is falsy."""
+    if not condition:
+        raise etype(message, op_context=op_context)
+    return True
+
+
+def op_error_context(op):
+    """Build the op-context dict from a static-graph OpDesc."""
+    return {
+        "op_type": getattr(op, "type", "?"),
+        "inputs": [n for ns in getattr(op, "inputs", {}).values() for n in ns],
+        "outputs": [
+            n for ns in getattr(op, "outputs", {}).values() for n in ns
+        ],
+    }

@@ -1,0 +1,119 @@
+"""Global FLAGS registry (env-driven runtime configuration).
+
+Reference parity: gflags definitions in paddle/fluid/platform/flags.cc
+(~50 flags, e.g. FLAGS_check_nan_inf :44), exported to Python through
+global_value_getter_setter.cc as ``core.globals()`` and the
+paddle.get_flags/set_flags API; ``init_gflags`` (pybind/pybind.cc:1652)
+imports ``FLAGS_*`` environment variables.
+
+TPU-native scope: only flags that change behavior on this runtime are
+registered — memory-fraction/allocator/cudnn knobs have no XLA
+equivalent and registering silent no-ops is worse than NotFound (the
+same contract as DistributedStrategy consumption). Each flag documents
+what consumes it.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "globals_view"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    value: object
+    default: object
+    type: type
+    help: str
+    # writable=False mirrors the reference's non-public globals
+    # (global_value_getter_setter.cc exposes some read-only)
+    writable: bool = True
+
+
+_REGISTRY: dict[str, _Flag] = {}
+
+
+def _coerce(value, typ):
+    if typ is bool and isinstance(value, str):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def define_flag(name: str, default, help: str = "", writable: bool = True):
+    """Register a flag (DEFINE_bool/int32/double/string equivalent,
+    platform/flags.cc). ``FLAGS_<name>`` env overrides the default at
+    definition time (init_gflags semantics)."""
+    typ = type(default)
+    value = default
+    env = os.environ.get(f"FLAGS_{name}")
+    if env is not None:
+        value = _coerce(env, typ)
+    _REGISTRY[name] = _Flag(name, value, default, typ, help, writable)
+    return value
+
+
+def flag(name: str):
+    """Fast internal read used by the runtime hot paths."""
+    try:
+        return _REGISTRY[name].value
+    except KeyError:
+        from .errors import NotFoundError
+
+        raise NotFoundError(
+            f"unknown flag {name!r}; known: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def get_flags(names):
+    """paddle.get_flags: dict of current values for name or list of names."""
+    if isinstance(names, str):
+        names = [names]
+    return {n: flag(n) for n in names}
+
+
+def set_flags(flags_map: dict):
+    """paddle.set_flags: update flag values with type checking."""
+    from .errors import InvalidArgumentError, NotFoundError
+
+    for name, value in flags_map.items():
+        f = _REGISTRY.get(name)
+        if f is None:
+            raise NotFoundError(
+                f"unknown flag {name!r}; known: {sorted(_REGISTRY)}"
+            )
+        if not f.writable:
+            raise InvalidArgumentError(f"flag {name!r} is read-only")
+        try:
+            f.value = _coerce(value, f.type)
+        except (TypeError, ValueError) as e:
+            raise InvalidArgumentError(
+                f"flag {name!r} expects {f.type.__name__}, got {value!r}"
+            ) from e
+
+
+def globals_view() -> dict:
+    """core.globals() equivalent: snapshot of every flag value."""
+    return {n: f.value for n, f in _REGISTRY.items()}
+
+
+# ---------------------------------------------------------------------------
+# Registered flags (each consumed somewhere — grep the name to find where)
+# ---------------------------------------------------------------------------
+
+# platform/flags.cc:44 — wired into framework/jit.py TrainStepFn (checkify
+# per-primitive NaN/Inf localization) and static/executor.py (post-run
+# scan of fetches/written vars, naming the variable)
+define_flag("check_nan_inf", False,
+            "scan step outputs for NaN/Inf and name the producing op")
+
+# platform/flags.cc benchmark — wired into framework/jit.py: synchronous
+# dispatch (block until ready each step) so wall-clock timings are exact
+define_flag("benchmark", False,
+            "synchronous step dispatch for exact per-step timing")
+
+# platform/enforce.h FLAGS_call_stack_level — wired into errors.py
+# formatting (0: message only, 1: + op context, 2: + python stack)
+define_flag("call_stack_level", 1,
+            "error verbosity: 0 message, 1 +op context, 2 +python stack")

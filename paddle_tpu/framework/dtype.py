@@ -55,16 +55,42 @@ complex128 = jnp.dtype(jnp.complex128)
 _DEFAULT_DTYPE = float32
 
 
+# x64-off canonicalization: TPUs have no 64-bit compute units; when JAX
+# x64 mode is disabled (the TPU-normal configuration) a requested 64-bit
+# dtype deliberately means its 32-bit counterpart. Doing this here — at
+# the single dtype chokepoint — keeps the paddle API surface (which
+# advertises int64 labels everywhere, framework.proto:104) while emitting
+# zero per-op truncation warnings from JAX.
+_X64_NARROW = {
+    "int64": "int32",
+    "uint64": "uint32",
+    "float64": "float32",
+    "complex128": "complex64",
+}
+
+
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
 def convert_dtype(dtype) -> jnp.dtype:
-    """Normalize any dtype spec (str, np dtype, jnp dtype) to a jnp.dtype."""
+    """Normalize any dtype spec (str, np dtype, jnp dtype) to a jnp.dtype.
+
+    With x64 disabled, 64-bit requests narrow to 32-bit silently (the
+    TPU-first contract; see _X64_NARROW above)."""
     if dtype is None:
         return _DEFAULT_DTYPE
     if isinstance(dtype, str):
         name = _ALIASES.get(dtype, dtype)
-        if name in _NAME_TO_DTYPE:
-            return jnp.dtype(_NAME_TO_DTYPE[name])
-        raise ValueError(f"unsupported dtype string: {dtype!r}")
-    return jnp.dtype(dtype)
+        if name not in _NAME_TO_DTYPE:
+            raise ValueError(f"unsupported dtype string: {dtype!r}")
+    else:
+        name = jnp.dtype(dtype).name
+    if name in _X64_NARROW and not _x64_enabled():
+        name = _X64_NARROW[name]
+    return jnp.dtype(_NAME_TO_DTYPE.get(name, name))
 
 
 def dtype_name(dtype) -> str:

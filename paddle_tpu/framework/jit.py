@@ -388,10 +388,47 @@ class TrainStepFn:
             self._freeze_unused_params(batch)
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         self._rng, sub = jax.random.split(self._rng)
-        self.state, metrics = self.compiled(self.state, batch, lr, sub)
+        from ..flags import flag
+
+        if flag("check_nan_inf"):
+            # FLAGS_check_nan_inf (platform/flags.cc:44 →
+            # details/nan_inf_utils_detail.cc): the reference scans every
+            # op's outputs post-run; the XLA-native equivalent is checkify
+            # float_checks — every primitive inside the compiled step gets
+            # an instrumented NaN check that reports the producing
+            # operation's source location.
+            metrics = self._run_checked(batch, lr, sub)
+        else:
+            self.state, metrics = self.compiled(self.state, batch, lr, sub)
+        if flag("benchmark"):
+            # FLAGS_benchmark: synchronous dispatch for exact timings
+            jax.block_until_ready(metrics)
         # NOTE: LR schedulers keep eager semantics — the user calls
         # scheduler.step() (per epoch or per batch) exactly as in eager mode;
         # the current value is read and fed in as a traced scalar each step.
+        return metrics
+
+    def _run_checked(self, batch, lr, sub):
+        from jax.experimental import checkify
+
+        from ..errors import FatalError
+
+        if not hasattr(self, "_checked_fn"):
+            # no donation: on error the pre-step state must stay valid
+            self._checked_fn = jax.jit(
+                checkify.checkify(self.pure, errors=checkify.float_checks)
+            )
+        err, (new_state, metrics) = self._checked_fn(
+            self.state, batch, lr, sub
+        )
+        try:
+            err.throw()
+        except Exception as e:  # checkify.JaxRuntimeError
+            raise FatalError(
+                f"check_nan_inf: non-finite value produced inside the "
+                f"train step: {e}"
+            ) from e
+        self.state = new_state
         return metrics
 
     def _freeze_unused_params(self, batch):

@@ -221,6 +221,28 @@ class _BlockRunner:
     def exec_ops(self, op_list, env, base_key, written_persist, block=None,
                  iter_idx=None):
         for op in op_list:
+            try:
+                self._exec_one(op, env, base_key, written_persist, block,
+                               iter_idx)
+            except Exception as e:
+                # PADDLE_ENFORCE behavior (platform/enforce.h): append the
+                # failing op's context to the message, preserving the
+                # original exception type; innermost op wins for nested
+                # control-flow blocks
+                marker = "[operator <"
+                if e.args and isinstance(e.args[0], str) and marker in e.args[0]:
+                    raise
+                ctx = (
+                    f"[operator < {op.type} > error] "
+                    f"inputs={op.inputs.get('X', [])} "
+                    f"outputs={op.outputs.get('Out', [])}"
+                )
+                head = e.args[0] if e.args else ""
+                e.args = (f"{head}\n  {ctx}",) + tuple(e.args[1:])
+                raise
+
+    def _exec_one(self, op, env, base_key, written_persist, block=None,
+                  iter_idx=None):
             in_names = op.inputs.get("X", [])
             out_names = op.outputs.get("Out", [])
             attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
@@ -403,12 +425,51 @@ class Executor:
         base_key = _random.split_key()
         fetches, written = jitted(feed_arrays, persist_arrays, base_key)
 
+        from ..flags import flag
+
+        if flag("check_nan_inf"):
+            # FLAGS_check_nan_inf: post-run scan of everything the block
+            # produced, naming the first non-finite variable (the
+            # variable-level analog of nan_inf_utils_detail.cc's per-op
+            # output scan; the op is identified by its output var name)
+            self._scan_nan_inf(program, fetch_names, fetches, written)
+
         for name, value in written.items():
             scope.set(name, value)
 
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor._from_array(f) for f in fetches]
+
+    @staticmethod
+    def _scan_nan_inf(program, fetch_names, fetches, written):
+        from ..errors import FatalError, op_error_context
+
+        def first_bad(named):
+            for name, arr in named:
+                a = np.asarray(arr)
+                if np.issubdtype(a.dtype, np.floating) and not np.all(
+                    np.isfinite(a)
+                ):
+                    return name
+            return None
+
+        bad = first_bad(
+            list(zip(fetch_names, fetches)) + list(written.items())
+        )
+        if bad is None:
+            return
+        producer = None
+        for _, op in _walk_ops(program, 0):
+            if bad in [n for ns in op.outputs.values() for n in ns]:
+                producer = op
+                break
+        ctx = op_error_context(producer) if producer is not None else None
+        raise FatalError(
+            f"check_nan_inf: variable {bad!r} contains NaN/Inf after the "
+            f"block ran",
+            op_context=ctx,
+        )
 
     # startup program: run initializer ops host-side (not jitted — once)
     def run_startup(self, startup_program=None, scope=None):

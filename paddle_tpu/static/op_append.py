@@ -84,7 +84,23 @@ def append_static_op(op_type, tensors, attrs, alias_outputs=None):
             kw["key"] = jax.random.key(0)
         return fn(*xs, **kw)
 
-    out_shape = jax.eval_shape(absfn, *specs)
+    try:
+        out_shape = jax.eval_shape(absfn, *specs)
+    except Exception as e:
+        # PADDLE_ENFORCE parity: shape-inference failures carry the op
+        # context (InferShape errors in the reference name the operator,
+        # platform/enforce.h); build-time is the earliest possible report
+        from ..errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"shape inference failed for operator {op_type!r} with input "
+            f"shapes {[tuple(s.shape) for s in specs]}: {e}",
+            op_context={
+                "op_type": op_type,
+                "inputs": in_names,
+                "outputs": [],
+            },
+        ) from e
     multi = isinstance(out_shape, (tuple, list))
     out_specs = list(out_shape) if multi else [out_shape]
 

@@ -1,0 +1,207 @@
+"""FLAGS registry, check_nan_inf, structured errors.
+
+Reference parity: platform/flags.cc (gflags + env import via init_gflags),
+core.globals()/paddle.get_flags/set_flags, FLAGS_check_nan_inf →
+details/nan_inf_utils_detail.cc (scan op outputs, name the op),
+platform/enforce.h PADDLE_ENFORCE + error_codes.proto taxonomy.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.errors as errors
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.static as static
+from paddle_tpu import ops
+from paddle_tpu.framework import jit as fjit
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    paddle.set_flags({"check_nan_inf": False, "benchmark": False,
+                      "call_stack_level": 1})
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_get_set_flags():
+    assert paddle.get_flags("check_nan_inf") == {"check_nan_inf": False}
+    paddle.set_flags({"check_nan_inf": True})
+    assert paddle.get_flags(["check_nan_inf"])["check_nan_inf"] is True
+
+
+def test_unknown_flag_raises_not_found():
+    with pytest.raises(errors.NotFoundError):
+        paddle.get_flags("no_such_flag")
+    with pytest.raises(errors.NotFoundError):
+        paddle.set_flags({"no_such_flag": 1})
+
+
+def test_flag_type_checking():
+    with pytest.raises(errors.InvalidArgumentError):
+        paddle.set_flags({"call_stack_level": "not-an-int"})
+
+
+def test_env_import(monkeypatch):
+    """FLAGS_<name> env var seeds the default (init_gflags semantics)."""
+    from paddle_tpu import flags as fl
+
+    monkeypatch.setenv("FLAGS_test_env_flag", "true")
+    val = fl.define_flag("test_env_flag", False, "test")
+    assert val is True
+    assert fl.flag("test_env_flag") is True
+    fl._REGISTRY.pop("test_env_flag")
+
+
+def test_globals_view():
+    from paddle_tpu import flags as fl
+
+    g = fl.globals_view()
+    assert "check_nan_inf" in g and "benchmark" in g
+
+
+# -- structured errors ------------------------------------------------------
+
+
+def test_error_taxonomy_codes():
+    assert errors.InvalidArgumentError.code == "INVALID_ARGUMENT"
+    assert errors.NotFoundError.code == "NOT_FOUND"
+    assert errors.UnimplementedError.code == "UNIMPLEMENTED"
+    assert issubclass(errors.OutOfRangeError, errors.EnforceNotMet)
+    assert issubclass(errors.EnforceNotMet, RuntimeError)
+
+
+def test_enforce_carries_op_context():
+    with pytest.raises(errors.InvalidArgumentError) as ei:
+        errors.enforce(
+            False, "bad shape",
+            op_context={"op_type": "matmul", "inputs": ["x"],
+                        "outputs": ["y"]},
+        )
+    msg = str(ei.value)
+    assert "INVALID_ARGUMENT" in msg
+    assert "operator < matmul >" in msg
+
+
+def test_call_stack_level_controls_verbosity():
+    paddle.set_flags({"call_stack_level": 0})
+    e0 = errors.InvalidArgumentError(
+        "m", op_context={"op_type": "mul", "inputs": [], "outputs": []}
+    )
+    assert "operator" not in str(e0)
+    paddle.set_flags({"call_stack_level": 2})
+    e2 = errors.InvalidArgumentError("m")
+    assert "python call stack" in str(e2)
+
+
+def test_build_time_shape_error_names_offending_op():
+    """InferShape failures report the op at graph-build time (the earliest
+    point — the reference reports at InferShape inside Run)."""
+    static.enable_static()
+    try:
+        static.reset_default_programs()
+        static.global_scope().clear()
+        x = static.data("x", [4, 4], "float32")
+        y = static.data("y", [3, 5], "float32")
+        with pytest.raises(errors.InvalidArgumentError) as ei:
+            ops.matmul(x, y)  # 4x4 @ 3x5: invalid
+        msg = str(ei.value)
+        assert "operator < matmul >" in msg
+        assert "shape inference failed" in msg
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+
+# -- check_nan_inf ----------------------------------------------------------
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(4, 2)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+def test_check_nan_inf_train_step():
+    """A loss that goes NaN must raise FatalError when the flag is on."""
+    m = TinyNet()
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+
+    def loss_fn(mm, x):
+        out = mm(x)
+        return (ops.log(out.sum() - out.sum() - 1.0)).mean()  # log(-1)=nan
+
+    paddle.set_flags({"check_nan_inf": True})
+    step = fjit.train_step(m, o, loss_fn)
+    x = np.ones((4, 4), np.float32)
+    with pytest.raises(errors.FatalError, match="check_nan_inf"):
+        step(x)
+
+
+def test_check_nan_inf_off_is_silent():
+    m = TinyNet()
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+
+    def loss_fn(mm, x):
+        out = mm(x)
+        return (ops.log(out.sum() - out.sum() - 1.0)).mean()
+
+    step = fjit.train_step(m, o, loss_fn)
+    x = np.ones((4, 4), np.float32)
+    loss = float(np.asarray(step(x)["loss"]))
+    assert np.isnan(loss)  # silently produces nan, reference default
+
+
+def test_check_nan_inf_healthy_step_passes():
+    m = TinyNet()
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+
+    def loss_fn(mm, x):
+        return (mm(x) ** 2).mean()
+
+    paddle.set_flags({"check_nan_inf": True})
+    step = fjit.train_step(m, o, loss_fn)
+    x = np.ones((4, 4), np.float32)
+    l0 = float(np.asarray(step(x)["loss"]))
+    l1 = float(np.asarray(step(x)["loss"]))
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_check_nan_inf_static_executor_names_variable():
+    static.enable_static()
+    try:
+        static.reset_default_programs()
+        static.global_scope().clear()
+        x = static.data("x", [3], "float32")
+        y = ops.log(x)  # log of negative input → nan
+        z = ops.add(y, ops.full([3], 1.0))
+        paddle.set_flags({"check_nan_inf": True})
+        exe = static.Executor()
+        with pytest.raises(errors.FatalError) as ei:
+            exe.run(feed={"x": np.array([-1.0, 1.0, 2.0], np.float32)},
+                    fetch_list=[z])
+        msg = str(ei.value)
+        assert "NaN/Inf" in msg
+        # the producing op is named via the variable it wrote
+        assert "operator <" in msg
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+
+def test_benchmark_flag_sync_dispatch():
+    m = TinyNet()
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    paddle.set_flags({"benchmark": True})
+    step = fjit.train_step(m, o, lambda mm, x: (mm(x) ** 2).mean())
+    out = step(np.ones((4, 4), np.float32))
+    assert np.isfinite(float(np.asarray(out["loss"])))
