@@ -16,6 +16,7 @@ from .base import (  # noqa: F401
     UserDefinedRoleMaker,
     fleet,
 )
+from . import utils  # noqa: F401  (fs layer: LocalFS/HDFSClient)
 
 # module-level facade functions, mirroring `from paddle.distributed import
 # fleet; fleet.init(...)`

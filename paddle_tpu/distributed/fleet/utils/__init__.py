@@ -1,0 +1,2 @@
+"""Fleet utilities (reference: python/paddle/distributed/fleet/utils/)."""
+from .fs import FS, LocalFS, HDFSClient  # noqa: F401

@@ -1,0 +1,110 @@
+"""Filesystem facade for checkpoint/dataset IO.
+
+Reference parity: python/paddle/fluid/incubate/fleet/utils/fs.py (FS base,
+LocalFS) and framework/io/fs.cc (shell-out fs layer). The HDFS client
+shells out to a hadoop binary in the reference; on this runtime HDFS is
+gated behind an explicit error (checkpoints on pod slices normally target
+GCS/local disk mounted paths, which LocalFS covers).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class FS:
+    """Abstract fs interface (fleet/utils/fs.py FS)."""
+
+    def ls_dir(self, path):
+        raise NotImplementedError
+
+    def is_dir(self, path):
+        raise NotImplementedError
+
+    def is_file(self, path):
+        raise NotImplementedError
+
+    def is_exist(self, path):
+        raise NotImplementedError
+
+    def mkdirs(self, path):
+        raise NotImplementedError
+
+    def delete(self, path):
+        raise NotImplementedError
+
+    def rename(self, src, dst):
+        raise NotImplementedError
+
+    def touch(self, path):
+        raise NotImplementedError
+
+    def upload(self, local, remote):
+        raise NotImplementedError
+
+    def download(self, remote, local):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """fleet/utils/fs.py LocalFS — local-disk implementation."""
+
+    def ls_dir(self, path):
+        if not self.is_exist(path):
+            return [], []
+        dirs, files = [], []
+        for entry in sorted(os.listdir(path)):
+            full = os.path.join(path, entry)
+            (dirs if os.path.isdir(full) else files).append(entry)
+        return dirs, files
+
+    def is_dir(self, path):
+        return os.path.isdir(path)
+
+    def is_file(self, path):
+        return os.path.isfile(path)
+
+    def is_exist(self, path):
+        return os.path.exists(path)
+
+    def mkdirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.remove(path)
+
+    def rename(self, src, dst):
+        os.replace(src, dst)
+
+    mv = rename
+
+    def touch(self, path):
+        with open(path, "a"):
+            os.utime(path, None)
+
+    def upload(self, local, remote):
+        self.mkdirs(os.path.dirname(remote) or ".")
+        if os.path.isdir(local):
+            shutil.copytree(local, remote, dirs_exist_ok=True)
+        else:
+            shutil.copy2(local, remote)
+
+    def download(self, remote, local):
+        self.upload(remote, local)
+
+
+class HDFSClient(FS):
+    """Gated: the reference shells out to `hadoop fs` (fs.py HDFSClient);
+    no hadoop binary exists on this runtime."""
+
+    def __init__(self, hadoop_home=None, configs=None):
+        from ....errors import UnavailableError
+
+        raise UnavailableError(
+            "HDFSClient requires a hadoop installation; point the "
+            "checkpoint dir at local/NFS/GCS-mounted storage and use "
+            "LocalFS instead"
+        )

@@ -160,7 +160,25 @@ class Model:
 
         cbks.on_train_begin()
         logs = {}
-        for epoch in range(epochs):
+
+        # auto-checkpoint (fluid/incubate/checkpoint/auto_checkpoint.py):
+        # when the PADDLE_EDL_AUTO_CHECKPOINT env is configured, fit
+        # resumes from the newest snapshot and snapshots periodically;
+        # train_epoch_range degrades to plain range() otherwise
+        from ..incubate import auto_checkpoint as acp
+
+        if acp.AutoCheckpointChecker().valid():
+            self._sync_from_step()
+            acp.register(self.network, self._optimizer,
+                         sync_fn=self._sync_from_step)
+            # the restore (inside train_epoch_range) rewrites the eager
+            # state; drop any compiled step so it rebuilds from it
+            self._train_step = None
+            epoch_iter = acp.train_epoch_range(epochs)
+        else:
+            epoch_iter = iter(range(epochs))
+
+        for epoch in epoch_iter:
             cbks.on_epoch_begin(epoch)
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)

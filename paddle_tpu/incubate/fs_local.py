@@ -1,0 +1,8 @@
+"""Default fs for auto-checkpoint (LocalFS; HDFS is gated in fs.py)."""
+from __future__ import annotations
+
+
+def local_fs():
+    from ..distributed.fleet.utils.fs import LocalFS
+
+    return LocalFS()

@@ -1,0 +1,181 @@
+"""Auto-checkpoint + fs layer tests.
+
+Reference parity: fluid/incubate/checkpoint/auto_checkpoint.py (env
+config :116-188, train_epoch_range resume), checkpoint_saver rotation,
+fleet/utils/fs.py LocalFS.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.fleet.utils import LocalFS
+from paddle_tpu.incubate import auto_checkpoint as acp
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    acp.reset_registry()
+    yield
+    acp.reset_registry()
+
+
+def _env(monkeypatch, tmp_path, inter="0"):
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_EDL_HDFS_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("PADDLE_JOB_ID", "job_1")
+    monkeypatch.setenv("PADDLE_EDL_SAVE_CHECKPOINT_INTER", inter)
+
+
+# -- fs layer ---------------------------------------------------------------
+
+
+def test_local_fs_roundtrip(tmp_path):
+    fs = LocalFS()
+    d = str(tmp_path / "a" / "b")
+    fs.mkdirs(d)
+    assert fs.is_dir(d) and fs.is_exist(d)
+    f = os.path.join(d, "x.txt")
+    fs.touch(f)
+    assert fs.is_file(f)
+    dirs, files = fs.ls_dir(str(tmp_path / "a"))
+    assert dirs == ["b"] and files == []
+    fs.rename(d, str(tmp_path / "c"))
+    assert fs.is_dir(str(tmp_path / "c"))
+    fs.delete(str(tmp_path / "c"))
+    assert not fs.is_exist(str(tmp_path / "c"))
+
+
+def test_hdfs_client_gated():
+    from paddle_tpu.distributed.fleet.utils import HDFSClient
+    from paddle_tpu.errors import UnavailableError
+
+    with pytest.raises(UnavailableError):
+        HDFSClient()
+
+
+# -- checker / env ----------------------------------------------------------
+
+
+def test_checker_disabled_without_env():
+    assert not acp.AutoCheckpointChecker().valid()
+    # degrades to plain range
+    assert list(acp.train_epoch_range(3)) == [0, 1, 2]
+
+
+def test_checker_env(monkeypatch, tmp_path):
+    _env(monkeypatch, tmp_path, inter="60")
+    c = acp.AutoCheckpointChecker()
+    assert c.valid()
+    assert c.save_inter == 60.0
+    assert c.job_dir == str(tmp_path / "job_1")
+
+
+# -- snapshot + resume ------------------------------------------------------
+
+
+def test_epoch_range_resumes(monkeypatch, tmp_path):
+    _env(monkeypatch, tmp_path)  # inter=0: save every epoch
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    acp.register(m, o)
+
+    seen = []
+    for epoch in acp.train_epoch_range(2):  # "job killed" after 2 epochs
+        seen.append(epoch)
+        # simulate a step so state changes per epoch
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        m(x).mean().backward()
+        o.step()
+        o.clear_grad()
+    assert seen == [0, 1]
+    w_done = np.asarray(m.weight._array).copy()
+
+    # fresh process: new objects, same registry name, larger epoch budget
+    acp.reset_registry()
+    paddle.seed(123)  # different init — must be overwritten by restore
+    m2 = nn.Linear(4, 2)
+    o2 = opt.SGD(learning_rate=0.1, parameters=m2.parameters())
+    acp.register(m2, o2)
+    resumed = list(acp.train_epoch_range(4))
+    assert resumed == [2, 3], resumed  # epochs 0,1 already done
+    # restored weights are exactly the snapshot (epochs 2,3 ran no steps)
+    np.testing.assert_allclose(
+        np.asarray(m2.weight._array), w_done, rtol=0, atol=0
+    )
+    # crash-before-snapshot semantics: a generator abandoned mid-epoch
+    # redoes that epoch on resume (the snapshot happens at epoch end)
+    acp.reset_registry()
+    m3 = nn.Linear(4, 2)
+    acp.register(m3)
+    g = acp.train_epoch_range(6)
+    assert next(g) == 4
+    g.close()  # crash before epoch 4's snapshot
+    acp.reset_registry()
+    m4 = nn.Linear(4, 2)
+    acp.register(m4)
+    assert next(acp.train_epoch_range(6)) == 4  # epoch 4 redone
+
+
+def test_snapshot_rotation(monkeypatch, tmp_path):
+    _env(monkeypatch, tmp_path)
+    m = nn.Linear(2, 2)
+    acp.register(m)
+    for _ in acp.train_epoch_range(5):
+        pass
+    fs = LocalFS()
+    checker = acp.AutoCheckpointChecker()
+    kept = acp._list_snapshots(checker, fs)
+    assert len(kept) <= 2  # checkpoint_saver max_num_checkpoints
+    assert kept[-1] == 4
+
+
+def test_sync_fn_called_before_save(monkeypatch, tmp_path):
+    _env(monkeypatch, tmp_path)
+    m = nn.Linear(2, 2)
+    calls = []
+    acp.register(m, sync_fn=lambda: calls.append(1))
+    for _ in acp.train_epoch_range(2):
+        pass
+    assert calls  # sync ran before snapshots
+
+
+def test_hapi_fit_auto_checkpoint(monkeypatch, tmp_path):
+    """Model.fit resumes mid-training via the env configuration."""
+    _env(monkeypatch, tmp_path)
+    from paddle_tpu.hapi import Model
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 4).astype("float32")
+    Y = rng.randint(0, 2, (32,)).astype("int64")
+
+    paddle.seed(1)
+    net = nn.Linear(4, 2)
+    model = Model(net)
+    model.prepare(
+        optimizer=opt.SGD(learning_rate=0.05, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+    )
+    model.fit(list(zip(X, Y)), batch_size=8, epochs=2, verbose=0)
+    checker = acp.AutoCheckpointChecker()
+    snaps = acp._list_snapshots(checker, LocalFS())
+    assert snaps and snaps[-1] == 1
+
+    # second run "resumes": all epochs already done → no training steps
+    acp.reset_registry()
+    paddle.seed(2)
+    net2 = nn.Linear(4, 2)
+    model2 = Model(net2)
+    model2.prepare(
+        optimizer=opt.SGD(learning_rate=0.05, parameters=net2.parameters()),
+        loss=nn.CrossEntropyLoss(),
+    )
+    model2.fit(list(zip(X, Y)), batch_size=8, epochs=2, verbose=0)
+    # weights restored from run 1's snapshot (not net2's fresh init)
+    w1 = np.asarray(net.state_dict()["weight"].numpy())
+    w2 = np.asarray(net2.state_dict()["weight"].numpy())
+    np.testing.assert_allclose(w1, w2, rtol=1e-6, atol=1e-7)
