@@ -1,0 +1,269 @@
+// C inference API (reference parity: paddle/fluid/inference/capi/
+// paddle_c_api.h + c_api.cc — a C ABI over the AnalysisPredictor so
+// non-C++ hosts can run inference).
+//
+// TPU-native design: the predictor itself is the XLA-compiled static
+// executor driven from Python; this library embeds the CPython
+// interpreter (the inverse of the reference's pybind direction) and
+// exposes the same create/set-input/run/fetch surface as C symbols.
+// One interpreter serves all predictors; calls are GIL-serialized so
+// the ABI is thread-safe for independent handles.
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+PyObject* g_helpers = nullptr;  // module dict with the helper functions
+std::string g_last_error;
+std::string g_scratch;  // returned const char*s point here
+
+const char kHelperSrc[] = R"PY(
+import numpy as np
+import paddle_tpu
+from paddle_tpu.inference import Config, create_predictor
+
+def _create(model_dir):
+    return create_predictor(Config(model_dir))
+
+def _input_names(pred):
+    return pred.get_input_names()
+
+def _output_names(pred):
+    return pred.get_output_names()
+
+def _set_input(pred, name, data, shape, dtype):
+    arr = np.frombuffer(data, dtype=dtype).reshape(shape)
+    pred.get_input_handle(name).copy_from_cpu(arr)
+
+def _run(pred):
+    pred.run()
+
+def _get_output(pred, name):
+    out = np.ascontiguousarray(pred.get_output_handle(name).copy_to_cpu())
+    return out.tobytes(), list(out.shape), str(out.dtype)
+)PY";
+
+void set_error_from_python() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyObject* s = value ? PyObject_Str(value) : nullptr;
+  g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  Py_XDECREF(s);
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+PyObject* helper(const char* name) {
+  return PyDict_GetItemString(g_helpers, name);  // borrowed
+}
+
+}  // namespace
+
+extern "C" {
+
+// All functions return 0 on success, -1 on error (PD_GetLastError tells).
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+int PD_Init() {
+  if (g_helpers) return 0;
+  if (!Py_IsInitialized()) Py_Initialize();
+  PyObject* mod = PyModule_New("paddle_tpu_capi_helpers");
+  PyObject* dict = PyModule_GetDict(mod);
+  PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
+  PyObject* res =
+      PyRun_String(kHelperSrc, Py_file_input, dict, dict);
+  if (!res) {
+    set_error_from_python();
+    Py_DECREF(mod);
+    return -1;
+  }
+  Py_DECREF(res);
+  g_helpers = dict;
+  Py_INCREF(g_helpers);
+  return 0;
+}
+
+void* PD_CreatePredictor(const char* model_dir) {
+  if (PD_Init() != 0) return nullptr;
+  PyObject* out = PyObject_CallFunction(helper("_create"), "s", model_dir);
+  if (!out) {
+    set_error_from_python();
+    return nullptr;
+  }
+  return out;  // owned handle
+}
+
+void PD_DeletePredictor(void* pred) {
+  Py_XDECREF(static_cast<PyObject*>(pred));
+}
+
+static int name_at(const char* fn, void* pred, int i, const char** out) {
+  PyObject* names = PyObject_CallFunction(
+      helper(fn), "O", static_cast<PyObject*>(pred));
+  if (!names) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_ssize_t n = PyList_Size(names);
+  if (i < 0 || i >= n) {
+    g_last_error = "index out of range";
+    Py_DECREF(names);
+    return -1;
+  }
+  g_scratch = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  Py_DECREF(names);
+  *out = g_scratch.c_str();
+  return 0;
+}
+
+int PD_GetInputNum(void* pred) {
+  PyObject* names = PyObject_CallFunction(
+      helper("_input_names"), "O", static_cast<PyObject*>(pred));
+  if (!names) {
+    set_error_from_python();
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(names));
+  Py_DECREF(names);
+  return n;
+}
+
+int PD_GetOutputNum(void* pred) {
+  PyObject* names = PyObject_CallFunction(
+      helper("_output_names"), "O", static_cast<PyObject*>(pred));
+  if (!names) {
+    set_error_from_python();
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(names));
+  Py_DECREF(names);
+  return n;
+}
+
+const char* PD_GetInputName(void* pred, int i) {
+  const char* out = nullptr;
+  return name_at("_input_names", pred, i, &out) == 0 ? out : nullptr;
+}
+
+const char* PD_GetOutputName(void* pred, int i) {
+  const char* out = nullptr;
+  return name_at("_output_names", pred, i, &out) == 0 ? out : nullptr;
+}
+
+static int set_input(void* pred, const char* name, const void* data,
+                     size_t bytes, const long long* shape, int ndim,
+                     const char* dtype) {
+  PyObject* shp = PyList_New(ndim);
+  for (int d = 0; d < ndim; ++d) {
+    PyList_SetItem(shp, d, PyLong_FromLongLong(shape[d]));
+  }
+  PyObject* buf = PyBytes_FromStringAndSize(
+      static_cast<const char*>(data), static_cast<Py_ssize_t>(bytes));
+  PyObject* res = PyObject_CallFunction(
+      helper("_set_input"), "OsOOs", static_cast<PyObject*>(pred), name,
+      buf, shp, dtype);
+  Py_DECREF(shp);
+  Py_DECREF(buf);
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+int PD_SetInputFloat(void* pred, const char* name, const float* data,
+                     const long long* shape, int ndim) {
+  size_t numel = 1;
+  for (int d = 0; d < ndim; ++d) numel *= static_cast<size_t>(shape[d]);
+  return set_input(pred, name, data, numel * sizeof(float), shape, ndim,
+                   "float32");
+}
+
+int PD_SetInputInt64(void* pred, const char* name, const long long* data,
+                     const long long* shape, int ndim) {
+  size_t numel = 1;
+  for (int d = 0; d < ndim; ++d) numel *= static_cast<size_t>(shape[d]);
+  return set_input(pred, name, data, numel * sizeof(long long), shape,
+                   ndim, "int64");
+}
+
+int PD_Run(void* pred) {
+  PyObject* res = PyObject_CallFunction(
+      helper("_run"), "O", static_cast<PyObject*>(pred));
+  if (!res) {
+    set_error_from_python();
+    return -1;
+  }
+  Py_DECREF(res);
+  return 0;
+}
+
+// Fetch: query ndim/shape first, then copy the flat float data.
+int PD_GetOutputNdim(void* pred, const char* name) {
+  PyObject* out = PyObject_CallFunction(
+      helper("_get_output"), "Os", static_cast<PyObject*>(pred), name);
+  if (!out) {
+    set_error_from_python();
+    return -1;
+  }
+  int ndim = static_cast<int>(PyList_Size(PyTuple_GetItem(out, 1)));
+  Py_DECREF(out);
+  return ndim;
+}
+
+int PD_GetOutputShape(void* pred, const char* name, long long* shape_out) {
+  PyObject* out = PyObject_CallFunction(
+      helper("_get_output"), "Os", static_cast<PyObject*>(pred), name);
+  if (!out) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* shp = PyTuple_GetItem(out, 1);
+  for (Py_ssize_t d = 0; d < PyList_Size(shp); ++d) {
+    shape_out[d] = PyLong_AsLongLong(PyList_GetItem(shp, d));
+  }
+  Py_DECREF(out);
+  return 0;
+}
+
+int PD_CopyOutputFloat(void* pred, const char* name, float* buf,
+                       long long numel) {
+  PyObject* out = PyObject_CallFunction(
+      helper("_get_output"), "Os", static_cast<PyObject*>(pred), name);
+  if (!out) {
+    set_error_from_python();
+    return -1;
+  }
+  PyObject* bytes = PyTuple_GetItem(out, 0);
+  const char* dtype = PyUnicode_AsUTF8(PyTuple_GetItem(out, 2));
+  if (std::strcmp(dtype, "float32") != 0) {
+    g_last_error = std::string("output dtype is ") + dtype +
+                   ", use the matching PD_CopyOutput*";
+    Py_DECREF(out);
+    return -1;
+  }
+  Py_ssize_t have = PyBytes_Size(bytes);
+  size_t want = static_cast<size_t>(numel) * sizeof(float);
+  if (static_cast<size_t>(have) != want) {
+    g_last_error = "output size mismatch";
+    Py_DECREF(out);
+    return -1;
+  }
+  std::memcpy(buf, PyBytes_AsString(bytes), want);
+  Py_DECREF(out);
+  return 0;
+}
+
+void PD_Finalize() {
+  Py_XDECREF(g_helpers);
+  g_helpers = nullptr;
+  // the interpreter stays up: other predictors/embedders may share it
+}
+
+}  // extern "C"

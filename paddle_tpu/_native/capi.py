@@ -1,0 +1,44 @@
+"""Build helper for the C inference API (capi.cpp).
+
+Reference parity: inference/capi/ builds libpaddle_fluid_c.so; here
+build_capi() compiles libpaddle_tpu_capi.so (embedding CPython) into
+the native cache and returns its path. C hosts link against it and the
+Python shared library:
+
+    g++ main.c -o app -L<cache> -lpaddle_tpu_capi \
+        -L$(python3-config --prefix)/lib -lpython3.12
+
+PYTHONPATH must reach paddle_tpu at runtime (the embedded interpreter
+imports it on PD_Init).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+import sysconfig
+
+from . import _CACHE, _HERE
+
+
+def build_capi() -> str:
+    """Compile capi.cpp → cached libpaddle_tpu_capi.so; returns the path."""
+    src_path = os.path.join(_HERE, "capi.cpp")
+    with open(src_path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    os.makedirs(_CACHE, exist_ok=True)
+    so_path = os.path.join(_CACHE, f"libpaddle_tpu_capi-{digest}.so")
+    if os.path.exists(so_path):
+        return so_path
+    include = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ldver = sysconfig.get_config_var("LDVERSION")
+    tmp = so_path + f".tmp{os.getpid()}"
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+        f"-I{include}", src_path, "-o", tmp,
+        f"-L{libdir}", f"-lpython{ldver}", f"-Wl,-rpath,{libdir}",
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so_path)
+    return so_path

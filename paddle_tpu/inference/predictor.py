@@ -2,10 +2,20 @@
 from __future__ import annotations
 
 import os
+import warnings
 
 import numpy as np
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+
+
+def _compat_noop(name, why):
+    """Accepted-for-compat Config methods warn instead of silently doing
+    nothing (AnalysisConfig parity without a false sense of effect)."""
+    warnings.warn(
+        f"inference.Config.{name} has no effect on the TPU runtime: {why}",
+        stacklevel=3,
+    )
 
 
 class Config:
@@ -27,7 +37,8 @@ class Config:
         return self._model_dir
 
     def enable_use_gpu(self, memory_pool_mb=100, device_id=0):
-        pass  # accepted for compat; device selection is XLA's
+        _compat_noop("enable_use_gpu",
+                     "device selection and memory pools are XLA's")
 
     def enable_tpu(self):
         self._use_tpu = True
@@ -36,13 +47,25 @@ class Config:
         self._use_tpu = False
 
     def switch_ir_optim(self, flag=True):
+        """Toggle the load-time pass pipeline (ir_pass_manager.cc):
+        constant folding + dead-op elimination."""
         self._ir_optim = flag
 
     def enable_memory_optim(self, flag=True):
+        _compat_noop("enable_memory_optim",
+                     "XLA's buffer assignment already reuses activations")
         self._memory_optim = flag
 
     def set_cpu_math_library_num_threads(self, n):
-        pass
+        _compat_noop("set_cpu_math_library_num_threads",
+                     "host threading is managed by the XLA client")
+
+    def enable_tensorrt_engine(self, *a, **k):
+        _compat_noop("enable_tensorrt_engine",
+                     "there is no TensorRT; XLA compiles the whole graph")
+
+    def enable_mkldnn(self, *a, **k):
+        _compat_noop("enable_mkldnn", "no MKLDNN on this runtime")
 
 
 class Tensor:
@@ -81,6 +104,17 @@ class Predictor:
                 params_filename=config._params_file,
             )
         )
+        self.pass_stats = {}
+        if config._ir_optim:
+            # ir_pass_manager.cc: load-time graph optimization
+            from ..static.executor import global_scope
+            from .passes import IrPassManager
+
+            pm = IrPassManager()
+            self.pass_stats = pm.apply(
+                self._program, global_scope(),
+                self._feed_names, self._fetch_names,
+            )
         self._inputs = {n: Tensor(n) for n in self._feed_names}
         self._outputs = {n: Tensor(n) for n in self._fetch_names}
 
