@@ -540,6 +540,32 @@ def eval_step(model, fn=None, jit=True):
 
     compiled = jax.jit(pure) if jit else pure
 
+    # the module-tree walk (named_parameters/named_buffers recursion) runs
+    # once; per call only the current arrays are read off the cached
+    # Tensor objects — fresh values with no per-step tree traversal
+    # (training mutates t._array in place, never the Tensor identities)
+    cached = {}
+
+    def snapshot():
+        if not cached:
+            cached["params"] = [
+                (n, p, getattr(p, "trainable", True))
+                for n, p in model.named_parameters()
+            ]
+            cached["buffers"] = [
+                (n, b) for n, b in model.named_buffers() if b is not None
+            ]
+        params, frozen = OrderedDict(), OrderedDict()
+        for n, p, trainable in cached["params"]:
+            (params if trainable else frozen)[n] = p._array
+        return {
+            "params": params,
+            "frozen": frozen,
+            "buffers": OrderedDict(
+                (n, b._array) for n, b in cached["buffers"]
+            ),
+        }
+
     def run(*batch):
         arrs = tuple(
             b._array if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
@@ -547,7 +573,7 @@ def eval_step(model, fn=None, jit=True):
         was_training = model.training
         model.eval()
         try:
-            return compiled(capture_state(model), *arrs)
+            return compiled(snapshot(), *arrs)
         finally:
             if was_training:
                 model.train()

@@ -388,6 +388,16 @@ def take_along_axis(x, index, axis):
 
 def masked_select(x, mask):
     # dynamic-shape op: executes on host values (not jittable) — paddle parity
+    from ..static.program import in_static_mode
+
+    if in_static_mode():
+        from ..errors import UnimplementedError
+
+        raise UnimplementedError(
+            "operator 'masked_select' has a data-dependent output shape and "
+            "cannot appear in a static program; use where/multiply masking "
+            "instead"
+        )
     arr = np.asarray(_t(x)._array)[np.asarray(_t(mask)._array)]
     return to_tensor(arr)
 

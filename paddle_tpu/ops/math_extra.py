@@ -119,7 +119,7 @@ def searchsorted(sorted_sequence, values, *, out_int32=False, right=False):
     return out.astype(jnp.int32 if out_int32 else jnp.int64)
 
 
-@register_op("unique", num_outputs=4)
+@register_op("unique", num_outputs=4, eager_only=True)
 def unique(x, *, return_index=False, return_inverse=False,
            return_counts=False, axis=None):
     """Eager-only (data-dependent size); returns (out, index, inverse,
@@ -133,7 +133,7 @@ def unique(x, *, return_index=False, return_inverse=False,
             jnp.asarray(counts))
 
 
-@register_op("unique_consecutive", num_outputs=3)
+@register_op("unique_consecutive", num_outputs=3, eager_only=True)
 def unique_consecutive(x, *, return_inverse=False, return_counts=False,
                        axis=None):
     _eager_only("unique_consecutive", x)
@@ -149,13 +149,13 @@ def unique_consecutive(x, *, return_inverse=False, return_counts=False,
     return jnp.asarray(out), jnp.asarray(grp), jnp.asarray(counts)
 
 
-@register_op("masked_select")
+@register_op("masked_select", eager_only=True)
 def masked_select(x, mask):
     _eager_only("masked_select", x, mask)
     return jnp.asarray(np.asarray(x)[np.asarray(mask)])
 
 
-@register_op("nonzero")
+@register_op("nonzero", eager_only=True)
 def nonzero(x, *, as_tuple=False):
     _eager_only("nonzero", x)
     nz = np.nonzero(np.asarray(x))

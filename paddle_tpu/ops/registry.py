@@ -22,16 +22,29 @@ class OpDef(NamedTuple):
 _REGISTRY: Dict[str, OpDef] = {}
 
 
-def register_op(name: str, num_outputs: int = 1):
-    """Decorator: register a pure-JAX kernel under a fluid op type name."""
+def register_op(name: str, num_outputs: int = 1, eager_only: bool = False):
+    """Decorator: register a pure-JAX kernel under a fluid op type name.
+
+    ``eager_only`` marks kernels whose output shape depends on data
+    (unique/nonzero/masked_select and the maxlen=None sequence forms) —
+    they cannot live inside a compiled XLA block, and the static graph
+    builder rejects them at append time (op_append.py) instead of
+    letting whole-block jit fail with an opaque trace error.
+    """
 
     def deco(fn):
         if name in _REGISTRY:
             raise ValueError(f"op {name!r} registered twice")
         _REGISTRY[name] = OpDef(name, fn, num_outputs)
+        if eager_only:
+            EAGER_ONLY_OPS.add(name)
         return fn
 
     return deco
+
+
+# ops with data-dependent output shapes: forbidden in static programs
+EAGER_ONLY_OPS: set[str] = set()
 
 
 def get_op(name: str) -> OpDef:

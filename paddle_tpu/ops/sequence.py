@@ -83,7 +83,7 @@ def sequence_pad(x, lengths, *, maxlen=None, pad_value=0.0):
     return out, lengths
 
 
-@register_op("sequence_unpad")
+@register_op("sequence_unpad", eager_only=True)
 def sequence_unpad(x, lengths):
     """Padded [B, T, ...] -> flat [N, ...]. Output length is data-dependent
     (sum of lengths) — eager-only, mirroring masked_select's contract."""
@@ -222,7 +222,7 @@ def sequence_concat(x, xlen, y, ylen):
     return out, lengths
 
 
-@register_op("sequence_expand")
+@register_op("sequence_expand", eager_only=True)
 def sequence_expand(x, rep):
     """Repeat row b of x rep[b] times (sequence_expand_op.cc). Output row
     count is data-dependent — eager-only."""
@@ -245,7 +245,7 @@ def sequence_enumerate(x, *, win_size, pad_value=0):
     return jnp.where(valid, x[idx], jnp.asarray(pad_value, x.dtype))
 
 
-@register_op("sequence_erase")
+@register_op("sequence_erase", eager_only=True)
 def sequence_erase(x, *, tokens=()):
     """Remove listed tokens (sequence_erase_op.cc). Output size is
     data-dependent — eager-only."""

@@ -204,10 +204,19 @@ def cond(pred, true_fn, false_fn, name=None):
         raise ValueError(
             f"cond branches returned {len(t_outs)} vs {len(f_outs)} outputs"
         )
-    for t, f in zip(t_outs, f_outs):
+    for i, (t, f) in enumerate(zip(t_outs, f_outs)):
         if str(t.dtype) != str(f.dtype):
             raise TypeError(
                 f"cond branch dtype mismatch: {t.dtype} vs {f.dtype}"
+            )
+        # shape check at build time: a mismatch would otherwise surface
+        # as an opaque lax.cond XLA error at exe.run
+        ts, fs = t.shape, f.shape
+        if ts is not None and fs is not None and list(ts) != list(fs):
+            raise ValueError(
+                f"cond branch output {i} shape mismatch: true_fn returned "
+                f"{list(ts)}, false_fn returned {list(fs)} — both branches "
+                "must produce identically-shaped outputs (lax.cond)"
             )
 
     captures = _collect_captures(prog, [true_blk.idx, false_blk.idx], set())

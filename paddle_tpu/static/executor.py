@@ -357,6 +357,7 @@ class Executor:
     def __init__(self, place: Place | None = None):
         self.place = place or _default_place()
         self._cache = {}
+        self._cache_limit = 128  # compiled-block LRU bound
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True):
@@ -408,7 +409,8 @@ class Executor:
                 next_id += 1
 
         sig = (
-            id(program), program._version, tuple(fetch_names), tuple(feed_names),
+            getattr(program, "_identity_token", id(program)),
+            program._version, tuple(fetch_names), tuple(feed_names),
             tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
             tuple(persist_in),
         )
@@ -419,6 +421,13 @@ class Executor:
             jitted = jax.jit(traced)
             entry = (jitted, persist_in)
             self._cache[sig] = entry
+            # LRU-style eviction: a long-lived Executor fed many program
+            # versions (notebooks, unit-test loops) must not grow the
+            # cache unboundedly
+            while len(self._cache) > self._cache_limit:
+                self._cache.pop(next(iter(self._cache)))
+        else:
+            self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
         jitted, persist_in = entry
 
         persist_arrays = [scope.get(n) for n in persist_in]

@@ -92,6 +92,135 @@ def dropout(x, dropout_prob=0.5, is_test=False):
     return ops.dropout(x, p=dropout_prob, training=not is_test)
 
 
+# -- recurrent front end ------------------------------------------------------
+# fluid/layers/rnn.py lstm/dynamic_gru + StaticRNN — lowered to the scan
+# construct (lax.scan), which XLA reverse-differentiates; weights follow
+# the single-matmul-per-gate-block layout the MXU wants.
+
+
+def _recurrent(x, init_states, hidden_size, n_gates, step, time_major,
+               init_of):
+    """Shared scan driver: x [B,T,D] (or [T,B,D]), per-step ``step``."""
+    from .control_flow import scan
+
+    if not time_major:
+        x = ops.transpose(x, [1, 0, 2])  # [T, B, D]
+    in_dim = x.shape[2]
+    w_ih = create_parameter([in_dim, n_gates * hidden_size], str(x.dtype))
+    w_hh = create_parameter([hidden_size, n_gates * hidden_size],
+                            str(x.dtype))
+    b = create_parameter([n_gates * hidden_size], str(x.dtype), is_bias=True)
+
+    if init_states is None:
+        batch = x.shape[1]
+        if batch in (-1, None):
+            raise ValueError(
+                "recurrent layers need either a static batch dim or "
+                "explicit initial states (XLA carries are fixed-shape)"
+            )
+        init_states = init_of(batch)
+
+    def cell(*args):
+        states, xt = list(args[:-1]), args[-1]
+        gates = ops.add(
+            ops.add(ops.matmul(xt, w_ih), ops.matmul(states[0], w_hh)), b
+        )
+        new_states = step(states, gates)
+        return new_states, [new_states[0]]
+
+    finals, ys = scan(cell, init_states, [x])
+    out = ys[0]  # [T, B, H]
+    if not time_major:
+        out = ops.transpose(out, [1, 0, 2])
+    return out, finals
+
+
+def simple_rnn(x, hidden_size, init_h=None, time_major=False, name=None):
+    """Elman RNN over scan (StaticRNN/recurrent_op capability,
+    fluid/layers/control_flow.py StaticRNN). Returns (out, [h_T])."""
+
+    def step(states, gates):
+        return [ops.tanh(gates)]
+
+    return _recurrent(
+        x, [init_h] if init_h is not None else None, hidden_size, 1, step,
+        time_major,
+        lambda b: [ops.zeros([b, hidden_size], str(x.dtype))],
+    )
+
+
+def lstm(x, hidden_size, init_h=None, init_c=None, time_major=False,
+         name=None):
+    """fluid.layers.lstm (fluid/layers/rnn.py) — (out, [h_T, c_T])."""
+
+    def step(states, gates):
+        h, c = states
+        i, f, g, o = ops.split(gates, 4, axis=-1)
+        c2 = ops.add(
+            ops.multiply(ops.sigmoid(f), c),
+            ops.multiply(ops.sigmoid(i), ops.tanh(g)),
+        )
+        h2 = ops.multiply(ops.sigmoid(o), ops.tanh(c2))
+        return [h2, c2]
+
+    inits = None
+    if init_h is not None and init_c is not None:
+        inits = [init_h, init_c]
+    return _recurrent(
+        x, inits, hidden_size, 4, step, time_major,
+        lambda b: [ops.zeros([b, hidden_size], str(x.dtype)),
+                   ops.zeros([b, hidden_size], str(x.dtype))],
+    )
+
+
+def gru(x, hidden_size, init_h=None, time_major=False, name=None):
+    """fluid.layers.dynamic_gru capability — (out, [h_T]).
+
+    Gate math follows the standard GRU; the candidate's recurrent term is
+    computed on the reset-scaled state (the reference's default mode).
+    """
+    from .control_flow import scan
+
+    if not time_major:
+        x = ops.transpose(x, [1, 0, 2])
+    in_dim = x.shape[2]
+    H = hidden_size
+    w_ih = create_parameter([in_dim, 3 * H], str(x.dtype))
+    w_hh_rz = create_parameter([H, 2 * H], str(x.dtype))
+    w_hh_c = create_parameter([H, H], str(x.dtype))
+    b = create_parameter([3 * H], str(x.dtype), is_bias=True)
+
+    if init_h is None:
+        batch = x.shape[1]
+        if batch in (-1, None):
+            raise ValueError(
+                "gru needs a static batch dim or explicit init_h"
+            )
+        init_h = ops.zeros([batch, H], str(x.dtype))
+
+    def cell(h, xt):
+        xg = ops.add(ops.matmul(xt, w_ih), b)  # [B, 3H]
+        x_rz = ops.slice(xg, [1], [0], [2 * H])
+        x_c = ops.slice(xg, [1], [2 * H], [3 * H])
+        rz = ops.sigmoid(ops.add(x_rz, ops.matmul(h, w_hh_rz)))
+        r = ops.slice(rz, [1], [0], [H])
+        z = ops.slice(rz, [1], [H], [2 * H])
+        cand = ops.tanh(
+            ops.add(x_c, ops.matmul(ops.multiply(r, h), w_hh_c))
+        )
+        h2 = ops.add(
+            ops.multiply(z, h),
+            ops.multiply(ops.subtract(ops.full([], 1.0), z), cand),
+        )
+        return [h2], [h2]
+
+    finals, ys = scan(cell, [init_h], [x])
+    out = ys[0]
+    if not time_major:
+        out = ops.transpose(out, [1, 0, 2])
+    return out, finals
+
+
 # -- control flow (operators/controlflow/, fluid/layers/control_flow.py) -----
 from .control_flow import (  # noqa: E402,F401
     case,

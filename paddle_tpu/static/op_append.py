@@ -59,6 +59,22 @@ def capture_constant(t, block=None):
 
 def append_static_op(op_type, tensors, attrs, alias_outputs=None):
     """Append an OpDesc to the current block; returns output Variable(s)."""
+    from ..ops.registry import EAGER_ONLY_OPS
+
+    if op_type in EAGER_ONLY_OPS:
+        # build-time guardrail: the whole block compiles as one XLA
+        # module (executor.py), so a data-dependent-shape op anywhere in
+        # the program would make it unrunnable — reject with a clear
+        # message now instead of an opaque trace error at exe.run
+        from ..errors import UnimplementedError
+
+        raise UnimplementedError(
+            f"operator {op_type!r} has a data-dependent output shape and "
+            "cannot appear in a static program (the block compiles to one "
+            "XLA module with static shapes). Run it eagerly, or use the "
+            "static-friendly alternative its docstring names "
+            "(mask/pad/static-length forms)."
+        )
     block = default_main_program().current_block()
     prog = default_main_program()
 

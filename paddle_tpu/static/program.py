@@ -11,6 +11,7 @@ Serialization via to_dict/from_dict + json (framework.proto equivalent).
 from __future__ import annotations
 
 import contextlib
+import itertools
 import json
 from typing import Any, Dict, List
 
@@ -231,6 +232,9 @@ class Variable(Tensor):
         return id(self)
 
 
+_program_token_counter = itertools.count()
+
+
 class Program:
     """ProgramDesc (framework.proto:212)."""
 
@@ -239,6 +243,10 @@ class Program:
         self._name_counter = {}
         self._version = 0
         self.random_seed = None
+        # process-unique identity for executor compile caching: id() can
+        # be reused after GC, silently aliasing two programs at the same
+        # version in the cache
+        self._identity_token = next(_program_token_counter)
 
     def global_block(self) -> Block:
         return self.blocks[0]

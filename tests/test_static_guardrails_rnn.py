@@ -1,0 +1,185 @@
+"""Static-graph guardrails, executor cache identity, static RNN layers.
+
+Reference parity: build-time op validation (the reference rejects at
+InferShape, framework/operator.cc:1003), fluid/layers/rnn.py lstm /
+dynamic_gru / StaticRNN, and Executor compile-cache correctness.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.errors as errors
+import paddle_tpu.static as static
+from paddle_tpu import ops
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    static.reset_default_programs()
+    static.global_scope().clear()
+    yield
+    static.disable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+
+
+# -- eager-only guardrails --------------------------------------------------
+
+
+@pytest.mark.parametrize("build", [
+    lambda x: ops.nonzero(x),
+    lambda x: ops.masked_select(x, ops.greater_than(x, ops.full([4], 0.0))),
+    lambda x: ops.unique(x),
+])
+def test_eager_only_ops_rejected_at_build_time(build):
+    static.enable_static()
+    x = static.data("x", [4], "float32")
+    with pytest.raises(errors.UnimplementedError,
+                       match="data-dependent output shape"):
+        build(x)
+
+
+def test_eager_only_ops_still_work_eagerly():
+    x = np.array([0.0, 1.0, 0.0, 2.0], np.float32)
+    import paddle_tpu as paddle
+
+    nz = ops.nonzero(paddle.to_tensor(x))
+    assert np.asarray(nz.numpy()).reshape(-1).tolist() == [1, 3]
+
+
+# -- executor cache identity ------------------------------------------------
+
+
+def test_cache_not_aliased_by_id_reuse():
+    """Two programs at the same version must never share a cache entry —
+    guaranteed by the identity token, not id()."""
+    import gc
+
+    static.enable_static()
+    exe = static.Executor()
+
+    def make_and_run(op):
+        static.reset_default_programs()
+        static.global_scope().clear()
+        x = static.data("x", [3], "float32")
+        y = op(x)
+        out = exe.run(feed={"x": np.array([1.0, 2.0, 3.0], np.float32)},
+                      fetch_list=[y])[0]
+        prog = static.default_main_program()
+        return out, prog._identity_token
+
+    out1, tok1 = make_and_run(lambda x: ops.add(x, ops.full([3], 1.0)))
+    gc.collect()
+    out2, tok2 = make_and_run(lambda x: ops.multiply(x, ops.full([3], 10.0)))
+    assert tok1 != tok2
+    np.testing.assert_allclose(out1, [2.0, 3.0, 4.0])
+    np.testing.assert_allclose(out2, [10.0, 20.0, 30.0])
+
+
+def test_cache_eviction_bounded():
+    static.enable_static()
+    exe = static.Executor()
+    exe._cache_limit = 4
+    for i in range(8):
+        static.reset_default_programs()
+        x = static.data("x", [2], "float32")
+        y = ops.add(x, ops.full([2], float(i)))
+        exe.run(feed={"x": np.zeros(2, np.float32)}, fetch_list=[y])
+    assert len(exe._cache) <= 4
+
+
+# -- cond shape validation --------------------------------------------------
+
+
+def test_cond_shape_mismatch_build_error():
+    static.enable_static()
+    pred = static.data("p", [], "bool")
+
+    with pytest.raises(ValueError, match="shape mismatch"):
+        static.cond(
+            pred,
+            lambda: ops.full([2], 1.0),
+            lambda: ops.full([3], 2.0),
+        )
+
+
+# -- static RNN front end ---------------------------------------------------
+
+
+def _seq_data(B=4, T=6, D=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(B, T, D).astype("float32")
+
+
+@pytest.mark.parametrize("layer,n_states", [
+    ("simple_rnn", 1), ("lstm", 2), ("gru", 1),
+])
+def test_static_rnn_layers_shapes(layer, n_states):
+    static.enable_static()
+    H = 5
+    x = static.data("x", [4, 6, 8], "float32")
+    out, finals = getattr(static.nn, layer)(x, H)
+    assert list(out.shape) == [4, 6, H]
+    assert len(finals) == n_states
+    exe = static.Executor()
+    exe.run_startup()
+    o, h = exe.run(feed={"x": _seq_data()}, fetch_list=[out, finals[0]])
+    assert o.shape == (4, 6, H)
+    assert h.shape == (4, H)
+    # last output step equals the final hidden state
+    np.testing.assert_allclose(o[:, -1, :], h, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("layer", ["simple_rnn", "lstm", "gru"])
+def test_static_rnn_trains(layer):
+    """The scan-lowered RNNs are differentiable end to end (the weights
+    inside the scan body get gradients) and fit a toy target."""
+    static.enable_static()
+    H = 8
+    x = static.data("x", [4, 6, 8], "float32")
+    target = static.data("t", [4, 1], "float32")
+    out, finals = getattr(static.nn, layer)(x, H)
+    w_out = static.nn.create_parameter([H, 1], "float32")
+    pred = ops.matmul(finals[0], w_out)
+    loss = ops.mean(ops.square(ops.subtract(pred, target)))
+    opt = static.optimizer.Adam(learning_rate=0.02)
+    opt.minimize(loss)
+
+    exe = static.Executor()
+    exe.run_startup()
+    X = _seq_data()
+    T = np.random.RandomState(1).randn(4, 1).astype("float32")
+    losses = [
+        float(exe.run(feed={"x": X, "t": T}, fetch_list=[loss])[0])
+        for _ in range(40)
+    ]
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+
+
+def test_lstm_oracle():
+    """LSTM numerics vs a numpy oracle with the same weights."""
+    static.enable_static()
+    H, D, B, T = 3, 4, 2, 5
+    x = static.data("x", [B, T, D], "float32")
+    out, (h_f, c_f) = static.nn.lstm(x, H)
+    exe = static.Executor()
+    exe.run_startup()
+    X = _seq_data(B, T, D, seed=3)
+    o = exe.run(feed={"x": X}, fetch_list=[out])[0]
+
+    scope = static.global_scope()
+    params = sorted(
+        n for n in scope.var_names() if n.startswith("param")
+    )
+    w_ih, w_hh, b = (np.asarray(scope.get(n)) for n in params[:3])
+
+    def sigmoid(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((B, H), np.float32)
+    c = np.zeros((B, H), np.float32)
+    for t in range(T):
+        g = X[:, t] @ w_ih + h @ w_hh + b
+        i, f, gg, oo = np.split(g, 4, axis=-1)
+        c = sigmoid(f) * c + sigmoid(i) * np.tanh(gg)
+        h = sigmoid(oo) * np.tanh(c)
+        np.testing.assert_allclose(o[:, t], h, rtol=1e-4, atol=1e-5)
