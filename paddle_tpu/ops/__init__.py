@@ -24,6 +24,7 @@ from . import detection as _det  # noqa: F401
 from . import linalg_kernels as _la  # noqa: F401
 from . import math_extra as _mx  # noqa: F401
 from . import metrics_kernels as _mk  # noqa: F401
+from . import nn_extra as _nx  # noqa: F401
 from . import optimizer_kernels as _ok  # noqa: F401
 from . import sequence as _seq  # noqa: F401
 from .registry import all_ops, get_op, has_op, kernel  # noqa: F401
@@ -1057,6 +1058,209 @@ def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_threshold=0.3,
     return _run("multiclass_nms", _t(bboxes), _t(scores),
                 score_threshold=score_threshold, nms_threshold=nms_threshold,
                 keep_top_k=keep_top_k, background_label=background_label)
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25):
+    return _run("sigmoid_focal_loss", _t(x), _t(label), _t(fg_num),
+                gamma=gamma, alpha=alpha)
+
+
+def anchor_generator(x, anchor_sizes, aspect_ratios, stride,
+                     variances=(0.1, 0.1, 0.2, 0.2), offset=0.5):
+    return _run("anchor_generator", _t(x), anchor_sizes=tuple(anchor_sizes),
+                aspect_ratios=tuple(aspect_ratios), stride=tuple(stride),
+                variances=tuple(variances), offset=offset)
+
+
+def density_prior_box(x, image, densities, fixed_sizes, fixed_ratios,
+                      variances=(0.1, 0.1, 0.2, 0.2), step=(0.0, 0.0),
+                      offset=0.5, clip=False):
+    return _run("density_prior_box", _t(x), _t(image),
+                densities=tuple(densities), fixed_sizes=tuple(fixed_sizes),
+                fixed_ratios=tuple(fixed_ratios), variances=tuple(variances),
+                step=tuple(step), offset=offset, clip=clip)
+
+
+def polygon_box_transform(x):
+    return _run("polygon_box_transform", _t(x))
+
+
+def bipartite_match(dist, match_type="bipartite", dist_threshold=0.5):
+    return _run("bipartite_match", _t(dist), match_type=match_type,
+                dist_threshold=dist_threshold)
+
+
+def target_assign(x, match_indices, neg_value=0.0):
+    return _run("target_assign", _t(x), _t(match_indices),
+                neg_value=neg_value)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=4.135166556742356):
+    return _run("box_decoder_and_assign", _t(prior_box),
+                _t(prior_box_var) if prior_box_var is not None else None,
+                _t(target_box), _t(box_score), box_clip=box_clip)
+
+
+def matrix_nms(bboxes, scores, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0):
+    return _run("matrix_nms", _t(bboxes), _t(scores),
+                score_threshold=score_threshold,
+                post_threshold=post_threshold, nms_top_k=nms_top_k,
+                keep_top_k=keep_top_k, use_gaussian=use_gaussian,
+                gaussian_sigma=gaussian_sigma,
+                background_label=background_label)
+
+
+def locality_aware_nms(bboxes, scores, score_threshold=0.05,
+                       nms_threshold=0.3, keep_top_k=100):
+    return _run("locality_aware_nms", _t(bboxes), _t(scores),
+                score_threshold=score_threshold,
+                nms_threshold=nms_threshold, keep_top_k=keep_top_k)
+
+
+def mine_hard_examples(cls_loss, match_indices, neg_pos_ratio=3.0,
+                       mining_type="max_negative", sample_size=None):
+    return _run("mine_hard_examples", _t(cls_loss), _t(match_indices),
+                neg_pos_ratio=neg_pos_ratio, mining_type=mining_type,
+                sample_size=sample_size)
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0):
+    return _run("generate_proposals", _t(scores), _t(bbox_deltas),
+                _t(im_info), _t(anchors), _t(variances),
+                pre_nms_top_n=pre_nms_top_n, post_nms_top_n=post_nms_top_n,
+                nms_thresh=nms_thresh, min_size=min_size, eta=eta)
+
+
+def distribute_fpn_proposals(rois, min_level=2, max_level=5, refer_level=4,
+                             refer_scale=224):
+    return _run("distribute_fpn_proposals", _t(rois), min_level=min_level,
+                max_level=max_level, refer_level=refer_level,
+                refer_scale=refer_scale)
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, post_nms_top_n=1000):
+    return _run("collect_fpn_proposals", _t(multi_rois), _t(multi_scores),
+                post_nms_top_n=post_nms_top_n)
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3):
+    return _run("retinanet_detection_output", _t(bboxes), _t(scores),
+                _t(anchors), _t(im_info), score_threshold=score_threshold,
+                nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                nms_threshold=nms_threshold)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32,
+                use_label_smooth=False):
+    return _run("yolov3_loss", _t(x), _t(gt_box), _t(gt_label),
+                anchors=tuple(anchors), anchor_mask=tuple(anchor_mask),
+                class_num=class_num, ignore_thresh=ignore_thresh,
+                downsample_ratio=downsample_ratio,
+                use_label_smooth=use_label_smooth)
+
+
+def rpn_target_assign(anchors, gt_boxes, rpn_batch_size_per_im=256,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    return _run("rpn_target_assign", _t(anchors), _t(gt_boxes),
+                key=_random.split_key(),
+                rpn_batch_size_per_im=rpn_batch_size_per_im,
+                rpn_fg_fraction=rpn_fg_fraction,
+                rpn_positive_overlap=rpn_positive_overlap,
+                rpn_negative_overlap=rpn_negative_overlap,
+                use_random=use_random)
+
+
+# -- 3D conv/pool, deformable, data_norm, roi pools, shuffles ----------------
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW"):
+    out = _run("conv3d", _t(x), _t(weight), stride=stride, padding=padding,
+               dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        out = add(out, reshape(_t(bias), [1, -1, 1, 1, 1]))
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    out = _run("conv3d_transpose", _t(x), _t(weight), stride=stride,
+               padding=padding, output_padding=output_padding,
+               dilation=dilation, groups=groups, data_format=data_format)
+    if bias is not None:
+        out = add(out, reshape(_t(bias), [1, -1, 1, 1, 1]))
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NCDHW"):
+    return _run("pool3d", _t(x), kernel_size=kernel_size, stride=stride,
+                padding=padding, pooling_type="max", ceil_mode=ceil_mode,
+                data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW"):
+    return _run("pool3d", _t(x), kernel_size=kernel_size, stride=stride,
+                padding=padding, pooling_type="avg", ceil_mode=ceil_mode,
+                exclusive=exclusive, data_format=data_format)
+
+
+def deformable_conv(x, offset, mask, weight, bias=None, stride=1, padding=0,
+                    dilation=1, deformable_groups=1, groups=1,
+                    im2col_step=1):
+    out = _run("deformable_conv", _t(x), _t(offset),
+               _t(mask) if mask is not None else None, _t(weight),
+               stride=stride, padding=padding, dilation=dilation,
+               deformable_groups=deformable_groups, groups=groups,
+               im2col_step=im2col_step)
+    if bias is not None:
+        out = add(out, reshape(_t(bias), [1, -1, 1, 1]))
+    return out
+
+
+def data_norm(x, batch_size, batch_sum, batch_square_sum, epsilon=1e-4):
+    return _run("data_norm", _t(x), _t(batch_size), _t(batch_sum),
+                _t(batch_square_sum), epsilon=epsilon)
+
+
+def roi_pool(x, rois, batch_indices=None, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    return _run("roi_pool", _t(x), _t(rois),
+                batch_indices=None if batch_indices is None
+                else _t(batch_indices)._array,
+                pooled_height=pooled_height, pooled_width=pooled_width,
+                spatial_scale=spatial_scale)
+
+
+def psroi_pool(x, rois, output_channels, pooled_height, pooled_width,
+               spatial_scale=1.0, batch_indices=None):
+    return _run("psroi_pool", _t(x), _t(rois),
+                batch_indices=None if batch_indices is None
+                else _t(batch_indices)._array,
+                output_channels=output_channels,
+                pooled_height=pooled_height, pooled_width=pooled_width,
+                spatial_scale=spatial_scale)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    return _run("pixel_unshuffle", _t(x), downscale_factor=downscale_factor,
+                data_format=data_format)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    return _run("channel_shuffle", _t(x), groups=groups,
+                data_format=data_format)
 
 
 # -- linalg ------------------------------------------------------------------

@@ -342,3 +342,586 @@ def multiclass_nms(bboxes, scores, *, score_threshold=0.05, nms_threshold=0.3,
             [stacked, jnp.full((pad, 6), -1.0, stacked.dtype)], axis=0
         )
     return stacked, num
+
+
+# ---------------------------------------------------------------------------
+# round-3 tail: anchors, matching/assignment, NMS variants, FPN routing,
+# losses, proposal generation
+# ---------------------------------------------------------------------------
+
+
+@register_op("sigmoid_focal_loss")
+def sigmoid_focal_loss(x, label, fg_num, *, gamma=2.0, alpha=0.25):
+    """detection/sigmoid_focal_loss_op.cc: per-element focal loss over
+    [N, C] logits; label [N] in {0..C} with 0 = background (classes are
+    1-indexed as in the reference); normalized by fg_num."""
+    n, c = x.shape
+    fg = jnp.maximum(fg_num.astype(x.dtype).reshape(()), 1.0)
+    cls = jnp.arange(1, c + 1)[None, :]
+    t = (label.reshape(-1, 1) == cls).astype(x.dtype)  # one-hot, bg = zeros
+    p = jax.nn.sigmoid(x)
+    ce = -(t * jax.nn.log_sigmoid(x) + (1 - t) * jax.nn.log_sigmoid(-x))
+    p_t = t * p + (1 - t) * (1 - p)
+    a_t = t * alpha + (1 - t) * (1 - alpha)
+    return a_t * ((1 - p_t) ** gamma) * ce / fg
+
+
+@register_op("anchor_generator", num_outputs=2)
+def anchor_generator(x, *, anchor_sizes, aspect_ratios, stride,
+                     variances=(0.1, 0.1, 0.2, 0.2), offset=0.5):
+    """detection/anchor_generator_op.cc: per-location anchors for an
+    [N, C, H, W] feature map. Returns (anchors [H, W, A, 4],
+    variances [H, W, A, 4])."""
+    h, w = x.shape[2], x.shape[3]
+    sx, sy = float(stride[0]), float(stride[1])
+    cx = (jnp.arange(w) + offset) * sx
+    cy = (jnp.arange(h) + offset) * sy
+    ws, hs = [], []
+    for r in aspect_ratios:
+        for s in anchor_sizes:
+            ws.append(s * float(np.sqrt(1.0 / r)))
+            hs.append(s * float(np.sqrt(r)))
+    ws = jnp.asarray(ws, x.dtype)
+    hs = jnp.asarray(hs, x.dtype)
+    grid_x = cx[None, :, None]
+    grid_y = cy[:, None, None]
+    x1 = grid_x - 0.5 * ws[None, None, :]
+    y1 = grid_y - 0.5 * hs[None, None, :]
+    x2 = grid_x + 0.5 * ws[None, None, :]
+    y2 = grid_y + 0.5 * hs[None, None, :]
+    x1, y1, x2, y2 = (
+        jnp.broadcast_to(v, (h, w, ws.shape[0])) for v in (x1, y1, x2, y2)
+    )
+    anchors = jnp.stack([x1, y1, x2, y2], axis=-1)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, x.dtype), anchors.shape
+    )
+    return anchors, var
+
+
+@register_op("density_prior_box", num_outputs=2)
+def density_prior_box(x, image, *, densities, fixed_sizes, fixed_ratios,
+                      variances=(0.1, 0.1, 0.2, 0.2), step=(0.0, 0.0),
+                      offset=0.5, clip=False):
+    """detection/density_prior_box_op.cc: densified SSD priors — each
+    (density d, fixed size s) pair contributes d*d shifted boxes per
+    ratio. Returns (boxes [H, W, P, 4], variances [H, W, P, 4]),
+    normalized to [0, 1] image coords."""
+    fh, fw = x.shape[2], x.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = float(step[0]) or iw / fw
+    sh = float(step[1]) or ih / fh
+    boxes_per_loc = []
+    for d, s in zip(densities, fixed_sizes):
+        for r in fixed_ratios:
+            bw = s * float(np.sqrt(r))
+            bh = s / float(np.sqrt(r))
+            shift = s / d
+            for di in range(d):
+                for dj in range(d):
+                    ox = -s / 2.0 + shift / 2.0 + dj * shift
+                    oy = -s / 2.0 + shift / 2.0 + di * shift
+                    boxes_per_loc.append((ox, oy, bw, bh))
+    p = len(boxes_per_loc)
+    off = jnp.asarray(boxes_per_loc, x.dtype)  # [P, 4] (ox, oy, w, h)
+    cx = (jnp.arange(fw, dtype=x.dtype) + offset) * sw
+    cy = (jnp.arange(fh, dtype=x.dtype) + offset) * sh
+    ccx = jnp.broadcast_to(cx[None, :, None], (fh, fw, p)) + off[None, None, :, 0]
+    ccy = jnp.broadcast_to(cy[:, None, None], (fh, fw, p)) + off[None, None, :, 1]
+    bw = jnp.broadcast_to(off[None, None, :, 2], (fh, fw, p))
+    bh = jnp.broadcast_to(off[None, None, :, 3], (fh, fw, p))
+    out = jnp.stack(
+        [(ccx - bw / 2) / iw, (ccy - bh / 2) / ih,
+         (ccx + bw / 2) / iw, (ccy + bh / 2) / ih], axis=-1,
+    )
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, x.dtype), out.shape)
+    return out, var
+
+
+@register_op("polygon_box_transform")
+def polygon_box_transform(x):
+    """detection/polygon_box_transform_op.cc: EAST-style geometry map —
+    channel 2k is offset-from-x, 2k+1 offset-from-y; input [N, 8, H, W]
+    holds offsets, output holds absolute quad coords (x*4 - offset)."""
+    n, c, h, w = x.shape
+    xs = jnp.arange(w, dtype=x.dtype)[None, None, None, :] * 4.0
+    ys = jnp.arange(h, dtype=x.dtype)[None, None, :, None] * 4.0
+    is_x = (jnp.arange(c) % 2 == 0)[None, :, None, None]
+    grid = jnp.where(is_x, xs, ys)
+    return grid - x
+
+
+@register_op("bipartite_match", num_outputs=2)
+def bipartite_match(dist, *, match_type="bipartite", dist_threshold=0.5):
+    """detection/bipartite_match_op.cc: greedy bipartite matching on a
+    [N, M] similarity matrix — repeatedly take the globally largest
+    entry whose row and column are both unmatched. Returns
+    (match_indices [M] int32 with -1 = unmatched,
+     match_dist [M]). match_type="per_prediction" additionally matches
+    remaining columns to their best row when sim > dist_threshold."""
+    n, m = dist.shape
+    neg = jnp.asarray(-1.0, dist.dtype)
+
+    def body(_, carry):
+        col_match, col_dist, d = carry
+        flat = jnp.argmax(d)
+        i, j = flat // m, flat % m
+        ok = d[i, j] > 0
+        col_match = col_match.at[j].set(
+            jnp.where(ok, i.astype(jnp.int32), col_match[j])
+        )
+        col_dist = col_dist.at[j].set(jnp.where(ok, dist[i, j], col_dist[j]))
+        d = jnp.where(ok, d.at[i, :].set(neg).at[:, j].set(neg), d)
+        return col_match, col_dist, d
+
+    init = (jnp.full(m, -1, jnp.int32), jnp.zeros(m, dist.dtype), dist)
+    col_match, col_dist, _ = lax.fori_loop(0, min(n, m), body, init)
+    if match_type == "per_prediction":
+        best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+        best_val = jnp.max(dist, axis=0)
+        extra = (col_match < 0) & (best_val > dist_threshold)
+        col_match = jnp.where(extra, best_row, col_match)
+        col_dist = jnp.where(extra, best_val, col_dist)
+    return col_match, col_dist
+
+
+@register_op("target_assign", num_outputs=2)
+def target_assign(x, match_indices, *, neg_value=0.0):
+    """detection/target_assign_op.cc: gather per-column targets by match
+    index. x [N, K], match_indices [M] -> (out [M, K], weights [M])."""
+    mi = match_indices
+    gi = jnp.clip(mi, 0, x.shape[0] - 1)
+    out = x[gi]
+    w = (mi >= 0).astype(x.dtype)
+    out = jnp.where((mi >= 0)[:, None], out, neg_value)
+    return out, w
+
+
+@register_op("box_decoder_and_assign", num_outputs=2)
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           *, box_clip=4.135166556742356):
+    """detection/box_decoder_and_assign_op.cc: decode per-class deltas
+    then pick each box's best-scoring class decode.
+
+    prior_box [N,4]; target_box [N, C*4]; box_score [N, C].
+    Returns (decoded [N, C*4], assigned [N, 4])."""
+    n, c4 = target_box.shape
+    c = c4 // 4
+    pw = prior_box[:, 2] - prior_box[:, 0] + 1.0
+    ph = prior_box[:, 3] - prior_box[:, 1] + 1.0
+    pcx = prior_box[:, 0] + 0.5 * pw
+    pcy = prior_box[:, 1] + 0.5 * ph
+    t = target_box.reshape(n, c, 4)
+    var = (prior_box_var if prior_box_var is not None
+           else jnp.ones((n, 4), target_box.dtype))
+    dx = t[..., 0] * var[:, None, 0]
+    dy = t[..., 1] * var[:, None, 1]
+    dw = jnp.clip(t[..., 2] * var[:, None, 2], -box_clip, box_clip)
+    dh = jnp.clip(t[..., 3] * var[:, None, 3], -box_clip, box_clip)
+    cx = dx * pw[:, None] + pcx[:, None]
+    cy = dy * ph[:, None] + pcy[:, None]
+    w = jnp.exp(dw) * pw[:, None]
+    h = jnp.exp(dh) * ph[:, None]
+    dec = jnp.stack(
+        [cx - w / 2, cy - h / 2, cx + w / 2 - 1.0, cy + h / 2 - 1.0],
+        axis=-1,
+    )  # [N, C, 4]
+    best = jnp.argmax(box_score, axis=1)
+    assigned = jnp.take_along_axis(
+        dec, best[:, None, None].repeat(4, axis=2), axis=1
+    )[:, 0]
+    return dec.reshape(n, c4), assigned
+
+
+@register_op("matrix_nms", num_outputs=2)
+def matrix_nms(bboxes, scores, *, score_threshold=0.05, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=100, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0):
+    """detection/matrix_nms_op.cc: parallel soft-NMS — each box's score is
+    decayed by its worst overlap with any higher-scoring same-class box
+    (min over decay(iou_ij)/decay(max-overlap_j)). One matmul-shaped
+    pass, no sequential suppression: the TPU-native NMS.
+
+    bboxes [N,4]; scores [C,N]. Returns (out [keep_top_k, 6], num_kept).
+    """
+    c, n = scores.shape
+    k = int(keep_top_k)
+    rows, valid_all = [], []
+    for cls in range(c):
+        if cls == background_label:
+            continue
+        s = scores[cls]
+        passes = s >= score_threshold
+        order = jnp.argsort(-jnp.where(passes, s, -jnp.inf))
+        b_s = bboxes[order]
+        s_s = s[order]
+        p_s = passes[order]
+        iou = _pairwise_iou(b_s, b_s)
+        upper = jnp.tril(iou, k=-1).T  # upper[i, j] = iou(i, j) for i < j
+        # iou_max_i: suppressor i's own max overlap with ITS predecessors
+        # (matrix_nms_op.cc: decay_ij = decay(iou_ij) / decay(iou_max_i))
+        max_overlap = jnp.max(upper, axis=0)
+        if use_gaussian:
+            decay = jnp.exp(
+                (jnp.square(max_overlap)[:, None] - jnp.square(upper))
+                / gaussian_sigma
+            )
+        else:
+            decay = (1.0 - upper) / jnp.maximum(1.0 - max_overlap[:, None],
+                                                1e-10)
+        decay = jnp.min(jnp.where(upper > 0, decay, 1.0), axis=0)
+        new_s = s_s * decay
+        ok = p_s & (new_s >= post_threshold)
+        row = jnp.concatenate(
+            [jnp.full((n, 1), cls, bboxes.dtype), new_s[:, None], b_s],
+            axis=1,
+        )
+        rows.append(jnp.where(ok[:, None], row, -1.0))
+        valid_all.append(ok)
+    stacked = jnp.concatenate(rows, axis=0)
+    valid = jnp.concatenate(valid_all, axis=0)
+    order = jnp.argsort(-jnp.where(valid, stacked[:, 1], -jnp.inf))
+    stacked = stacked[order][:k]
+    valid = valid[order][:k]
+    pad = k - stacked.shape[0]
+    if pad > 0:
+        stacked = jnp.concatenate(
+            [stacked, jnp.full((pad, 6), -1.0, stacked.dtype)], axis=0
+        )
+    return stacked, jnp.sum(valid)
+
+
+@register_op("locality_aware_nms", num_outputs=2)
+def locality_aware_nms(bboxes, scores, *, score_threshold=0.05,
+                       nms_threshold=0.3, keep_top_k=100):
+    """detection/locality_aware_nms_op.cc (EAST): first weighted-merge
+    overlapping neighbors (score-weighted coordinate average), then
+    standard NMS. Single-class. Returns (out [keep_top_k, 6], num)."""
+    n = bboxes.shape[0]
+    s = scores.reshape(-1)
+    passes = s >= score_threshold
+    iou = _pairwise_iou(bboxes, bboxes)
+    near = (iou > nms_threshold) & passes[None, :] & passes[:, None]
+    wsum = jnp.sum(jnp.where(near, s[None, :], 0.0), axis=1)
+    merged = jnp.einsum(
+        "nm,md->nd", jnp.where(near, s[None, :], 0.0), bboxes
+    ) / jnp.maximum(wsum, 1e-10)[:, None]
+    merged = jnp.where(passes[:, None], merged, bboxes)
+    keep_idx, _ = nms(
+        merged, jnp.where(passes, s, -jnp.inf),
+        iou_threshold=nms_threshold, top_k=n,
+    )
+    gi = jnp.clip(keep_idx, 0, n - 1)
+    valid = (keep_idx >= 0) & passes[gi]
+    k = int(keep_top_k)
+    rows = jnp.concatenate(
+        [jnp.zeros((n, 1), bboxes.dtype), s[gi][:, None], merged[gi]],
+        axis=1,
+    )
+    rows = jnp.where(valid[:, None], rows, -1.0)[:k]
+    valid = valid[:k]
+    pad = k - rows.shape[0]
+    if pad > 0:
+        rows = jnp.concatenate(
+            [rows, jnp.full((pad, 6), -1.0, rows.dtype)], axis=0
+        )
+    return rows, jnp.sum(valid)
+
+
+@register_op("mine_hard_examples", num_outputs=2)
+def mine_hard_examples(cls_loss, match_indices, *, neg_pos_ratio=3.0,
+                       mining_type="max_negative", sample_size=None):
+    """detection/mine_hard_examples_op.cc: pick the hardest negatives
+    (highest loss among unmatched priors), capped at
+    neg_pos_ratio * num_positives (or sample_size). Fixed-size output:
+    returns (neg_mask [M] int32, num_neg) instead of a LoD index list."""
+    m = match_indices.shape[0]
+    is_pos = match_indices >= 0
+    n_pos = jnp.sum(is_pos)
+    cap = (jnp.asarray(int(sample_size), jnp.float32)
+           if sample_size is not None
+           else neg_pos_ratio * n_pos.astype(jnp.float32))
+    neg_loss = jnp.where(is_pos, -jnp.inf, cls_loss.reshape(-1))
+    order = jnp.argsort(-neg_loss)
+    rank = jnp.zeros(m, jnp.int32).at[order].set(jnp.arange(m, dtype=jnp.int32))
+    neg_mask = (~is_pos) & (rank.astype(jnp.float32) < cap) \
+        & jnp.isfinite(neg_loss)
+    return neg_mask.astype(jnp.int32), jnp.sum(neg_mask)
+
+
+@register_op("generate_proposals", num_outputs=3)
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances, *,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0):
+    """detection/generate_proposals_op.cc for one image: objectness top-k
+    → decode → clip to image → filter small → NMS. Fixed-size contract:
+    (rois [post_nms_top_n, 4], roi_scores [post_nms_top_n], num_valid).
+
+    scores [A] objectness; bbox_deltas [A, 4]; anchors/variances [A, 4];
+    im_info (h, w, scale).
+    """
+    a = scores.shape[0]
+    pre = min(int(pre_nms_top_n), a)
+    post = int(post_nms_top_n)
+    top_s, top_i = lax.top_k(scores, pre)
+    anc = anchors[top_i]
+    var = variances[top_i]
+    d = bbox_deltas[top_i]
+    # decode (box_coder decode_center_size with variances)
+    aw = anc[:, 2] - anc[:, 0] + 1.0
+    ah = anc[:, 3] - anc[:, 1] + 1.0
+    acx = anc[:, 0] + 0.5 * aw
+    acy = anc[:, 1] + 0.5 * ah
+    cx = var[:, 0] * d[:, 0] * aw + acx
+    cy = var[:, 1] * d[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(var[:, 2] * d[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(var[:, 3] * d[:, 3], 10.0)) * ah
+    boxes = jnp.stack(
+        [cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0],
+        axis=1,
+    )
+    ih, iw = im_info[0], im_info[1]
+    boxes = jnp.stack([
+        jnp.clip(boxes[:, 0], 0, iw - 1), jnp.clip(boxes[:, 1], 0, ih - 1),
+        jnp.clip(boxes[:, 2], 0, iw - 1), jnp.clip(boxes[:, 3], 0, ih - 1),
+    ], axis=1)
+    ms = min_size * im_info[2]
+    keep_size = ((boxes[:, 2] - boxes[:, 0] + 1.0) >= ms) \
+        & ((boxes[:, 3] - boxes[:, 1] + 1.0) >= ms)
+    s_masked = jnp.where(keep_size, top_s, -jnp.inf)
+    keep_idx, _ = nms(boxes, s_masked, iou_threshold=nms_thresh, top_k=post)
+    gi = jnp.clip(keep_idx, 0, pre - 1)
+    valid = (keep_idx >= 0) & keep_size[gi]
+    rois = jnp.where(valid[:, None], boxes[gi], 0.0)
+    rs = jnp.where(valid, top_s[gi], 0.0)
+    return rois, rs, jnp.sum(valid)
+
+
+@register_op("distribute_fpn_proposals", num_outputs=2)
+def distribute_fpn_proposals(rois, *, min_level=2, max_level=5,
+                             refer_level=4, refer_scale=224):
+    """detection/distribute_fpn_proposals_op.cc: route each RoI to an FPN
+    level by its scale. Fixed-size contract: returns
+    (level_idx [R] int32 absolute level, restore_rank [R] int32) — the
+    caller masks per level (instead of the reference's variable-size
+    per-level LoD outputs)."""
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = jnp.sqrt(jnp.maximum(w * h, 1e-10))
+    lvl = jnp.floor(
+        jnp.log2(scale / refer_scale + 1e-10)
+    ).astype(jnp.int32) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level)
+    order = jnp.argsort(lvl, stable=True)
+    restore = jnp.zeros_like(order).at[order].set(
+        jnp.arange(rois.shape[0], dtype=order.dtype)
+    )
+    return lvl, restore.astype(jnp.int32)
+
+
+@register_op("collect_fpn_proposals", num_outputs=2)
+def collect_fpn_proposals(multi_rois, multi_scores, *, post_nms_top_n=1000):
+    """detection/collect_fpn_proposals_op.cc: concat per-level proposals
+    and keep the global top-k by score. multi_rois [L, R, 4] stacked
+    (pad with zero-score rows); multi_scores [L, R].
+    Returns (rois [post_nms_top_n, 4], scores [post_nms_top_n])."""
+    rois = multi_rois.reshape(-1, 4)
+    scores = multi_scores.reshape(-1)
+    k = min(int(post_nms_top_n), scores.shape[0])
+    top_s, top_i = lax.top_k(scores, k)
+    return rois[top_i], top_s
+
+
+@register_op("retinanet_detection_output", num_outputs=2)
+def retinanet_detection_output(bboxes, scores, anchors, im_info, *,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3):
+    """detection/retinanet_detection_output_op.cc for one image: decode
+    per-anchor deltas, then multiclass NMS. bboxes [A, 4] deltas;
+    scores [A, C] sigmoid scores; anchors [A, 4]."""
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+    cx = bboxes[:, 0] * aw + acx
+    cy = bboxes[:, 1] * ah + acy
+    w = jnp.exp(jnp.minimum(bboxes[:, 2], 10.0)) * aw
+    h = jnp.exp(jnp.minimum(bboxes[:, 3], 10.0)) * ah
+    dec = jnp.stack(
+        [cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w - 1.0, cy + 0.5 * h - 1.0],
+        axis=1,
+    )
+    ih, iw = im_info[0], im_info[1]
+    dec = jnp.stack([
+        jnp.clip(dec[:, 0], 0, iw - 1), jnp.clip(dec[:, 1], 0, ih - 1),
+        jnp.clip(dec[:, 2], 0, iw - 1), jnp.clip(dec[:, 3], 0, ih - 1),
+    ], axis=1)
+    return multiclass_nms(
+        dec, scores.T, score_threshold=score_threshold,
+        nms_threshold=nms_threshold, keep_top_k=keep_top_k,
+        background_label=-1,
+    )
+
+
+@register_op("yolov3_loss")
+def yolov3_loss(x, gt_box, gt_label, *, anchors, anchor_mask, class_num,
+                ignore_thresh=0.7, downsample_ratio=32,
+                use_label_smooth=False):
+    """detection/yolov3_loss_op.cc: single-scale YOLOv3 training loss.
+
+    x [N, A*(5+C), H, W] raw head output; gt_box [N, B, 4] normalized
+    (cx, cy, w, h); gt_label [N, B] int (negative = padding slot).
+    Differentiable scalar loss (objectness ignore mask per
+    ignore_thresh, as the reference computes it).
+    """
+    n, _, h, w = x.shape
+    a = len(anchor_mask)
+    c = int(class_num)
+    an_all = jnp.asarray(anchors, x.dtype).reshape(-1, 2)  # [A_all, 2]
+    an = an_all[jnp.asarray(anchor_mask)]                  # [A, 2]
+    stride = float(downsample_ratio)
+    in_w, in_h = w * stride, h * stride
+
+    x = x.reshape(n, a, 5 + c, h, w)
+    tx, ty = x[:, :, 0], x[:, :, 1]
+    tw, th = x[:, :, 2], x[:, :, 3]
+    tobj = x[:, :, 4]
+    tcls = x[:, :, 5:]
+
+    gx = jnp.arange(w, dtype=x.dtype)[None, None, None, :]
+    gy = jnp.arange(h, dtype=x.dtype)[None, None, :, None]
+    px = (jax.nn.sigmoid(tx) + gx) / w
+    py = (jax.nn.sigmoid(ty) + gy) / h
+    pw = jnp.exp(jnp.clip(tw, -10, 10)) * an[None, :, 0, None, None] / in_w
+    ph = jnp.exp(jnp.clip(th, -10, 10)) * an[None, :, 1, None, None] / in_h
+    pred = jnp.stack(
+        [px - pw / 2, py - ph / 2, px + pw / 2, py + ph / 2], axis=-1
+    )  # [N, A, H, W, 4]
+
+    gt_valid = gt_label >= 0
+    gxyxy = jnp.stack(
+        [gt_box[..., 0] - gt_box[..., 2] / 2,
+         gt_box[..., 1] - gt_box[..., 3] / 2,
+         gt_box[..., 0] + gt_box[..., 2] / 2,
+         gt_box[..., 1] + gt_box[..., 3] / 2], axis=-1,
+    )  # [N, B, 4]
+
+    def per_image(pred_i, gt_i, gtv_i):
+        iou = _pairwise_iou(pred_i.reshape(-1, 4), gt_i)  # [AHW, B]
+        best = jnp.max(jnp.where(gtv_i[None, :], iou, 0.0), axis=1)
+        return best.reshape(a, h, w)
+
+    best_iou = jax.vmap(per_image)(pred, gxyxy, gt_valid)
+    ignore = best_iou > ignore_thresh
+
+    # responsibility: each gt is owned by the best-matching anchor shape
+    # at its center cell (shape-only IoU over ALL anchors, then mapped
+    # into this scale's mask)
+    gw = gt_box[..., 2] * in_w
+    gh = gt_box[..., 3] * in_h
+    inter = jnp.minimum(gw[..., None], an_all[None, None, :, 0]) * \
+        jnp.minimum(gh[..., None], an_all[None, None, :, 1])
+    union = gw[..., None] * gh[..., None] + \
+        an_all[None, None, :, 0] * an_all[None, None, :, 1] - inter
+    best_an = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [N,B]
+    mask_arr = jnp.asarray(anchor_mask)
+    local_a = jnp.argmax(best_an[..., None] == mask_arr[None, None, :],
+                         axis=-1)
+    owned = jnp.any(best_an[..., None] == mask_arr[None, None, :], axis=-1) \
+        & gt_valid
+
+    gi = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    b = gt_box.shape[1]
+    n_idx = jnp.repeat(jnp.arange(n)[:, None], b, axis=1)
+
+    def scatter(vals, default):
+        out = jnp.full((n, a, h, w), default, x.dtype)
+        return out.at[n_idx, local_a, gj, gi].set(
+            jnp.where(owned, vals, out[n_idx, local_a, gj, gi]),
+            mode="drop",
+        )
+
+    obj_t = scatter(jnp.ones_like(gw), 0.0)
+    scale_t = scatter(2.0 - gt_box[..., 2] * gt_box[..., 3], 0.0)
+    tx_t = scatter(gt_box[..., 0] * w - gi.astype(x.dtype), 0.0)
+    ty_t = scatter(gt_box[..., 1] * h - gj.astype(x.dtype), 0.0)
+    tw_t = scatter(
+        jnp.log(jnp.maximum(gw / an[local_a][..., 0], 1e-10)), 0.0
+    )
+    th_t = scatter(
+        jnp.log(jnp.maximum(gh / an[local_a][..., 1], 1e-10)), 0.0
+    )
+
+    def bce(logit, target):
+        return -(target * jax.nn.log_sigmoid(logit)
+                 + (1 - target) * jax.nn.log_sigmoid(-logit))
+
+    pos = obj_t
+    loss_xy = pos * scale_t * (bce(tx, tx_t) + bce(ty, ty_t))
+    loss_wh = pos * scale_t * 0.5 * (
+        jnp.square(tw - tw_t) + jnp.square(th - th_t)
+    )
+    noobj = (1.0 - pos) * (1.0 - ignore.astype(x.dtype))
+    loss_obj = pos * bce(tobj, jnp.ones_like(tobj)) \
+        + noobj * bce(tobj, jnp.zeros_like(tobj))
+    smooth = 1.0 / c if use_label_smooth else 0.0
+    cls_t = scatter(gt_label.astype(x.dtype), -1.0)
+    cls_onehot = jnp.clip(
+        (cls_t[:, :, None] == jnp.arange(c)[None, None, :, None, None])
+        .astype(x.dtype), smooth, 1.0 - smooth if use_label_smooth else 1.0,
+    )
+    loss_cls = pos[:, :, None] * bce(tcls, cls_onehot)
+    per_img = (loss_xy.sum(axis=(1, 2, 3)) + loss_wh.sum(axis=(1, 2, 3))
+               + loss_obj.sum(axis=(1, 2, 3))
+               + loss_cls.sum(axis=(1, 2, 3, 4)))
+    return per_img
+
+
+@register_op("rpn_target_assign", num_outputs=4)
+def rpn_target_assign(anchors, gt_boxes, *, key, is_crowd=None,
+                      rpn_batch_size_per_im=256, rpn_fg_fraction=0.5,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3,
+                      use_random=True):
+    """detection/rpn_target_assign_op.cc for one image. Fixed-size
+    contract: returns (labels [A] int32 in {-1 ignore, 0 neg, 1 pos},
+    matched_gt [A] int32, fg_num, bg_num) instead of LoD index lists.
+
+    Positives: best anchor per gt + anchors with IoU > positive_overlap;
+    negatives: IoU < negative_overlap; then subsampled to the reference's
+    batch-size/fg-fraction budget (random when use_random, else
+    top-ranked).
+    """
+    a = anchors.shape[0]
+    iou = _pairwise_iou(anchors, gt_boxes)  # [A, G]
+    best_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)
+    best_iou = jnp.max(iou, axis=1)
+    # anchors that are the argmax for some gt are positive regardless
+    best_per_gt = jnp.max(iou, axis=0)
+    is_best = jnp.any(
+        (iou >= best_per_gt[None, :] - 1e-7) & (best_per_gt[None, :] > 0),
+        axis=1,
+    )
+    pos = is_best | (best_iou >= rpn_positive_overlap)
+    neg = (~pos) & (best_iou < rpn_negative_overlap)
+
+    budget = int(rpn_batch_size_per_im)
+    fg_cap = int(budget * rpn_fg_fraction)
+    rk = jax.random.uniform(key, (a,)) if use_random else -best_iou
+
+    def subsample(mask, cap):
+        r = jnp.where(mask, rk, jnp.inf)
+        order = jnp.argsort(r)
+        rank = jnp.zeros(a, jnp.int32).at[order].set(
+            jnp.arange(a, dtype=jnp.int32)
+        )
+        return mask & (rank < cap)
+
+    pos_s = subsample(pos, fg_cap)
+    n_fg = jnp.sum(pos_s)
+    neg_s = subsample(neg, budget - n_fg)
+    labels = jnp.where(pos_s, 1, jnp.where(neg_s, 0, -1)).astype(jnp.int32)
+    return labels, best_gt, n_fg, jnp.sum(neg_s)

@@ -1,0 +1,464 @@
+"""Op-tail tests: 3D conv/pool, deformable conv, data_norm, roi pools,
+shuffles, and the round-3 detection family — numpy oracles + finite-diff
+gradient checks (OpTest pattern, tests/unittests/op_test.py:170).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import ops
+from paddle_tpu.ops.registry import kernel
+
+
+def _fd_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar f at x."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        hi = float(f(x))
+        flat[i] = old - eps
+        lo = float(f(x))
+        flat[i] = old
+        gf[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+# -- 3D conv / pool ---------------------------------------------------------
+
+
+def test_conv3d_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 5, 6, 7).astype(np.float64)
+    w = rng.randn(4, 3, 3, 3, 3).astype(np.float64)
+    out = np.asarray(kernel("conv3d")(jnp.asarray(x), jnp.asarray(w),
+                                      stride=1, padding=1))
+    assert out.shape == (2, 4, 5, 6, 7)
+    # oracle: one output element by direct correlation
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1), (1, 1)))
+    want = np.sum(xp[1, :, 2:5, 3:6, 4:7] * w[2])
+    np.testing.assert_allclose(out[1, 2, 2, 3, 4], want, rtol=1e-6)
+
+
+def test_conv3d_grad_finite_diff():
+    rng = np.random.RandomState(1)
+    x = rng.randn(1, 2, 4, 4, 4).astype(np.float64)
+    w = rng.randn(2, 2, 2, 2, 2).astype(np.float64)
+
+    def loss_w(wv):
+        return jnp.sum(
+            kernel("conv3d")(jnp.asarray(x), jnp.asarray(wv), stride=1,
+                             padding=0) ** 2
+        )
+
+    g = jax.grad(lambda wv: loss_w(wv))(jnp.asarray(w))
+    fd = _fd_grad(lambda wv: loss_w(jnp.asarray(wv)), w.copy(), eps=1e-4)
+    np.testing.assert_allclose(np.asarray(g), fd, rtol=2e-3, atol=1e-4)
+
+
+def test_conv3d_transpose_inverts_shape():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 4, 3, 3, 3).astype(np.float32)
+    w = rng.randn(4, 5, 2, 2, 2).astype(np.float32)  # IODHW
+    out = kernel("conv3d_transpose")(
+        jnp.asarray(x), jnp.asarray(w), stride=2, padding=0
+    )
+    assert out.shape == (1, 5, 6, 6, 6)
+
+
+def test_pool3d_max_avg():
+    x = np.arange(2 * 1 * 4 * 4 * 4, dtype=np.float32).reshape(2, 1, 4, 4, 4)
+    mx = np.asarray(kernel("pool3d")(jnp.asarray(x), kernel_size=2, stride=2,
+                                     pooling_type="max"))
+    av = np.asarray(kernel("pool3d")(jnp.asarray(x), kernel_size=2, stride=2,
+                                     pooling_type="avg"))
+    assert mx.shape == (2, 1, 2, 2, 2)
+    blk = x[0, 0, :2, :2, :2]
+    np.testing.assert_allclose(mx[0, 0, 0, 0, 0], blk.max())
+    np.testing.assert_allclose(av[0, 0, 0, 0, 0], blk.mean())
+
+
+# -- deformable conv --------------------------------------------------------
+
+
+def test_deformable_conv_zero_offset_equals_conv2d():
+    """With zero offsets and unit mask, deformable conv == plain conv."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 8, 8).astype(np.float64)
+    w = rng.randn(6, 4, 3, 3).astype(np.float64)
+    off = np.zeros((2, 2 * 9, 8, 8), np.float64)
+    msk = np.ones((2, 9, 8, 8), np.float64)
+    got = np.asarray(kernel("deformable_conv")(
+        jnp.asarray(x), jnp.asarray(off), jnp.asarray(msk), jnp.asarray(w),
+        stride=1, padding=1,
+    ))
+    want = np.asarray(kernel("conv2d")(
+        jnp.asarray(x), jnp.asarray(w), stride=1, padding=1
+    ))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-8)
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """An integer offset samples the shifted input exactly."""
+    x = np.zeros((1, 1, 6, 6), np.float64)
+    x[0, 0, 3, 4] = 1.0
+    w = np.ones((1, 1, 1, 1), np.float64)
+    off = np.zeros((1, 2, 6, 6), np.float64)
+    off[0, 0] = 1.0  # dy = 1
+    off[0, 1] = 2.0  # dx = 2
+    got = np.asarray(kernel("deformable_conv")(
+        jnp.asarray(x), jnp.asarray(off), None, jnp.asarray(w),
+        stride=1, padding=0,
+    ))
+    # output at (y, x) samples input at (y+1, x+2) → spike appears at (2,2)
+    assert got[0, 0, 2, 2] == 1.0
+    assert got.sum() == 1.0
+
+
+def test_deformable_conv_differentiable_wrt_offset():
+    rng = np.random.RandomState(4)
+    x = rng.randn(1, 2, 5, 5)
+    w = rng.randn(3, 2, 3, 3)
+    off = rng.randn(1, 18, 5, 5) * 0.3
+
+    def loss(o):
+        return jnp.sum(kernel("deformable_conv")(
+            jnp.asarray(x), o, None, jnp.asarray(w), stride=1, padding=1
+        ) ** 2)
+
+    g = jax.grad(loss)(jnp.asarray(off))
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+# -- data_norm --------------------------------------------------------------
+
+
+def test_data_norm_oracle():
+    rng = np.random.RandomState(5)
+    x = rng.randn(6, 3).astype(np.float64)
+    size = np.full(3, 10.0)
+    s = rng.randn(3) * 10
+    sq = np.abs(rng.randn(3)) * 100 + 50
+    y, means, scales = kernel("data_norm")(
+        jnp.asarray(x), jnp.asarray(size), jnp.asarray(s), jnp.asarray(sq)
+    )
+    np.testing.assert_allclose(np.asarray(means), s / size)
+    np.testing.assert_allclose(np.asarray(scales), np.sqrt(size / sq))
+    np.testing.assert_allclose(
+        np.asarray(y), (x - s / size) * np.sqrt(size / sq), rtol=1e-10
+    )
+
+
+def test_data_norm_update():
+    from paddle_tpu.ops.nn_extra import data_norm_update
+
+    x = np.ones((4, 2), np.float64) * 2
+    ns, nsum, nsq = data_norm_update(
+        jnp.asarray(x), jnp.full(2, 10.0), jnp.full(2, 5.0),
+        jnp.full(2, 8.0), summary_decay=0.5,
+    )
+    np.testing.assert_allclose(np.asarray(ns), 10 * 0.5 + 4)
+    np.testing.assert_allclose(np.asarray(nsum), 5 * 0.5 + 8)
+    np.testing.assert_allclose(np.asarray(nsq), 8 * 0.5 + 16)
+
+
+# -- roi pools --------------------------------------------------------------
+
+
+def test_roi_pool_oracle():
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = np.asarray(kernel("roi_pool")(
+        jnp.asarray(x), jnp.asarray(rois), pooled_height=2, pooled_width=2,
+        spatial_scale=1.0,
+    ))
+    assert out.shape == (1, 1, 2, 2)
+    np.testing.assert_allclose(out[0, 0], [[9, 11], [25, 27]])
+
+
+def test_psroi_pool_groups():
+    c, ph, pw = 2, 2, 2
+    x = np.zeros((1, c * ph * pw, 4, 4), np.float32)
+    for g in range(ph * pw):
+        x[0, g::ph * pw] = g + 1  # group g holds value g+1 everywhere
+    rois = np.array([[0.0, 0.0, 3.0, 3.0]], np.float32)
+    out = np.asarray(kernel("psroi_pool")(
+        jnp.asarray(x), jnp.asarray(rois), output_channels=c,
+        pooled_height=ph, pooled_width=pw, spatial_scale=1.0,
+    ))
+    assert out.shape == (1, c, ph, pw)
+    # bin (py, px) reads group py*pw+px → value py*pw+px+1
+    np.testing.assert_allclose(out[0, 0], [[1, 2], [3, 4]])
+
+
+# -- shuffles ---------------------------------------------------------------
+
+
+def test_pixel_unshuffle_inverts_shuffle():
+    rng = np.random.RandomState(6)
+    x = rng.randn(2, 8, 4, 4).astype(np.float32)
+    up = kernel("pixel_shuffle")(jnp.asarray(x), upscale_factor=2)
+    down = kernel("pixel_unshuffle")(up, downscale_factor=2)
+    np.testing.assert_allclose(np.asarray(down), x)
+
+
+def test_channel_shuffle_permutes():
+    x = np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1)
+    out = np.asarray(kernel("channel_shuffle")(jnp.asarray(x), groups=2))
+    np.testing.assert_allclose(out.reshape(-1), [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+# -- detection tail ---------------------------------------------------------
+
+
+def test_sigmoid_focal_loss_oracle():
+    rng = np.random.RandomState(7)
+    x = rng.randn(5, 3)
+    label = np.array([0, 1, 2, 3, 1])  # 0 = background
+    out = np.asarray(kernel("sigmoid_focal_loss")(
+        jnp.asarray(x), jnp.asarray(label), jnp.asarray(2.0),
+        gamma=2.0, alpha=0.25,
+    ))
+    p = 1 / (1 + np.exp(-x))
+    t = np.zeros((5, 3))
+    for i, l in enumerate(label):
+        if l > 0:
+            t[i, l - 1] = 1
+    ce = -(t * np.log(p) + (1 - t) * np.log(1 - p))
+    pt = t * p + (1 - t) * (1 - p)
+    at = t * 0.25 + (1 - t) * 0.75
+    want = at * (1 - pt) ** 2 * ce / 2.0
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-7)
+
+
+def test_anchor_generator():
+    x = jnp.zeros((1, 3, 2, 2))
+    anchors, var = kernel("anchor_generator")(
+        x, anchor_sizes=(64.0,), aspect_ratios=(1.0,), stride=(16.0, 16.0)
+    )
+    assert anchors.shape == (2, 2, 1, 4)
+    # first cell center at 8, 8 → box [-24, -24, 40, 40]
+    np.testing.assert_allclose(np.asarray(anchors[0, 0, 0]),
+                               [-24, -24, 40, 40])
+    np.testing.assert_allclose(np.asarray(var[0, 0, 0]),
+                               [0.1, 0.1, 0.2, 0.2])
+
+
+def test_density_prior_box_counts():
+    x = jnp.zeros((1, 3, 4, 4))
+    img = jnp.zeros((1, 3, 32, 32))
+    boxes, var = kernel("density_prior_box")(
+        x, img, densities=(2,), fixed_sizes=(8.0,), fixed_ratios=(1.0,),
+        clip=True,
+    )
+    assert boxes.shape == (4, 4, 4, 4)  # 2*2 densified boxes per loc
+    b = np.asarray(boxes)
+    assert (b >= 0).all() and (b <= 1).all()
+
+
+def test_bipartite_match_greedy():
+    dist = np.array([
+        [0.9, 0.1, 0.3],
+        [0.8, 0.7, 0.2],
+    ], np.float32)
+    mi, md = kernel("bipartite_match")(jnp.asarray(dist))
+    # greedy: (0,0)=0.9 first, then row 1's best free col = 1 (0.7)
+    np.testing.assert_array_equal(np.asarray(mi), [0, 1, -1])
+    np.testing.assert_allclose(np.asarray(md), [0.9, 0.7, 0.0])
+
+
+def test_bipartite_match_per_prediction():
+    dist = np.array([[0.9, 0.6, 0.3]], np.float32)
+    mi, md = kernel("bipartite_match")(
+        jnp.asarray(dist), match_type="per_prediction", dist_threshold=0.5
+    )
+    np.testing.assert_array_equal(np.asarray(mi), [0, 0, -1])
+
+
+def test_target_assign():
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    mi = np.array([1, -1, 0], np.int32)
+    out, w = kernel("target_assign")(jnp.asarray(x), jnp.asarray(mi))
+    np.testing.assert_allclose(np.asarray(out),
+                               [[3, 4], [0, 0], [1, 2]])
+    np.testing.assert_allclose(np.asarray(w), [1, 0, 1])
+
+
+def test_matrix_nms_suppresses_duplicates():
+    boxes = np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+    ], np.float32)
+    scores = np.array([[0.0, 0.0, 0.0],   # background row
+                       [0.9, 0.85, 0.8]], np.float32)
+    out, num = kernel("matrix_nms")(
+        jnp.asarray(boxes), jnp.asarray(scores), score_threshold=0.1,
+        post_threshold=0.4, keep_top_k=5, background_label=0,
+    )
+    out = np.asarray(out)
+    assert int(num) == 2  # overlapping second box decayed below 0.4
+    assert out[0, 1] == pytest.approx(0.9)
+    np.testing.assert_allclose(out[1, 2:], [50, 50, 60, 60])
+
+
+def test_locality_aware_nms_merges():
+    boxes = np.array([
+        [0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5], [40, 40, 50, 50],
+    ], np.float32)
+    scores = np.array([0.8, 0.8, 0.9], np.float32)
+    out, num = kernel("locality_aware_nms")(
+        jnp.asarray(boxes), jnp.asarray(scores), score_threshold=0.1,
+        nms_threshold=0.5, keep_top_k=4,
+    )
+    assert int(num) == 2
+    merged = np.asarray(out)[np.asarray(out)[:, 1] > 0]
+    # the overlapping pair merged to the score-weighted average
+    pair = merged[np.argmin(merged[:, 2])]
+    np.testing.assert_allclose(pair[2:], [0.25, 0.25, 10.25, 10.25],
+                               atol=1e-5)
+
+
+def test_mine_hard_examples():
+    loss = np.array([0.9, 0.1, 0.8, 0.2, 0.7], np.float32)
+    mi = np.array([0, -1, -1, -1, -1], np.int32)  # one positive
+    mask, n = kernel("mine_hard_examples")(
+        jnp.asarray(loss), jnp.asarray(mi), neg_pos_ratio=2.0
+    )
+    assert int(n) == 2
+    np.testing.assert_array_equal(np.asarray(mask), [0, 0, 1, 0, 1])
+
+
+def test_generate_proposals_shapes_and_validity():
+    rng = np.random.RandomState(8)
+    a = 50
+    anchors = np.abs(rng.rand(a, 2)) * 20
+    anchors = np.concatenate([anchors, anchors + 10 + rng.rand(a, 2) * 20],
+                             axis=1).astype(np.float32)
+    scores = rng.rand(a).astype(np.float32)
+    deltas = (rng.randn(a, 4) * 0.1).astype(np.float32)
+    var = np.ones((a, 4), np.float32)
+    im_info = np.array([60.0, 60.0, 1.0], np.float32)
+    rois, rs, num = kernel("generate_proposals")(
+        jnp.asarray(scores), jnp.asarray(deltas), jnp.asarray(im_info),
+        jnp.asarray(anchors), jnp.asarray(var),
+        pre_nms_top_n=30, post_nms_top_n=10, nms_thresh=0.7, min_size=2.0,
+    )
+    rois, rs = np.asarray(rois), np.asarray(rs)
+    assert rois.shape == (10, 4) and rs.shape == (10,)
+    n = int(num)
+    assert 0 < n <= 10
+    v = rois[:n]
+    assert (v[:, 0] >= 0).all() and (v[:, 2] <= 59).all()
+    assert (rs[:n] > 0).all()
+    # scores sorted descending among valid
+    assert (np.diff(rs[:n]) <= 1e-6).all()
+
+
+def test_distribute_and_collect_fpn():
+    rois = np.array([
+        [0, 0, 20, 20],      # small → low level
+        [0, 0, 220, 220],    # ~refer scale → level 4
+        [0, 0, 800, 800],    # big → high level
+    ], np.float32)
+    lvl, restore = kernel("distribute_fpn_proposals")(
+        jnp.asarray(rois), min_level=2, max_level=5,
+        refer_level=4, refer_scale=224,
+    )
+    lvl = np.asarray(lvl)
+    assert lvl[0] < lvl[1] <= lvl[2]
+    assert lvl.min() >= 2 and lvl.max() <= 5
+    # collect: global top-k by score
+    mr = np.stack([rois, rois + 1])
+    ms = np.array([[0.1, 0.9, 0.5], [0.2, 0.8, 0.3]], np.float32)
+    top_r, top_s = kernel("collect_fpn_proposals")(
+        jnp.asarray(mr), jnp.asarray(ms), post_nms_top_n=3
+    )
+    np.testing.assert_allclose(np.asarray(top_s), [0.9, 0.8, 0.5])
+
+
+def test_retinanet_detection_output():
+    anchors = np.array([[0, 0, 10, 10], [30, 30, 40, 40]], np.float32)
+    deltas = np.zeros((2, 4), np.float32)
+    scores = np.array([[0.9, 0.1], [0.1, 0.8]], np.float32)
+    im_info = np.array([100.0, 100.0, 1.0], np.float32)
+    out, num = kernel("retinanet_detection_output")(
+        jnp.asarray(deltas), jnp.asarray(scores), jnp.asarray(anchors),
+        jnp.asarray(im_info), score_threshold=0.3, keep_top_k=5,
+    )
+    assert int(num) == 2
+    out = np.asarray(out)
+    assert {int(out[0, 0]), int(out[1, 0])} == {0, 1}  # both classes kept
+
+
+def test_polygon_box_transform():
+    x = np.zeros((1, 8, 2, 2), np.float32)
+    out = np.asarray(kernel("polygon_box_transform")(jnp.asarray(x)))
+    # zero offsets → absolute 4*grid coords
+    np.testing.assert_allclose(out[0, 0], [[0, 4], [0, 4]])  # x-channel
+    np.testing.assert_allclose(out[0, 1], [[0, 0], [4, 4]])  # y-channel
+
+
+def test_yolov3_loss_finite_and_sensitive():
+    rng = np.random.RandomState(9)
+    n, a, c, h, w = 2, 3, 4, 4, 4
+    x = rng.randn(n, a * (5 + c), h, w).astype(np.float32) * 0.1
+    gt_box = np.array([
+        [[0.5, 0.5, 0.3, 0.4], [0.2, 0.2, 0.1, 0.1]],
+        [[0.7, 0.3, 0.2, 0.2], [0.0, 0.0, 0.0, 0.0]],
+    ], np.float32)
+    gt_label = np.array([[1, 2], [3, -1]], np.int64)
+    anchors = (10, 13, 16, 30, 33, 23)
+    mask = (0, 1, 2)
+
+    def loss(xv):
+        return jnp.sum(kernel("yolov3_loss")(
+            xv, jnp.asarray(gt_box), jnp.asarray(gt_label),
+            anchors=anchors, anchor_mask=mask, class_num=c,
+            downsample_ratio=32,
+        ))
+
+    l0 = float(loss(jnp.asarray(x)))
+    assert np.isfinite(l0) and l0 > 0
+    g = jax.grad(loss)(jnp.asarray(x))
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0
+
+
+def test_rpn_target_assign_budget():
+    rng = np.random.RandomState(10)
+    a = 100
+    xy = rng.rand(a, 2) * 80
+    anchors = np.concatenate([xy, xy + 10], axis=1).astype(np.float32)
+    gt = np.array([[5, 5, 18, 18], [50, 50, 62, 62]], np.float32)
+    labels, matched, fg, bg = kernel("rpn_target_assign")(
+        jnp.asarray(anchors), jnp.asarray(gt),
+        key=jax.random.PRNGKey(0), rpn_batch_size_per_im=32,
+        rpn_fg_fraction=0.5, use_random=True,
+    )
+    labels = np.asarray(labels)
+    n_fg, n_bg = int(fg), int(bg)
+    assert n_fg >= 1  # best anchor per gt is always positive
+    assert n_fg <= 16
+    assert n_fg + n_bg <= 32
+    assert (labels == 1).sum() == n_fg
+    assert (labels == 0).sum() == n_bg
+
+
+def test_eager_wrappers_exist():
+    for name in [
+        "sigmoid_focal_loss", "anchor_generator", "density_prior_box",
+        "bipartite_match", "target_assign", "matrix_nms",
+        "locality_aware_nms", "mine_hard_examples", "generate_proposals",
+        "distribute_fpn_proposals", "collect_fpn_proposals",
+        "retinanet_detection_output", "yolov3_loss", "rpn_target_assign",
+        "conv3d", "conv3d_transpose", "max_pool3d", "avg_pool3d",
+        "deformable_conv", "data_norm", "roi_pool", "psroi_pool",
+        "pixel_unshuffle", "channel_shuffle", "box_decoder_and_assign",
+        "polygon_box_transform",
+    ]:
+        assert hasattr(ops, name), name
