@@ -1,0 +1,204 @@
+"""Runtime-loadable custom op libraries.
+
+Reference parity: LoadOpLib (framework/load_op_lib.h:45 — dlopen a user
+.so and merge its OpInfoMap into the registry), the C plugin ABI
+(framework/c/c_api.h) and paddle.fluid.load_op_library
+(pybind/pybind.cc:1654); example+test
+python/paddle/fluid/tests/custom_op/relu_op.cc / test_custom_op.py.
+
+TPU-native split of responsibilities:
+- device kernels are written in Python (JAX/Pallas) and registered with
+  ops.registry.register_op — no ABI needed, they compile into the XLA
+  module like built-ins;
+- NATIVE (C++) custom kernels are host kernels, reached through
+  jax.pure_callback — exactly the reference's CPU-kernel role. The .so
+  implements the C ABI below; each op becomes a registered kernel usable
+  eagerly, under jit (as a host callback), and in static programs. A
+  library may export gradients (PD_OpRunGrad), wired via jax.custom_vjp
+  (the GradOpDescMaker analog, framework/c/c_api.h PD_GetGradOpDescStrs).
+
+C ABI (all symbols optional except the first four):
+
+    int         PD_NumOps(void);
+    const char* PD_OpName(int op);
+    int         PD_OpNumInputs(int op);
+    int         PD_OpNumOutputs(int op);
+    // shapes flattened with stride MAX_RANK (8)
+    int PD_OpInferShape(int op, int n_in, const int64_t* in_shapes,
+                        const int32_t* in_ndims, int64_t* out_shapes,
+                        int32_t* out_ndims);
+    int PD_OpRun(int op, int n_in, const float** in_bufs,
+                 const int64_t* in_shapes, const int32_t* in_ndims,
+                 float** out_bufs);
+    int PD_OpHasGrad(int op);
+    // grad: inputs ++ output cotangents -> input gradients
+    int PD_OpRunGrad(int op, int n_in, const float** in_bufs,
+                     const int64_t* in_shapes, const int32_t* in_ndims,
+                     float** grad_bufs);
+
+float32 buffers in v1 (the reference example ops are float too); rank is
+capped at MAX_RANK = 8.
+"""
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+MAX_RANK = 8
+
+__all__ = ["load_op_library"]
+
+_loaded: dict[str, list] = {}
+
+
+def _shapes_buf(arrays):
+    n = len(arrays)
+    shapes = (ctypes.c_int64 * (n * MAX_RANK))()
+    ndims = (ctypes.c_int32 * n)()
+    for i, a in enumerate(arrays):
+        ndims[i] = a.ndim
+        for d, s in enumerate(a.shape):
+            shapes[i * MAX_RANK + d] = s
+    return shapes, ndims
+
+
+def _infer(lib, op_idx, in_specs, n_out):
+    shapes = (ctypes.c_int64 * (len(in_specs) * MAX_RANK))()
+    ndims = (ctypes.c_int32 * len(in_specs))()
+    for i, shp in enumerate(in_specs):
+        ndims[i] = len(shp)
+        for d, s in enumerate(shp):
+            shapes[i * MAX_RANK + d] = s
+    out_shapes = (ctypes.c_int64 * (n_out * MAX_RANK))()
+    out_ndims = (ctypes.c_int32 * n_out)()
+    rc = lib.PD_OpInferShape(op_idx, len(in_specs), shapes, ndims,
+                             out_shapes, out_ndims)
+    if rc != 0:
+        raise RuntimeError(f"custom op infer_shape failed (rc={rc})")
+    return [
+        tuple(out_shapes[i * MAX_RANK + d] for d in range(out_ndims[i]))
+        for i in range(n_out)
+    ]
+
+
+def _run_c(lib, fn, op_idx, arrays, out_shapes):
+    arrays = [np.ascontiguousarray(a, dtype=np.float32) for a in arrays]
+    shapes, ndims = _shapes_buf(arrays)
+    in_ptrs = (ctypes.POINTER(ctypes.c_float) * len(arrays))(
+        *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for a in arrays]
+    )
+    outs = [np.empty(s, np.float32) for s in out_shapes]
+    out_ptrs = (ctypes.POINTER(ctypes.c_float) * len(outs))(
+        *[o.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for o in outs]
+    )
+    rc = fn(op_idx, len(arrays), in_ptrs, shapes, ndims, out_ptrs)
+    if rc != 0:
+        raise RuntimeError(f"custom op run failed (rc={rc})")
+    return outs
+
+
+def _make_kernel(lib, op_idx, name, n_in, n_out, has_grad):
+    def infer_out_shapes(args):
+        return _infer(lib, op_idx, [tuple(a.shape) for a in args], n_out)
+
+    def host_run(*args):
+        outs = _run_c(lib, lib.PD_OpRun, op_idx, args,
+                      infer_out_shapes(args))
+        return tuple(outs) if n_out > 1 else outs[0]
+
+    def callback(*args):
+        out_shapes = infer_out_shapes(args)
+        result_spec = [
+            jax.ShapeDtypeStruct(s, jnp.float32) for s in out_shapes
+        ]
+        if n_out == 1:
+            result_spec = result_spec[0]
+        return jax.pure_callback(host_run, result_spec, *args,
+                                 vmap_method="sequential")
+
+    if not has_grad:
+        def fn(*args, **kw):
+            args = [jnp.asarray(a, jnp.float32) for a in args]
+            return callback(*args)
+        fn.__name__ = name
+        return fn
+
+    if n_out != 1:
+        raise NotImplementedError(
+            f"custom op {name!r}: gradients are supported for "
+            "single-output ops in v1"
+        )
+
+    @jax.custom_vjp
+    def fn(*args):
+        return callback(*args)
+
+    def fwd(*args):
+        return fn(*args), args
+
+    def bwd(res, gy):
+        args = list(res) + [gy]
+
+        def host_grad(*all_args):
+            grads = _run_c(
+                lib, lib.PD_OpRunGrad, op_idx, all_args,
+                [tuple(a.shape) for a in all_args[:n_in]],
+            )
+            return tuple(grads)
+
+        spec = tuple(
+            jax.ShapeDtypeStruct(a.shape, jnp.float32) for a in res
+        )
+        return jax.pure_callback(host_grad, spec, *args,
+                                 vmap_method="sequential")
+
+    fn.defvjp(fwd, bwd)
+
+    def wrapper(*args, **kw):
+        args = [jnp.asarray(a, jnp.float32) for a in args]
+        return fn(*args)
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+def load_op_library(so_path: str):
+    """dlopen a custom-op library and register its ops (LoadOpLib,
+    framework/load_op_lib.h:45). Returns the list of op names added.
+
+    Each op becomes callable as ``ops.registry.kernel(name)`` / through
+    the mode-aware dispatch, like any built-in kernel.
+    """
+    from ..ops.registry import register_op
+
+    if so_path in _loaded:
+        return list(_loaded[so_path])
+    lib = ctypes.CDLL(so_path)
+    lib.PD_NumOps.restype = ctypes.c_int
+    lib.PD_OpName.restype = ctypes.c_char_p
+    lib.PD_OpName.argtypes = [ctypes.c_int]
+    for sym in ("PD_OpNumInputs", "PD_OpNumOutputs"):
+        getattr(lib, sym).restype = ctypes.c_int
+        getattr(lib, sym).argtypes = [ctypes.c_int]
+    lib.PD_OpInferShape.restype = ctypes.c_int
+    lib.PD_OpRun.restype = ctypes.c_int
+    has_grad_fn = getattr(lib, "PD_OpHasGrad", None)
+    if has_grad_fn is not None:
+        has_grad_fn.restype = ctypes.c_int
+        has_grad_fn.argtypes = [ctypes.c_int]
+
+    names = []
+    for i in range(lib.PD_NumOps()):
+        name = lib.PD_OpName(i).decode()
+        n_in = lib.PD_OpNumInputs(i)
+        n_out = lib.PD_OpNumOutputs(i)
+        has_grad = bool(has_grad_fn(i)) if has_grad_fn is not None else False
+        k = _make_kernel(lib, i, name, n_in, n_out, has_grad)
+        register_op(name, num_outputs=n_out)(k)
+        names.append(name)
+    _loaded[so_path] = names
+    return names

@@ -1,0 +1,133 @@
+"""Custom-op plugin ABI test.
+
+Reference parity: python/paddle/fluid/tests/custom_op/relu_op.cc +
+test_custom_op.py — a user compiles a C++ op library, loads it at
+runtime (load_op_lib.h:45), and uses the ops like built-ins, including
+gradients.
+"""
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.framework.op_library import load_op_library
+from paddle_tpu.ops.registry import has_op, kernel
+
+
+USER_OP_SRC = r"""
+// user custom-op library implementing the paddle_tpu plugin C ABI:
+// my_relu6 (with gradient) and my_double (no gradient).
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+constexpr int kMaxRank = 8;
+
+int64_t numel(const int64_t* shape, int32_t ndim) {
+  int64_t n = 1;
+  for (int d = 0; d < ndim; ++d) n *= shape[d];
+  return n;
+}
+}  // namespace
+
+extern "C" {
+
+int PD_NumOps() { return 2; }
+
+const char* PD_OpName(int op) {
+  return op == 0 ? "my_relu6" : "my_double";
+}
+
+int PD_OpNumInputs(int op) { return 1; }
+int PD_OpNumOutputs(int op) { return 1; }
+
+int PD_OpInferShape(int op, int n_in, const int64_t* in_shapes,
+                    const int32_t* in_ndims, int64_t* out_shapes,
+                    int32_t* out_ndims) {
+  out_ndims[0] = in_ndims[0];
+  std::memcpy(out_shapes, in_shapes, sizeof(int64_t) * kMaxRank);
+  return 0;
+}
+
+int PD_OpRun(int op, int n_in, const float** in, const int64_t* shapes,
+             const int32_t* ndims, float** out) {
+  int64_t n = numel(shapes, ndims[0]);
+  for (int64_t i = 0; i < n; ++i) {
+    out[0][i] = op == 0 ? std::min(std::max(in[0][i], 0.0f), 6.0f)
+                        : in[0][i] * 2.0f;
+  }
+  return 0;
+}
+
+int PD_OpHasGrad(int op) { return op == 0 ? 1 : 0; }
+
+// inputs ++ cotangent -> input grads
+int PD_OpRunGrad(int op, int n_in, const float** in, const int64_t* shapes,
+                 const int32_t* ndims, float** grads) {
+  if (op != 0) return -1;
+  int64_t n = numel(shapes, ndims[0]);
+  const float* x = in[0];
+  const float* gy = in[1];
+  for (int64_t i = 0; i < n; ++i) {
+    grads[0][i] = (x[i] > 0.0f && x[i] < 6.0f) ? gy[i] : 0.0f;
+  }
+  return 0;
+}
+
+}  // extern "C"
+"""
+
+
+@pytest.fixture(scope="module")
+def user_lib(tmp_path_factory):
+    d = tmp_path_factory.mktemp("custom_op")
+    src = d / "user_ops.cpp"
+    src.write_text(USER_OP_SRC)
+    so = str(d / "libuser_ops.so")
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", str(src),
+         "-o", so],
+        check=True, capture_output=True,
+    )
+    return so
+
+
+def test_load_and_run_eager(user_lib):
+    names = load_op_library(user_lib)
+    assert names == ["my_relu6", "my_double"]
+    assert has_op("my_relu6") and has_op("my_double")
+    x = np.array([-1.0, 2.0, 7.5], np.float32)
+    out = np.asarray(kernel("my_relu6")(jnp.asarray(x)))
+    np.testing.assert_allclose(out, [0.0, 2.0, 6.0])
+    out2 = np.asarray(kernel("my_double")(jnp.asarray(x)))
+    np.testing.assert_allclose(out2, [-2.0, 4.0, 15.0])
+
+
+def test_custom_op_under_jit(user_lib):
+    load_op_library(user_lib)
+
+    @jax.jit
+    def f(x):
+        return kernel("my_relu6")(x) + 1.0
+
+    out = np.asarray(f(jnp.asarray([-3.0, 3.0, 9.0], jnp.float32)))
+    np.testing.assert_allclose(out, [1.0, 4.0, 7.0])
+
+
+def test_custom_op_gradient(user_lib):
+    load_op_library(user_lib)
+    x = jnp.asarray([-1.0, 2.0, 7.0], jnp.float32)
+    g = jax.grad(lambda v: jnp.sum(kernel("my_relu6")(v) ** 2))(x)
+    # d/dx relu6(x)^2 = 2*relu6(x) inside (0, 6), else 0
+    np.testing.assert_allclose(np.asarray(g), [0.0, 4.0, 0.0])
+
+
+def test_custom_op_reload_idempotent(user_lib):
+    first = load_op_library(user_lib)
+    second = load_op_library(user_lib)
+    assert first == second
