@@ -204,5 +204,6 @@ from . import profiler  # noqa: E402
 from . import distribution  # noqa: E402
 from . import errors  # noqa: E402  (platform/enforce.h error taxonomy)
 from . import incubate  # noqa: E402  (auto-checkpoint)
+from . import slim  # noqa: E402  (quantization: QAT + PTQ)
 from . import flags as _flags_mod  # noqa: E402
 from .flags import get_flags, set_flags  # noqa: E402  (core.globals() API)

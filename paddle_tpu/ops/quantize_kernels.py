@@ -1,0 +1,186 @@
+"""Fake-quantization op family (INT8 simulation).
+
+Reference parity: paddle/fluid/operators/fake_quantize_op.cc
+(fake_quantize_abs_max :608, fake_quantize_dequantize_abs_max :616,
+fake_quantize_range_abs_max :624, fake_quantize_moving_average_abs_max
+:632, fake_channel_wise_quantize_abs_max :650,
+moving_average_abs_max_scale :658) and fake_dequantize_op.cc.
+
+TPU-native: quantization on TPU is *simulated* (quant-dequant in the
+compiled graph — the MXU computes in bf16/f32 either way); the value is
+(a) QAT: training that bakes in int8 rounding so exported models run on
+int8 inference hardware, and (b) scale calibration for deployment. The
+quantize→round→dequantize chain gets a straight-through estimator
+gradient (custom_vjp), matching FakeQuantDequantGrad's identity pass.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = []
+
+
+def _qdq(x, scale, bit_length):
+    """Quantize to [-bnt, bnt] then dequantize (the simulation core)."""
+    bnt = float((1 << (bit_length - 1)) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s * bnt, -bnt, bnt))
+    return q * s / bnt
+
+
+@register_op("fake_quantize_abs_max", num_outputs=2)
+def fake_quantize_abs_max(x, *, bit_length=8):
+    """Returns (quantized_int_values, scale). Out holds the rounded
+    integer grid values (as float, like the reference's Out tensor)."""
+    bnt = float((1 << (bit_length - 1)) - 1)
+    scale = jnp.max(jnp.abs(x))
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s * bnt, -bnt, bnt))
+    return q, scale
+
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _qdq_ste(x, scale, bit_length):
+    return _qdq(x, scale, bit_length)
+
+
+def _qdq_fwd(x, scale, bit_length):
+    return _qdq(x, scale, bit_length), scale
+
+
+def _qdq_bwd(bit_length, scale, gy):
+    # FakeQuantDequantGrad: straight-through — dL/dx = dL/dout; the
+    # scale is an observed statistic, not a trained parameter
+    return gy, jnp.zeros_like(scale)
+
+
+_qdq_ste.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+@register_op("fake_quantize_dequantize_abs_max", num_outputs=2)
+def fake_quantize_dequantize_abs_max(x, *, bit_length=8):
+    """Quant-dequant with dynamic abs-max scale + STE gradient."""
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    return _qdq_ste(x, scale, bit_length), scale
+
+
+@register_op("fake_quantize_range_abs_max", num_outputs=2)
+def fake_quantize_range_abs_max(x, in_scale, *, bit_length=8,
+                                window_size=10000, is_test=False):
+    """Scale from the running window max (training keeps the max of the
+    current and stored scale — the reference's window behavior folded to
+    its steady state). Returns (out_int_values, out_scale)."""
+    cur = jnp.max(jnp.abs(x))
+    scale = jnp.where(
+        jnp.asarray(is_test), in_scale.reshape(()),
+        jnp.maximum(cur, in_scale.reshape(())),
+    )
+    bnt = float((1 << (bit_length - 1)) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s * bnt, -bnt, bnt))
+    return q, scale
+
+
+@register_op("fake_quantize_moving_average_abs_max", num_outputs=4)
+def fake_quantize_moving_average_abs_max(x, in_scale, in_state, in_accum, *,
+                                         bit_length=8, moving_rate=0.9,
+                                         is_test=False):
+    """EMA abs-max scale (the QAT activation quantizer). Returns
+    (out_int_values, out_scale, out_state, out_accum)."""
+    cur = jnp.max(jnp.abs(x))
+    state = jnp.where(jnp.asarray(is_test), in_state,
+                      in_state * moving_rate + 1.0)
+    accum = jnp.where(jnp.asarray(is_test), in_accum,
+                      in_accum * moving_rate + cur)
+    scale = jnp.where(jnp.asarray(is_test), in_scale.reshape(()),
+                      accum / jnp.maximum(state, 1e-8)).reshape(())
+    bnt = float((1 << (bit_length - 1)) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s * bnt, -bnt, bnt))
+    return q, scale, state, accum
+
+
+@register_op("fake_quantize_dequantize_moving_average_abs_max",
+             num_outputs=4)
+def fake_quantize_dequantize_moving_average_abs_max(
+        x, in_scale, in_state, in_accum, *, bit_length=8, moving_rate=0.9,
+        is_test=False):
+    """QAT activation quant-dequant: EMA scale + STE gradient.
+    Returns (out, out_scale, out_state, out_accum)."""
+    cur = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    state = jnp.where(jnp.asarray(is_test), in_state,
+                      in_state * moving_rate + 1.0)
+    accum = jnp.where(jnp.asarray(is_test), in_accum,
+                      in_accum * moving_rate + cur)
+    scale = jnp.where(jnp.asarray(is_test), in_scale.reshape(()),
+                      accum / jnp.maximum(state, 1e-8)).reshape(())
+    return _qdq_ste(x, scale, bit_length), scale, state, accum
+
+
+@register_op("fake_channel_wise_quantize_abs_max", num_outputs=2)
+def fake_channel_wise_quantize_abs_max(x, *, bit_length=8, quant_axis=0):
+    """Per-output-channel abs-max weight quantization. Returns
+    (out_int_values, scales [C])."""
+    axes = tuple(d for d in range(x.ndim) if d != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes)
+    bnt = float((1 << (bit_length - 1)) - 1)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    s = jnp.maximum(scale, 1e-8).reshape(shape)
+    q = jnp.round(jnp.clip(x / s * bnt, -bnt, bnt))
+    return q, scale
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             num_outputs=2)
+def fake_channel_wise_quantize_dequantize_abs_max(x, *, bit_length=8,
+                                                  quant_axis=0):
+    """Per-channel quant-dequant with STE (the QAT weight quantizer)."""
+    axes = tuple(d for d in range(x.ndim) if d != quant_axis)
+    scale = jax.lax.stop_gradient(jnp.max(jnp.abs(x), axis=axes))
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return _qdq_ste(x, scale.reshape(shape), bit_length), scale
+
+
+@register_op("moving_average_abs_max_scale", num_outputs=4)
+def moving_average_abs_max_scale(x, in_scale, in_state, in_accum, *,
+                                 moving_rate=0.9, is_test=False):
+    """Scale observer only (no quantization): out == x.
+    Returns (out, out_scale, out_state, out_accum)."""
+    cur = jnp.max(jnp.abs(x))
+    state = jnp.where(jnp.asarray(is_test), in_state,
+                      in_state * moving_rate + 1.0)
+    accum = jnp.where(jnp.asarray(is_test), in_accum,
+                      in_accum * moving_rate + cur)
+    scale = jnp.where(jnp.asarray(is_test), in_scale.reshape(()),
+                      accum / jnp.maximum(state, 1e-8)).reshape(())
+    return x, scale, state, accum
+
+
+@register_op("fake_dequantize_max_abs")
+def fake_dequantize_max_abs(x, scale, *, max_range):
+    """fake_dequantize_op.cc: x * scale / max_range."""
+    return x * scale.reshape(()) / float(max_range)
+
+
+@register_op("fake_channel_wise_dequantize_max_abs")
+def fake_channel_wise_dequantize_max_abs(x, scale, *, quant_bits=(8,),
+                                         quant_axis=0):
+    bnt = float((1 << (int(quant_bits[0]) - 1)) - 1)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return x * scale.reshape(shape) / bnt
+
+
+@register_op("quant_dequant_static")
+def quant_dequant_static(x, *, scale, bit_length=8):
+    """PTQ simulation op with a calibrated constant scale
+    (quantization_pass.py's inserted quant/dequant pair)."""
+    return _qdq(x, jnp.asarray(scale, x.dtype), bit_length)

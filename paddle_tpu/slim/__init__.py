@@ -1,0 +1,10 @@
+"""Model-slimming: quantization (QAT + PTQ).
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/ —
+imperative/qat.py (ImperativeQuantAware), imperative/quant_nn.py
+(QuantizedLinear/QuantizedConv2D), post_training_quantization.py, and
+quantization_pass.py (static program rewrite).
+"""
+from .quant_nn import QuantizedConv2D, QuantizedLinear  # noqa: F401
+from .qat import ImperativeQuantAware  # noqa: F401
+from .ptq import PostTrainingQuantization, quantize_static_program  # noqa: F401

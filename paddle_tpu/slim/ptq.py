@@ -1,0 +1,137 @@
+"""Post-training quantization for static programs.
+
+Reference parity: fluid/contrib/slim/quantization/
+post_training_quantization.py (calibrate activation scales by feeding
+sample data, compute weight scales, then rewrite the program) and
+quantization_pass.py (QuantizationTransformPass — insert quant/dequant
+around every quantizable op's inputs).
+
+TPU-native: the rewrite inserts ``fake_quantize_dequantize_abs_max``-
+style simulation ops with *calibrated constant scales* in front of each
+matmul/mul/conv2d input; XLA folds the scale math into the surrounding
+fusion. The quantized program is a drop-in for the Executor/Predictor.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+_QUANTIZABLE = ("mul", "matmul", "conv2d")
+
+
+def _collect_var_abs_max(program, scope, exe, feed_batches, var_names):
+    """Run calibration batches; record abs-max per listed var."""
+    maxes = {n: 0.0 for n in var_names}
+    for feed in feed_batches:
+        outs = exe.run(program, feed=feed, fetch_list=list(var_names))
+        for n, v in zip(var_names, outs):
+            maxes[n] = max(maxes[n], float(np.max(np.abs(np.asarray(v)))))
+    return maxes
+
+
+def quantize_static_program(program, scope, exe, feed_batches, *,
+                            weight_bits=8, activation_bits=8):
+    """QuantizationTransformPass + calibration in one step.
+
+    Mutates ``program``: every quantizable op's activation input gets a
+    quant-dequant op with its calibrated scale; weight inputs (persistable
+    vars) are quant-dequantized in the scope directly (per-tensor abs
+    max). Returns {var_name: scale} for deployment metadata.
+    """
+    block = program.global_block()
+    # find activation inputs of quantizable ops (non-persistable vars)
+    act_inputs = []
+    weight_inputs = set()
+    for op in block.ops:
+        if op.type not in _QUANTIZABLE:
+            continue
+        for n in op.inputs.get("X", []):
+            if block.has_var(n) and block.var(n).persistable:
+                weight_inputs.add(n)
+            elif scope.has(n):
+                weight_inputs.add(n)
+            else:
+                act_inputs.append(n)
+    act_inputs = sorted(set(act_inputs))
+
+    scales = _collect_var_abs_max(program, scope, exe, feed_batches,
+                                  act_inputs)
+
+    # weights: quant-dequant in place (per-tensor abs-max, like the
+    # reference's weight_quantize_type="abs_max" path)
+    bnt_w = float((1 << (weight_bits - 1)) - 1)
+    for n in sorted(weight_inputs):
+        w = np.asarray(scope.get(n))
+        s = max(float(np.max(np.abs(w))), 1e-8)
+        q = np.round(np.clip(w / s * bnt_w, -bnt_w, bnt_w))
+        scope.set(n, jnp.asarray((q * s / bnt_w).astype(w.dtype)))
+        scales[n] = s
+
+    # activations: insert scale-clamped quant-dequant ops before use
+    from ..static.program import OpDesc
+
+    bnt = float((1 << (activation_bits - 1)) - 1)
+    new_ops = []
+    renamed = {}
+    for op in block.ops:
+        if op.type in _QUANTIZABLE:
+            new_inputs = {}
+            for slot, names in op.inputs.items():
+                out_names = []
+                for n in names:
+                    if n in scales and n not in weight_inputs:
+                        if n not in renamed:
+                            qn = program._unique_name(f"{n}.quantized")
+                            src = block.var(n)
+                            block.create_var(
+                                name=qn, shape=src.shape,
+                                dtype=str(src.dtype),
+                            )
+                            new_ops.append(OpDesc(
+                                "quant_dequant_static",
+                                {"X": [n]}, {"Out": [qn]},
+                                {"scale": float(scales[n]),
+                                 "bit_length": activation_bits},
+                            ))
+                            renamed[n] = qn
+                        out_names.append(renamed[n])
+                    else:
+                        out_names.append(n)
+                new_inputs[slot] = out_names
+            op.inputs = new_inputs
+        new_ops.append(op)
+    block.ops[:] = new_ops
+    program._version = getattr(program, "_version", 0) + 1
+    return scales
+
+
+class PostTrainingQuantization:
+    """post_training_quantization.py facade over the pass above."""
+
+    def __init__(self, executor, program, feed_batches, scope=None,
+                 weight_bits=8, activation_bits=8):
+        from ..static.executor import global_scope
+
+        self._exe = executor
+        self._program = program
+        self._batches = list(feed_batches)
+        self._scope = scope or global_scope()
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self.scales = None
+
+    def quantize(self):
+        self.scales = quantize_static_program(
+            self._program, self._scope, self._exe, self._batches,
+            weight_bits=self._wbits, activation_bits=self._abits,
+        )
+        return self._program
+
+    def save_quantized_model(self, dirname, feed_names, fetch_vars):
+        from ..static import io as static_io
+
+        return static_io.save_inference_model(
+            dirname, feed_names, fetch_vars, self._exe,
+            main_program=self._program,
+        )

@@ -1,0 +1,53 @@
+"""Imperative quantization-aware training.
+
+Reference parity: fluid/contrib/slim/quantization/imperative/qat.py —
+ImperativeQuantAware.quantize(model) swaps every Linear/Conv2D for its
+quantized wrapper in place (training then runs with fake quant), and
+save_quantized_model exports the inference program.
+"""
+from __future__ import annotations
+
+from ..nn.layer_base import Layer
+from ..nn.layers import Conv2D, Linear
+from .quant_nn import QuantizedConv2D, QuantizedLinear
+
+_DEFAULT_TYPES = (Linear, Conv2D)
+
+
+class ImperativeQuantAware:
+    """imperative/qat.py:ImperativeQuantAware."""
+
+    def __init__(self, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 quantizable_layer_type=("Linear", "Conv2D")):
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self._types = tuple(
+            t for t in _DEFAULT_TYPES
+            if t.__name__ in set(quantizable_layer_type)
+        )
+
+    def _wrap(self, layer):
+        if isinstance(layer, Linear):
+            return QuantizedLinear(layer, self._wbits, self._abits,
+                                   self._rate)
+        return QuantizedConv2D(layer, self._wbits, self._abits, self._rate)
+
+    def quantize(self, model: Layer):
+        """Swap quantizable sublayers in place; returns the model."""
+        for parent in [model] + [l for l in model.sublayers(True)]:
+            subs = getattr(parent, "_sub_layers", None)
+            if not subs:
+                continue
+            for name, child in list(subs.items()):
+                if isinstance(child, self._types) and not isinstance(
+                    child, (QuantizedLinear, QuantizedConv2D)
+                ):
+                    subs[name] = self._wrap(child)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None):
+        """Export with the quant-dequant ops baked in (jit trace path)."""
+        from .. import jit_api
+
+        return jit_api.save(model, path, input_spec=input_spec)

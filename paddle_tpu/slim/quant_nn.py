@@ -1,0 +1,105 @@
+"""Quantization-aware layers.
+
+Reference parity: fluid/contrib/slim/quantization/imperative/quant_nn.py
+— QuantizedLinear/QuantizedConv2D wrap the fp layer, fake-quantizing the
+weight (per-channel abs-max) and the input activation (EMA abs-max with
+persisted scale/state/accum), so training sees int8 rounding while the
+MXU still computes in bf16/f32 (QAT on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..ops.registry import kernel
+
+
+class _ActQuant:
+    """EMA activation quant-dequant over layer buffers."""
+
+    def __init__(self, layer: Layer, prefix: str, moving_rate=0.9,
+                 bit_length=8):
+        self._layer = layer
+        self._prefix = prefix
+        self._rate = moving_rate
+        self._bits = bit_length
+        z = lambda v: Tensor(np.asarray(v, np.float32))
+        layer.register_buffer(f"{prefix}_scale", z(0.0))
+        layer.register_buffer(f"{prefix}_state", z(0.0))
+        layer.register_buffer(f"{prefix}_accum", z(0.0))
+
+    def __call__(self, x: Tensor) -> Tensor:
+        lyr, p = self._layer, self._prefix
+        scale = getattr(lyr, f"{p}_scale")
+        state = getattr(lyr, f"{p}_state")
+        accum = getattr(lyr, f"{p}_accum")
+        out, s, st, ac = kernel(
+            "fake_quantize_dequantize_moving_average_abs_max"
+        )(
+            x._array, scale._array, state._array, accum._array,
+            bit_length=self._bits, moving_rate=self._rate,
+            is_test=not lyr.training,
+        )
+        scale._array = s
+        state._array = st
+        accum._array = ac
+        return Tensor._from_array(out, stop_gradient=x.stop_gradient)
+
+
+def _quant_weight(w: Tensor, quant_axis: int, bits: int) -> Tensor:
+    out, _ = kernel("fake_channel_wise_quantize_dequantize_abs_max")(
+        w._array, bit_length=bits, quant_axis=quant_axis
+    )
+    return Tensor._from_array(out, stop_gradient=w.stop_gradient)
+
+
+class QuantizedLinear(Layer):
+    """quant_nn.py QuantizedLinear: shares the wrapped layer's parameters
+    (training updates the original fp weights)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self._wbits = weight_bits
+        self._act = _ActQuant(self, "in", moving_rate, activation_bits)
+
+    def forward(self, x):
+        x = self._act(x)
+        w = _quant_weight(self._inner.weight, 1, self._wbits)
+        return F.linear(x, w, self._inner.bias)
+
+    def weight_scales(self):
+        _, s = kernel("fake_channel_wise_quantize_abs_max")(
+            self._inner.weight._array, bit_length=self._wbits, quant_axis=1
+        )
+        return np.asarray(s)
+
+
+class QuantizedConv2D(Layer):
+    """quant_nn.py QuantizedConv2D (per-output-channel weight scales)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self._inner = layer
+        self._wbits = weight_bits
+        self._act = _ActQuant(self, "in", moving_rate, activation_bits)
+
+    def forward(self, x):
+        x = self._act(x)
+        w = _quant_weight(self._inner.weight, 0, self._wbits)
+        return F.conv2d(
+            x, w, self._inner.bias, data_format=self._inner.data_format,
+            **self._inner._attrs,
+        )
+
+    def weight_scales(self):
+        _, s = kernel("fake_channel_wise_quantize_abs_max")(
+            self._inner.weight._array, bit_length=self._wbits, quant_axis=0
+        )
+        return np.asarray(s)

@@ -1,0 +1,205 @@
+"""Quantization (slim) tests: fake-quant ops, QAT, PTQ.
+
+Reference parity: fluid/contrib/slim/quantization/ (imperative/qat.py,
+quant_nn.py, post_training_quantization.py, quantization_pass.py) and
+operators/fake_quantize_op.cc — op oracles + end-to-end QAT training +
+PTQ calibrate/rewrite/accuracy.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.static as static
+from paddle_tpu import ops, slim
+from paddle_tpu.framework import jit as fjit
+from paddle_tpu.ops.registry import kernel
+
+
+# -- op oracles -------------------------------------------------------------
+
+
+def test_fake_quantize_abs_max_oracle():
+    x = np.array([-2.0, 0.5, 1.0, 4.0], np.float32)
+    q, s = kernel("fake_quantize_abs_max")(jnp.asarray(x), bit_length=8)
+    assert float(s) == 4.0
+    np.testing.assert_allclose(
+        np.asarray(q), np.round(x / 4.0 * 127.0)
+    )
+
+
+def test_fake_quantize_dequantize_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    x = rng.randn(64).astype(np.float32)
+    out, s = kernel("fake_quantize_dequantize_abs_max")(
+        jnp.asarray(x), bit_length=8
+    )
+    # max quantization error is scale/127/2 per element
+    err = np.abs(np.asarray(out) - x).max()
+    assert err <= float(s) / 127.0 / 2 + 1e-6
+
+
+def test_channel_wise_scales():
+    x = np.zeros((3, 4), np.float32)
+    x[0] = 1.0
+    x[1] = 2.0
+    x[2] = 8.0
+    q, s = kernel("fake_channel_wise_quantize_abs_max")(
+        jnp.asarray(x), bit_length=8, quant_axis=0
+    )
+    np.testing.assert_allclose(np.asarray(s), [1.0, 2.0, 8.0])
+    np.testing.assert_allclose(np.asarray(q), np.full((3, 4), 127.0))
+
+
+def test_moving_average_scale_ema():
+    x = jnp.asarray(np.full(4, 3.0, np.float32))
+    out, s, st, ac = kernel("fake_quantize_moving_average_abs_max")(
+        x, jnp.asarray(0.0), jnp.asarray(0.0), jnp.asarray(0.0),
+        moving_rate=0.9, is_test=False,
+    )
+    # state=1, accum=3 → scale=3
+    assert float(s) == pytest.approx(3.0)
+    out2, s2, st2, ac2 = kernel("fake_quantize_moving_average_abs_max")(
+        jnp.asarray(np.full(4, 1.0, np.float32)), s, st, ac,
+        moving_rate=0.9, is_test=False,
+    )
+    # state=1.9, accum=3*0.9+1=3.7 → scale≈1.947
+    assert float(s2) == pytest.approx(3.7 / 1.9, rel=1e-5)
+    # is_test keeps the stored scale
+    _, s3, st3, _ = kernel("fake_quantize_moving_average_abs_max")(
+        jnp.asarray(np.full(4, 99.0, np.float32)), s2, st2, ac2,
+        moving_rate=0.9, is_test=True,
+    )
+    assert float(s3) == pytest.approx(float(s2))
+
+
+def test_ste_gradient_is_identity():
+    x = jnp.asarray(np.linspace(-1, 1, 8).astype(np.float32))
+
+    def loss(v):
+        out, _ = kernel("fake_quantize_dequantize_abs_max")(v, bit_length=8)
+        return jnp.sum(out * out)
+
+    g = jax.grad(loss)(x)
+    # STE: grad flows as if quant-dequant were identity → 2*qdq(x)
+    out, _ = kernel("fake_quantize_dequantize_abs_max")(x, bit_length=8)
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(out),
+                               rtol=1e-6)
+
+
+def test_dequantize_ops():
+    x = np.array([127.0, -64.0], np.float32)
+    out = kernel("fake_dequantize_max_abs")(
+        jnp.asarray(x), jnp.asarray(2.0), max_range=127.0
+    )
+    np.testing.assert_allclose(np.asarray(out), [2.0, -64 * 2 / 127])
+
+
+# -- QAT --------------------------------------------------------------------
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_qat_swaps_layers_and_keeps_params():
+    paddle.seed(0)
+    m = SmallNet()
+    w_before = np.asarray(m.fc1.weight._array).copy()
+    slim.ImperativeQuantAware().quantize(m)
+    assert isinstance(m.fc1, slim.QuantizedLinear)
+    assert isinstance(m.fc2, slim.QuantizedLinear)
+    # parameters are shared, not copied
+    np.testing.assert_array_equal(
+        np.asarray(m.fc1._inner.weight._array), w_before
+    )
+
+
+def test_qat_trains_and_tracks_scales():
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 8).astype("float32")
+    Y = rng.randint(0, 4, (64,)).astype("int64")
+    paddle.seed(1)
+    m = SmallNet()
+    slim.ImperativeQuantAware().quantize(m)
+    o = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+    step = fjit.train_step(
+        m, o, lambda mm, x, y: F.cross_entropy(mm(x), y).mean()
+    )
+    losses = [float(np.asarray(step(X, Y)["loss"])) for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.8
+    step.sync()
+    # activation scale observer advanced
+    assert float(np.asarray(m.fc1.in_scale._array)) > 0
+    assert m.fc1.weight_scales().shape == (16,)
+
+
+def test_qat_quantized_forward_close_to_fp():
+    rng = np.random.RandomState(2)
+    X = rng.randn(16, 8).astype("float32")
+    paddle.seed(3)
+    m = SmallNet()
+    ref = np.asarray(m(paddle.to_tensor(X)).numpy())
+    slim.ImperativeQuantAware().quantize(m)
+    m.train()
+    got = np.asarray(m(paddle.to_tensor(X)).numpy())
+    # int8 simulation stays close to fp32
+    assert np.abs(got - ref).max() < 0.15 * np.abs(ref).max()
+
+
+# -- PTQ --------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _fresh_static():
+    static.reset_default_programs()
+    static.global_scope().clear()
+    yield
+    static.disable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+
+
+def test_ptq_static_program(tmp_path):
+    rng = np.random.RandomState(4)
+    static.enable_static()
+    x = static.data("x", [None, 8], "float32")
+    h = static.nn.fc(x, 16, activation="relu", name="f1")
+    y = static.nn.fc(h, 4, name="f2")
+    exe = static.Executor()
+    exe.run_startup()
+    prog = static.default_main_program()
+
+    calib = [{"x": rng.randn(16, 8).astype("float32")} for _ in range(4)]
+    Xtest = rng.randn(8, 8).astype("float32")
+    ref = exe.run(feed={"x": Xtest}, fetch_list=[y])[0]
+
+    ptq = slim.PostTrainingQuantization(exe, prog, calib)
+    ptq.quantize()
+    assert ptq.scales, "no scales calibrated"
+    types = [op.type for op in prog.global_block().ops]
+    assert "quant_dequant_static" in types
+
+    got = exe.run(prog, feed={"x": Xtest}, fetch_list=[y])[0]
+    # int8 simulation error bounded relative to activations magnitude
+    assert np.abs(got - ref).max() < 0.1 * np.abs(ref).max() + 0.1
+
+    # quantized model round-trips through save/load_inference_model
+    path = str(tmp_path / "qmodel")
+    ptq.save_quantized_model(path, ["x"], [y])
+    static.reset_default_programs()
+    static.global_scope().clear()
+    prog2, feeds, fetches = static.load_inference_model(path, exe)
+    got2 = exe.run(prog2, feed={"x": Xtest}, fetch_list=fetches)[0]
+    np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
