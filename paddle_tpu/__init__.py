@@ -205,5 +205,26 @@ from . import distribution  # noqa: E402
 from . import errors  # noqa: E402  (platform/enforce.h error taxonomy)
 from . import incubate  # noqa: E402  (auto-checkpoint)
 from . import slim  # noqa: E402  (quantization: QAT + PTQ)
+from . import tensor  # noqa: E402  (2.0 tensor-API namespace split)
+from . import linalg  # noqa: E402  (2.0 linalg namespace)
+from .ops import (  # noqa: E402,F401  (2.0 tail additions, flat aliases)
+    clone,
+    diagflat,
+    dist,
+    empty,
+    empty_like,
+    increment,
+    inner,
+    is_complex,
+    is_integer,
+    multiplex,
+    mv,
+    outer,
+    poisson,
+    put_along_axis,
+    rank,
+    standard_normal,
+    stanh,
+)
 from . import flags as _flags_mod  # noqa: E402
 from .flags import get_flags, set_flags  # noqa: E402  (core.globals() API)

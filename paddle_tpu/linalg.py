@@ -1,0 +1,31 @@
+"""paddle_tpu.linalg — the 2.0 linear-algebra namespace.
+
+Reference parity: the paddle.linalg namespace emerging in the 2.0 API
+rework (python/paddle/tensor/linalg.py backs it in the snapshot).
+"""
+from .tensor.linalg import (  # noqa: F401
+    bmm,
+    cholesky,
+    cross,
+    det,
+    dist,
+    dot,
+    eig,
+    eigh,
+    histogram,
+    inverse,
+    lstsq,
+    matmul,
+    matrix_power,
+    matrix_rank,
+    mv,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    t,
+    transpose,
+    triangular_solve,
+)

@@ -1264,6 +1264,135 @@ def channel_shuffle(x, groups, data_format="NCHW"):
                 data_format=data_format)
 
 
+# -- 2.0 tensor-API tail (python/paddle/tensor/ coverage) --------------------
+
+floor_mod = mod
+
+
+def increment(x, value=1.0):
+    """fluid increment op: x + value (in the 2.0 API, returns new)."""
+    return add(_t(x), to_tensor(value))
+
+
+def multiplex(inputs, index):
+    """operators/multiplex_op.cc: out[i] = inputs[index[i]][i]."""
+    stacked = stack([_t(t) for t in inputs], axis=0)
+    idx = reshape(_t(index), [-1])
+    arr = stacked._array[
+        idx._array.astype("int32"), jnp.arange(idx._array.shape[0])
+    ]
+    return to_tensor(arr)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    """operators/activation_op.cc stanh: b * tanh(a * x)."""
+    return scale(tanh(scale(_t(x), scale_a)), scale_b)
+
+
+def inner(x, y):
+    a, b = _t(x), _t(y)
+    return to_tensor(jnp.inner(a._array, b._array))
+
+
+def outer(x, y):
+    a, b = _t(x), _t(y)
+    return to_tensor(jnp.outer(a._array, b._array))
+
+
+def rank(x):
+    """paddle.rank: the number of dimensions (attribute.py)."""
+    return to_tensor(np.asarray(len(_t(x).shape), np.int32))
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._array.dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_t(x)._array.dtype, jnp.integer)
+
+
+def is_empty(x):
+    return to_tensor(np.asarray(_t(x)._array.size == 0))
+
+
+def empty(shape, dtype=None):
+    """paddle.empty — uninitialized memory doesn't exist under XLA's
+    value semantics; zeros have identical cost post-fusion."""
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(_t(x), dtype)
+
+
+def diagflat(x, offset=0):
+    return to_tensor(jnp.diagflat(_t(x)._array, k=offset))
+
+
+def clone(x):
+    t = _t(x)
+    return to_tensor(jnp.copy(t._array))
+
+
+def dist(x, y, p=2):
+    """paddle.dist: p-norm of (x - y)."""
+    d = subtract(_t(x), _t(y))
+    arr = d._array.reshape(-1)
+    if p == float("inf"):
+        return to_tensor(jnp.max(jnp.abs(arr)))
+    if p == 0:
+        return to_tensor(jnp.sum(arr != 0).astype(arr.dtype))
+    return to_tensor(jnp.sum(jnp.abs(arr) ** p) ** (1.0 / p))
+
+
+def mv(x, vec):
+    return matmul(_t(x), _t(vec))
+
+
+def poisson(x):
+    return to_tensor(
+        jax.random.poisson(_random.split_key(), _t(x)._array)
+        .astype(_t(x)._array.dtype)
+    )
+
+
+def standard_normal(shape, dtype=None):
+    return randn(shape, dtype)
+
+
+def reverse(x, axis):
+    return flip(_t(x), axis)
+
+
+def scatter_nd(index, updates, shape):
+    z = zeros(list(shape), str(_t(updates)._array.dtype))
+    return scatter_nd_add(z, _t(index), _t(updates))
+
+
+def put_along_axis(x, indices, values, axis, reduce="assign"):
+    t, idx = _t(x), _t(indices)
+    v = _t(values) if not isinstance(values, (int, float)) else None
+    varr = (v._array if v is not None
+            else jnp.full(idx._array.shape, values, t._array.dtype))
+    varr = jnp.broadcast_to(varr, idx._array.shape).astype(t._array.dtype)
+    if reduce == "assign":
+        out = jnp.put_along_axis(
+            t._array, idx._array, varr, axis=axis, inplace=False
+        )
+    elif reduce == "add":
+        out = t._array
+        dims = list(range(out.ndim))
+        idxs = jnp.meshgrid(
+            *[jnp.arange(s) for s in idx._array.shape], indexing="ij"
+        )
+        idxs[axis] = idx._array
+        out = out.at[tuple(idxs)].add(varr)
+    else:
+        raise ValueError(f"unsupported reduce mode {reduce!r}")
+    return to_tensor(out)
+
+
 # -- linalg ------------------------------------------------------------------
 
 

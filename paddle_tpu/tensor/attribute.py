@@ -1,0 +1,13 @@
+"""paddle_tpu.tensor.attribute — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/attribute.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import shape  # noqa: F401
+from ..ops import real  # noqa: F401
+from ..ops import imag  # noqa: F401
+from ..ops import rank  # noqa: F401
+from ..ops import is_complex  # noqa: F401
+from ..ops import is_integer  # noqa: F401

@@ -1,0 +1,26 @@
+"""paddle_tpu.tensor.creation — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/creation.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import to_tensor  # noqa: F401
+from ..ops import zeros  # noqa: F401
+from ..ops import ones  # noqa: F401
+from ..ops import full  # noqa: F401
+from ..ops import zeros_like  # noqa: F401
+from ..ops import ones_like  # noqa: F401
+from ..ops import full_like  # noqa: F401
+from ..ops import arange  # noqa: F401
+from ..ops import linspace  # noqa: F401
+from ..ops import eye  # noqa: F401
+from ..ops import diag  # noqa: F401
+from ..ops import tril  # noqa: F401
+from ..ops import triu  # noqa: F401
+from ..ops import meshgrid  # noqa: F401
+from ..ops import assign  # noqa: F401
+from ..ops import empty  # noqa: F401
+from ..ops import empty_like  # noqa: F401
+from ..ops import diagflat  # noqa: F401
+from ..ops import clone  # noqa: F401

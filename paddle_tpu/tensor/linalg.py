@@ -1,0 +1,31 @@
+"""paddle_tpu.tensor.linalg — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/linalg.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import matmul  # noqa: F401
+from ..ops import dot  # noqa: F401
+from ..ops import norm  # noqa: F401
+from ..ops import transpose  # noqa: F401
+from ..ops import t  # noqa: F401
+from ..ops import cross  # noqa: F401
+from ..ops import cholesky  # noqa: F401
+from ..ops import bmm  # noqa: F401
+from ..ops import histogram  # noqa: F401
+from ..ops import det  # noqa: F401
+from ..ops import slogdet  # noqa: F401
+from ..ops import matrix_power  # noqa: F401
+from ..ops import qr  # noqa: F401
+from ..ops import svd  # noqa: F401
+from ..ops import pinv  # noqa: F401
+from ..ops import solve  # noqa: F401
+from ..ops import lstsq  # noqa: F401
+from ..ops import matrix_rank  # noqa: F401
+from ..ops import eig  # noqa: F401
+from ..ops import eigh  # noqa: F401
+from ..ops import inverse  # noqa: F401
+from ..ops import triangular_solve  # noqa: F401
+from ..ops import dist  # noqa: F401
+from ..ops import mv  # noqa: F401

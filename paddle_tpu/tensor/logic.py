@@ -1,0 +1,24 @@
+"""paddle_tpu.tensor.logic — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/logic.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import equal  # noqa: F401
+from ..ops import greater_equal  # noqa: F401
+from ..ops import greater_than  # noqa: F401
+from ..ops import less_equal  # noqa: F401
+from ..ops import less_than  # noqa: F401
+from ..ops import logical_and  # noqa: F401
+from ..ops import logical_not  # noqa: F401
+from ..ops import logical_or  # noqa: F401
+from ..ops import logical_xor  # noqa: F401
+from ..ops import not_equal  # noqa: F401
+from ..ops import allclose  # noqa: F401
+from ..ops import equal_all  # noqa: F401
+from ..ops import isclose  # noqa: F401
+from ..ops import isnan  # noqa: F401
+from ..ops import isinf  # noqa: F401
+from ..ops import isfinite  # noqa: F401
+from ..ops import is_empty  # noqa: F401

@@ -1,0 +1,45 @@
+"""paddle_tpu.tensor.manipulation — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/manipulation.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import cast  # noqa: F401
+from ..ops import concat  # noqa: F401
+from ..ops import expand  # noqa: F401
+from ..ops import broadcast_to  # noqa: F401
+from ..ops import expand_as  # noqa: F401
+from ..ops import flatten  # noqa: F401
+from ..ops import gather  # noqa: F401
+from ..ops import gather_nd  # noqa: F401
+from ..ops import reshape  # noqa: F401
+from ..ops import flip  # noqa: F401
+from ..ops import roll  # noqa: F401
+from ..ops import scatter  # noqa: F401
+from ..ops import scatter_nd_add  # noqa: F401
+from ..ops import shard_index  # noqa: F401
+from ..ops import slice  # noqa: F401
+from ..ops import split  # noqa: F401
+from ..ops import chunk  # noqa: F401
+from ..ops import squeeze  # noqa: F401
+from ..ops import stack  # noqa: F401
+from ..ops import strided_slice  # noqa: F401
+from ..ops import tile  # noqa: F401
+from ..ops import transpose  # noqa: F401
+from ..ops import unbind  # noqa: F401
+from ..ops import unique  # noqa: F401
+from ..ops import unsqueeze  # noqa: F401
+from ..ops import unstack  # noqa: F401
+from ..ops import repeat_interleave  # noqa: F401
+from ..ops import index_select  # noqa: F401
+from ..ops import masked_select  # noqa: F401
+from ..ops import take_along_axis  # noqa: F401
+from ..ops import pixel_shuffle  # noqa: F401
+from ..ops import pixel_unshuffle  # noqa: F401
+from ..ops import channel_shuffle  # noqa: F401
+from ..ops import as_complex  # noqa: F401
+from ..ops import as_real  # noqa: F401
+from ..ops import reverse  # noqa: F401
+from ..ops import scatter_nd  # noqa: F401
+from ..ops import put_along_axis  # noqa: F401

@@ -1,0 +1,17 @@
+"""paddle_tpu.tensor.random — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/random.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import bernoulli  # noqa: F401
+from ..ops import multinomial  # noqa: F401
+from ..ops import normal  # noqa: F401
+from ..ops import uniform  # noqa: F401
+from ..ops import randn  # noqa: F401
+from ..ops import rand  # noqa: F401
+from ..ops import randint  # noqa: F401
+from ..ops import randperm  # noqa: F401
+from ..ops import poisson  # noqa: F401
+from ..ops import standard_normal  # noqa: F401

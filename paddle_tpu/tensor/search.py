@@ -1,0 +1,19 @@
+"""paddle_tpu.tensor.search — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/search.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import argmax  # noqa: F401
+from ..ops import argmin  # noqa: F401
+from ..ops import argsort  # noqa: F401
+from ..ops import searchsorted  # noqa: F401
+from ..ops import topk  # noqa: F401
+from ..ops import where  # noqa: F401
+from ..ops import index_sample  # noqa: F401
+from ..ops import nonzero  # noqa: F401
+from ..ops import sort  # noqa: F401
+from ..ops import index_select  # noqa: F401
+from ..ops import mode  # noqa: F401
+from ..ops import kthvalue  # noqa: F401

@@ -1,0 +1,14 @@
+"""paddle_tpu.tensor.stat — the 2.0 tensor-API split.
+
+Reference parity: python/paddle/tensor/stat.py (the 2.0 namespace
+rework present in the snapshot). Thin categorized re-exports of the
+mode-aware ops surface; implementations live in paddle_tpu.ops.
+"""
+
+from ..ops import mean  # noqa: F401
+from ..ops import std  # noqa: F401
+from ..ops import var  # noqa: F401
+from ..ops import numel  # noqa: F401
+from ..ops import median  # noqa: F401
+from ..ops import nanmedian  # noqa: F401
+from ..ops import quantile  # noqa: F401

@@ -1,0 +1,89 @@
+"""2.0 API namespace split tests.
+
+Reference parity: python/paddle/tensor/ (categorized modules) and the
+emerging paddle.linalg namespace of the 2.0 rework.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.linalg as linalg
+import paddle_tpu.tensor as tensor
+
+
+def test_submodules_exist():
+    for mod in ("attribute", "creation", "linalg", "logic", "manipulation",
+                "math", "random", "search", "stat"):
+        assert hasattr(tensor, mod), mod
+
+
+def test_category_membership():
+    assert tensor.creation.to_tensor is paddle.to_tensor
+    assert tensor.math.add is paddle.add
+    assert tensor.linalg.matmul is paddle.matmul
+    assert tensor.manipulation.reshape is paddle.reshape
+    assert tensor.search.argmax is paddle.argmax
+    assert tensor.stat.mean is paddle.mean
+
+
+def test_flat_namespace_reexports():
+    x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    y = tensor.matmul(x, x)
+    np.testing.assert_allclose(
+        np.asarray(y.numpy()), [[7, 10], [15, 22]]
+    )
+
+
+def test_linalg_namespace():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    assert float(np.asarray(linalg.det(x).numpy())) == 8.0
+    inv = np.asarray(linalg.inverse(x).numpy())
+    np.testing.assert_allclose(inv, np.eye(3) / 2)
+
+
+def test_new_tail_ops():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    assert int(np.asarray(paddle.rank(x).numpy())) == 1
+    np.testing.assert_allclose(
+        np.asarray(paddle.increment(x, 2.0).numpy()), [3, 4, 5]
+    )
+    o = paddle.outer(x, x)
+    assert list(o.shape) == [3, 3]
+    d = paddle.dist(x, paddle.to_tensor(np.zeros(3, np.float32)))
+    np.testing.assert_allclose(float(np.asarray(d.numpy())),
+                               np.sqrt(14), rtol=1e-6)
+    st = paddle.stanh(x, 0.67, 1.7159)
+    np.testing.assert_allclose(
+        np.asarray(st.numpy()), 1.7159 * np.tanh(0.67 * np.array([1, 2, 3])),
+        rtol=1e-5,
+    )
+
+
+def test_multiplex():
+    a = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    b = paddle.to_tensor(np.array([[10.0, 20.0], [30.0, 40.0]], np.float32))
+    idx = paddle.to_tensor(np.array([[1], [0]], np.int32))
+    out = paddle.multiplex([a, b], idx)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[10, 20], [3, 4]])
+
+
+def test_put_along_axis():
+    x = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    idx = paddle.to_tensor(np.array([[1], [2]], np.int64))
+    out = paddle.put_along_axis(x, idx, 9.0, axis=1)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               [[0, 9, 0], [0, 0, 9]])
+    out2 = paddle.put_along_axis(out, idx, 1.0, axis=1, reduce="add")
+    np.testing.assert_allclose(np.asarray(out2.numpy()),
+                               [[0, 10, 0], [0, 0, 10]])
+
+
+def test_scatter_nd_and_reverse():
+    from paddle_tpu import ops
+
+    idx = paddle.to_tensor(np.array([[0], [2]], np.int64))
+    upd = paddle.to_tensor(np.array([5.0, 7.0], np.float32))
+    out = ops.scatter_nd(idx, upd, [4])
+    np.testing.assert_allclose(np.asarray(out.numpy()), [5, 0, 7, 0])
+    r = ops.reverse(paddle.to_tensor(np.array([1.0, 2.0, 3.0])), axis=0)
+    np.testing.assert_allclose(np.asarray(r.numpy()), [3, 2, 1])
