@@ -1,0 +1,436 @@
+"""Dygraph-to-static AST transforms (value-dependent control flow).
+
+Reference parity: python/paddle/fluid/dygraph/dygraph_to_static/ — the
+AST transformer stack (ifelse_transformer.py, loop_transformer.py,
+logical_transformer.py, program_translator.py). The reference rewrites
+Python `if`/`while`/`and`/`or` over Variables into conditional_block /
+while ops; here they rewrite into runtime converter calls that dispatch
+on tracedness:
+
+- concrete (eager) values  → plain Python control flow, unchanged
+  semantics;
+- traced values (inside a compiled step / to_static trace) →
+  lax.cond / lax.while_loop / jnp.logical_*, which is how XLA wants
+  data-dependent control flow expressed.
+
+Supported v1 surface (unsupported shapes are left untouched and only
+fail if the predicate is actually traced, with a clear message):
+
+- ``if``/``elif``/``else`` whose branches assign local names (the
+  modified names become the merged outputs) or where both branches end
+  in ``return``;
+- ``while`` loops whose body assigns local names (the loop carry);
+- ``and`` / ``or`` / ``not`` inside the transformed function.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .framework.tensor import Tensor
+
+__all__ = [
+    "convert_ifelse",
+    "convert_while_loop",
+    "convert_logical_and",
+    "convert_logical_or",
+    "convert_logical_not",
+    "convert_to_static",
+]
+
+
+# ---------------------------------------------------------------------------
+# runtime converters (dygraph_to_static/convert_operators.py equivalents)
+# ---------------------------------------------------------------------------
+
+
+def _arr(v):
+    return v._array if isinstance(v, Tensor) else v
+
+
+def _is_traced(v):
+    return isinstance(_arr(v), jax.core.Tracer)
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        _arr, tree, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+
+
+def _rewrap_like(arrays, template):
+    flat_t, treedef = jax.tree_util.tree_flatten(
+        template, is_leaf=lambda x: isinstance(x, Tensor)
+    )
+    flat_a = jax.tree_util.tree_leaves(arrays)
+    out = [
+        Tensor._from_array(a) if isinstance(t, Tensor) else a
+        for a, t in zip(flat_a, flat_t)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def convert_ifelse(pred, true_fn, false_fn):
+    """ifelse_transformer target: branch on a maybe-traced predicate."""
+    if not _is_traced(pred):
+        p = _arr(pred)
+        taken = bool(np.asarray(p)) if hasattr(p, "dtype") else bool(p)
+        return true_fn() if taken else false_fn()
+    p = jnp.reshape(_arr(pred), ()).astype(bool)
+
+    # trace both branches; unify pytrees of Tensors/arrays
+    def mk(fn):
+        def f(_):
+            out = fn()
+            return _unwrap_tree(out)
+        return f
+
+    # branches must be pure (the reference's contract as well): true_fn
+    # runs once more here to recover the Tensor-vs-array structure
+    sample = true_fn()
+    out = lax.cond(p, mk(true_fn), mk(false_fn), None)
+    return _rewrap_like(out, sample)
+
+
+def convert_while_loop(cond_fn, body_fn, loop_vars):
+    """loop_transformer target: while over a maybe-traced condition.
+
+    Note the XLA contract: a traced while_loop is not reverse-
+    differentiable (use the scan construct for trainable loops).
+    """
+    first = cond_fn(*loop_vars)
+    if not _is_traced(first) and not any(_is_traced(v) for v in loop_vars):
+        vars_ = tuple(loop_vars)
+        while bool(np.asarray(_arr(cond_fn(*vars_)))):
+            out = body_fn(*vars_)
+            vars_ = tuple(out) if isinstance(out, tuple) else (out,)
+        return vars_ if len(vars_) > 1 else vars_[0]
+
+    template = tuple(loop_vars)
+    init = tuple(_arr(v) for v in loop_vars)
+
+    def cond(c):
+        vs = _rewrap_like(c, template)
+        return jnp.reshape(_arr(cond_fn(*vs)), ()).astype(bool)
+
+    def body(c):
+        vs = _rewrap_like(c, template)
+        out = body_fn(*vs)
+        out = out if isinstance(out, tuple) else (out,)
+        return tuple(_arr(v) for v in out)
+
+    final = lax.while_loop(cond, body, init)
+    out = _rewrap_like(final, template)
+    return out if len(template) > 1 else out[0]
+
+
+def convert_logical_and(x_fn, y_fn):
+    x = x_fn()
+    if not _is_traced(x):
+        xa = _arr(x)
+        if hasattr(xa, "dtype") and np.asarray(xa).size == 1:
+            if not bool(np.asarray(xa)):
+                return x  # python short-circuit semantics
+            return y_fn()
+        if not hasattr(xa, "dtype"):
+            return x and y_fn()
+    y = y_fn()
+    return Tensor._from_array(
+        jnp.logical_and(
+            jnp.asarray(_arr(x)).astype(bool),
+            jnp.asarray(_arr(y)).astype(bool),
+        )
+    )
+
+
+def convert_logical_or(x_fn, y_fn):
+    x = x_fn()
+    if not _is_traced(x):
+        xa = _arr(x)
+        if hasattr(xa, "dtype") and np.asarray(xa).size == 1:
+            if bool(np.asarray(xa)):
+                return x
+            return y_fn()
+        if not hasattr(xa, "dtype"):
+            return x or y_fn()
+    y = y_fn()
+    return Tensor._from_array(
+        jnp.logical_or(
+            jnp.asarray(_arr(x)).astype(bool),
+            jnp.asarray(_arr(y)).astype(bool),
+        )
+    )
+
+
+def convert_logical_not(x):
+    if not _is_traced(x) and not hasattr(_arr(x), "dtype"):
+        return not x
+    return Tensor._from_array(jnp.logical_not(
+        jnp.asarray(_arr(x)).astype(bool)
+    ))
+
+
+# ---------------------------------------------------------------------------
+# AST transformer (ifelse_transformer.py / loop_transformer.py)
+# ---------------------------------------------------------------------------
+
+
+def _assigned_names(nodes):
+    """Names bound by assignment/augassign/for-targets within nodes."""
+    out = []
+    for node in nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign):
+                for t in sub.targets:
+                    out.extend(_target_names(t))
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                out.extend(_target_names(sub.target))
+    seen = []
+    for n in out:
+        if n not in seen:
+            seen.append(n)
+    return seen
+
+
+def _target_names(t):
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    return []
+
+
+def _loaded_names(node):
+    return {
+        sub.id for sub in ast.walk(node)
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+    }
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- if/else ------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        uid = self._uid()
+
+        def ends_in_return(body):
+            return bool(body) and isinstance(body[-1], ast.Return)
+
+        has_return = any(
+            isinstance(s, ast.Return)
+            for b in (node.body, node.orelse) for stmt in b
+            for s in ast.walk(stmt)
+        )
+        if has_return:
+            # supported: both branches ARE a single return (the common
+            # `if c: return a` / `else: return b` tail); otherwise leave
+            # untouched (plain python — fails only on traced preds)
+            if (
+                len(node.body) == 1 and ends_in_return(node.body)
+                and len(node.orelse) == 1 and ends_in_return(node.orelse)
+            ):
+                t = ast.Lambda(
+                    args=_no_args(), body=node.body[0].value or
+                    ast.Constant(None),
+                )
+                f = ast.Lambda(
+                    args=_no_args(), body=node.orelse[0].value or
+                    ast.Constant(None),
+                )
+                call = _call("convert_ifelse", [node.test, t, f])
+                return ast.copy_location(ast.Return(value=call), node)
+            return node
+
+        modified = _assigned_names(node.body + node.orelse)
+        if not modified:
+            return node  # side-effect-only branches: leave to tracing
+
+        tname, fname = f"_pt_true_{uid}", f"_pt_false_{uid}"
+        ret = ast.Return(
+            value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in modified],
+                ctx=ast.Load(),
+            ) if len(modified) > 1 else ast.Name(id=modified[0],
+                                                ctx=ast.Load())
+        )
+        t_def = ast.FunctionDef(
+            name=tname, args=_no_args_def(),
+            body=(node.body or [ast.Pass()]) + [ret],
+            decorator_list=[], type_params=[],
+        )
+        f_def = ast.FunctionDef(
+            name=fname, args=_no_args_def(),
+            body=(node.orelse or [ast.Pass()]) + [ret],
+            decorator_list=[], type_params=[],
+        )
+        assign = ast.Assign(
+            targets=[
+                ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in modified],
+                    ctx=ast.Store(),
+                ) if len(modified) > 1 else ast.Name(id=modified[0],
+                                                     ctx=ast.Store())
+            ],
+            value=_call(
+                "convert_ifelse",
+                [node.test, ast.Name(id=tname, ctx=ast.Load()),
+                 ast.Name(id=fname, ctx=ast.Load())],
+            ),
+        )
+        return [
+            ast.copy_location(x, node) for x in (t_def, f_def, assign)
+        ]
+
+    # -- while --------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or any(
+            isinstance(s, (ast.Break, ast.Continue, ast.Return))
+            for stmt in node.body for s in ast.walk(stmt)
+        ):
+            return node  # unsupported: keep python semantics
+        uid = self._uid()
+        carry = _assigned_names(node.body)
+        carry = [n for n in carry
+                 if n in _loaded_names(node.test)
+                 or any(n in _loaded_names(s) for s in node.body)]
+        if not carry:
+            return node
+
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=n) for n in carry],
+            kwonlyargs=[], kw_defaults=[], defaults=[],
+        )
+        cname, bname = f"_pt_wcond_{uid}", f"_pt_wbody_{uid}"
+        c_def = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            type_params=[],
+        )
+        ret = ast.Return(
+            value=ast.Tuple(
+                elts=[ast.Name(id=n, ctx=ast.Load()) for n in carry],
+                ctx=ast.Load(),
+            )
+        )
+        b_def = ast.FunctionDef(
+            name=bname, args=args, body=node.body + [ret],
+            decorator_list=[], type_params=[],
+        )
+        assign = ast.Assign(
+            targets=[
+                ast.Tuple(
+                    elts=[ast.Name(id=n, ctx=ast.Store()) for n in carry],
+                    ctx=ast.Store(),
+                ) if len(carry) > 1 else ast.Name(id=carry[0],
+                                                 ctx=ast.Store())
+            ],
+            value=_call(
+                "convert_while_loop",
+                [ast.Name(id=cname, ctx=ast.Load()),
+                 ast.Name(id=bname, ctx=ast.Load()),
+                 ast.Tuple(
+                     elts=[ast.Name(id=n, ctx=ast.Load()) for n in carry],
+                     ctx=ast.Load(),
+                 )],
+            ),
+        )
+        return [ast.copy_location(x, node) for x in (c_def, b_def, assign)]
+
+    # -- and/or/not ---------------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = ("convert_logical_and" if isinstance(node.op, ast.And)
+              else "convert_logical_or")
+        out = node.values[-1]
+        for v in reversed(node.values[:-1]):
+            out = _call(
+                fn,
+                [ast.Lambda(args=_no_args(), body=v),
+                 ast.Lambda(args=_no_args(), body=out)],
+            )
+        return ast.copy_location(out, node)
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.copy_location(
+                _call("convert_logical_not", [node.operand]), node
+            )
+        return node
+
+
+def _call(name, args):
+    return ast.Call(
+        func=ast.Attribute(
+            value=ast.Name(id="_pt_jst", ctx=ast.Load()),
+            attr=name, ctx=ast.Load(),
+        ),
+        args=args, keywords=[],
+    )
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                         kw_defaults=[], defaults=[])
+
+
+_no_args_def = _no_args
+
+
+def convert_to_static(fn):
+    """Rewrite ``fn``'s control flow (program_translator.py role).
+
+    Returns the transformed function, or ``fn`` unchanged when the
+    source is unavailable or the transform does not apply.
+    """
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        fdef.decorator_list = []  # the decorator would recurse
+        new = _ControlFlowTransformer().visit(tree)
+        ast.fix_missing_locations(new)
+        code = compile(new, f"<dygraph_to_static:{fn.__qualname__}>",
+                       "exec")
+        import sys
+
+        this = sys.modules[__name__]
+        glb = dict(fn.__globals__)
+        glb["_pt_jst"] = this
+        # freevars of the original become globals of the rebuilt module-
+        # level def: seed them with the current cell contents (snapshot
+        # semantics — the reference's ProgramTranslator captures the
+        # same way)
+        for name, cell in zip(fn.__code__.co_freevars,
+                              fn.__closure__ or ()):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError:
+                pass  # empty cell (e.g. recursive self-reference)
+        loc = {}
+        exec(code, glb, loc)  # noqa: S102 — AST we just built
+        transformed = loc[fdef.name]
+        functools.update_wrapper(transformed, fn)
+        transformed.__wrapped_original__ = fn
+        return transformed
+    except (OSError, TypeError, SyntaxError):
+        return fn

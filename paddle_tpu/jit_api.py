@@ -44,6 +44,19 @@ class StaticFunction:
     """@to_static wrapper: jit-compiles the eager callable per signature."""
 
     def __init__(self, function, input_spec=None):
+        # AST pass (dygraph_to_static/): rewrite value-dependent python
+        # control flow into lax.cond/while_loop converter calls so
+        # data-dependent if/while compiles instead of failing the trace
+        from .dygraph_to_static import convert_to_static
+
+        bound_self = getattr(function, "__self__", None)
+        base = getattr(function, "__func__", function)
+        transformed = convert_to_static(base)
+        if transformed is not base:
+            function = (
+                transformed.__get__(bound_self)
+                if bound_self is not None else transformed
+            )
         self._function = function
         self._input_spec = input_spec
         self._compiled = {}
