@@ -1,0 +1,351 @@
+"""Reference op-type compatibility layer: aliases + small tail kernels.
+
+Reference parity: the op types of paddle/fluid/operators/ whose
+semantics already exist here under a different registry name (the *_v2 /
+*2 io-variant families) plus small kernels closing the remaining tail
+(tools/check_op_coverage.py tracks the list).
+
+An alias registers the reference op type dispatching to the existing
+kernel — programs/op tests written against reference op names run
+unchanged.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import get_op, register_op
+
+
+def _alias(ref_name, target, num_outputs=1):
+    k = get_op(target).fn
+
+    def fn(*args, **kwargs):
+        return k(*args, **kwargs)
+
+    fn.__name__ = ref_name
+    fn.__doc__ = f"alias of {target!r} (reference op type {ref_name!r})"
+    register_op(ref_name, num_outputs=num_outputs)(fn)
+
+
+# -- v2 / *2 io-variants (identical math, different slot layout) -------------
+_alias("matmul_v2", "matmul")
+_alias("reshape2", "reshape")
+_alias("transpose2", "transpose")
+_alias("squeeze2", "squeeze")
+_alias("unsqueeze2", "unsqueeze")
+_alias("flatten2", "flatten")
+_alias("flatten_contiguous_range", "flatten")
+_alias("top_k_v2", "top_k", num_outputs=2)
+_alias("lookup_table_v2", "lookup_table")
+_alias("elementwise_minus", "elementwise_sub")
+_alias("minus", "elementwise_sub")
+_alias("space_to_depth", "pixel_unshuffle")
+_alias("shuffle_channel", "channel_shuffle")
+_alias("fill_constant_batch_size_like", "fill_any_like")
+
+
+@register_op("tril_triu")
+def tril_triu(x, *, diagonal=0, lower=True):
+    """operators/tril_triu_op.cc: one op, attr-selected variant."""
+    return (jnp.tril if lower else jnp.triu)(x, k=diagonal)
+
+
+# -- interpolation family (interpolate_op.cc registers one op per mode) ------
+
+
+def _interp_mode(mode):
+    def fn(x, *, out_h=None, out_w=None, out_d=None, scale=None,
+           align_corners=False, align_mode=1, data_format="NCHW"):
+        k = get_op("interpolate").fn
+        size = None
+        if out_h is not None and out_w is not None:
+            size = ([out_d, out_h, out_w] if out_d is not None
+                    else [out_h, out_w])
+        return k(x, size=size, scale_factor=scale, mode=mode,
+                 align_corners=align_corners, data_format=data_format)
+    fn.__name__ = f"{mode}_interp"
+    return fn
+
+
+for _mode in ("nearest", "bilinear", "trilinear", "bicubic", "linear"):
+    register_op(f"{_mode}_interp")(_interp_mode(_mode))
+
+
+# -- pooling with indices -----------------------------------------------------
+
+
+@register_op("max_pool2d_with_index", num_outputs=2)
+def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False):
+    """operators/pool_with_index_op.cc: max pool + flat argmax indices."""
+    n, c, h, w = x.shape
+    ks = (kernel_size if isinstance(kernel_size, (list, tuple))
+          else (kernel_size, kernel_size))
+    st = (stride if isinstance(stride, (list, tuple))
+          else (stride, stride)) if stride is not None else ks
+    p = padding if isinstance(padding, (list, tuple)) else (padding, padding)
+    if global_pooling:
+        ks, st, p = (h, w), (1, 1), (0, 0)
+    flat_idx = jnp.arange(h * w, dtype=x.dtype).reshape(1, 1, h, w)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    neg = jnp.finfo(x.dtype).min
+
+    def sel(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        take = cv > av
+        return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+
+    window = (1, 1, ks[0], ks[1])
+    strides = (1, 1, st[0], st[1])
+    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    out, idx = lax.reduce_window(
+        (x, flat_idx),
+        (jnp.asarray(neg, x.dtype), jnp.asarray(-1.0, x.dtype)),
+        sel, window, strides, pads,
+    )
+    return out, idx.astype(jnp.int32)
+
+
+@register_op("unpool")
+def unpool(x, indices, *, output_size):
+    """operators/unpool_op.cc: scatter pooled values back to the flat
+    positions recorded by max_pool2d_with_index."""
+    n, c, h, w = x.shape
+    oh, ow = int(output_size[0]), int(output_size[1])
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    flat = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return flat.reshape(n, c, oh, ow)
+
+
+# -- small math/vision tail ---------------------------------------------------
+
+
+@register_op("squared_l2_norm")
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+@register_op("squared_l2_distance", num_outputs=2)
+def squared_l2_distance(x, y):
+    sub = x - y
+    return sub, jnp.sum(jnp.square(sub), axis=tuple(range(1, x.ndim)))
+
+
+@register_op("pad_constant_like")
+def pad_constant_like(x, y, *, pad_value=0.0):
+    """Pad y up to x's shape with pad_value."""
+    pads = [(0, xs - ys) for xs, ys in zip(x.shape, y.shape)]
+    return jnp.pad(y, pads, constant_values=pad_value)
+
+
+@register_op("lrn", num_outputs=2)
+def lrn(x, *, n=5, k=1.0, alpha=1e-4, beta=0.75):
+    """operators/lrn_op.cc: local response normalization over channels.
+    Returns (out, mid) — mid is the normalization denominator base."""
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    mid = k + alpha * acc
+    return x / jnp.power(mid, beta), mid
+
+
+@register_op("temporal_shift")
+def temporal_shift(x, *, seg_num, shift_ratio=0.25):
+    """operators/temporal_shift_op.cc (TSM): shift channel slices across
+    the time dimension of [N*T, C, H, W]."""
+    nt, c, h, w = x.shape
+    t = int(seg_num)
+    b = nt // t
+    v = x.reshape(b, t, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    fwd = jnp.concatenate(
+        [jnp.zeros_like(v[:, :1, :c1]), v[:, :-1, :c1]], axis=1
+    )
+    bwd = jnp.concatenate(
+        [v[:, 1:, c1:c2], jnp.zeros_like(v[:, :1, c1:c2])], axis=1
+    )
+    return jnp.concatenate([fwd, bwd, v[:, :, c2:]], axis=2).reshape(x.shape)
+
+
+@register_op("cos_sim")
+def cos_sim(x, y):
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), axis=-1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), axis=-1, keepdims=True))
+    sim = jnp.sum(x * y, axis=-1, keepdims=True) / jnp.maximum(
+        xn * yn, 1e-12
+    )
+    return sim
+
+
+@register_op("rank_loss")
+def rank_loss(label, left, right):
+    """operators/rank_loss_op.cc: RankNet pairwise loss."""
+    d = left - right
+    return jnp.log1p(jnp.exp(d)) - label * d
+
+
+@register_op("margin_rank_loss", num_outputs=2)
+def margin_rank_loss(label, left, right, *, margin=0.0):
+    out = jnp.maximum(0.0, -label * (left - right) + margin)
+    act = (out > 0).astype(left.dtype)
+    return out, act
+
+
+@register_op("bpr_loss")
+def bpr_loss(x, label):
+    """operators/bpr_loss_op.cc: Bayesian personalized ranking over
+    logits [N, C] with positive-class labels [N, 1]."""
+    n, c = x.shape
+    lbl = label.reshape(-1)
+    pos = jnp.take_along_axis(x, lbl[:, None], axis=1)
+    diff = pos - x  # [N, C]
+    mask = jnp.arange(c)[None, :] != lbl[:, None]
+    losses = -jnp.log(jax.nn.sigmoid(diff)) * mask
+    return jnp.sum(losses, axis=1, keepdims=True) / (c - 1)
+
+
+@register_op("center_loss", num_outputs=3)
+def center_loss(x, label, centers, *, alpha=0.1, update=True):
+    """operators/center_loss_op.cc: intra-class compactness loss.
+    Returns (loss [N,1], diff, new_centers)."""
+    ctr = centers[label.reshape(-1)]
+    diff = x - ctr
+    loss = 0.5 * jnp.sum(jnp.square(diff), axis=1, keepdims=True)
+    if update:
+        cnt = jnp.zeros(centers.shape[0], x.dtype).at[label.reshape(-1)].add(1.0)
+        upd = jnp.zeros_like(centers).at[label.reshape(-1)].add(diff)
+        new_centers = centers + alpha * upd / (1.0 + cnt)[:, None]
+    else:
+        new_centers = centers
+    return loss, diff, new_centers
+
+
+@register_op("conv_shift")
+def conv_shift(x, y):
+    """operators/conv_shift_op.cc: circular correlation of [B, N] with
+    [B, M] (M odd, M <= N)."""
+    b, n_len = x.shape
+    m = y.shape[1]
+    half = m // 2
+    idx = (jnp.arange(n_len)[:, None] + jnp.arange(-half, half + 1)[None, :]
+           ) % n_len
+    return jnp.einsum("bnm,bm->bn", x[:, idx], y)
+
+
+@register_op("partial_concat")
+def partial_concat(xs, *, start_index=0, length=-1):
+    parts = []
+    for x in xs:
+        end = x.shape[1] if length == -1 else start_index + length
+        parts.append(x[:, start_index:end])
+    return jnp.concatenate(parts, axis=1)
+
+
+@register_op("partial_sum")
+def partial_sum(xs, *, start_index=0, length=-1):
+    out = None
+    for x in xs:
+        end = x.shape[1] if length == -1 else start_index + length
+        s = x[:, start_index:end]
+        out = s if out is None else out + s
+    return out
+
+
+@register_op("shuffle_batch", num_outputs=2)
+def shuffle_batch(x, *, key):
+    perm = jax.random.permutation(key, x.shape[0])
+    return x[perm], perm.astype(jnp.int64)
+
+
+@register_op("sequence_reshape")
+def sequence_reshape(x, *, new_dim):
+    """sequence_ops/sequence_reshape_op.cc on the flat representation."""
+    return x.reshape(-1, int(new_dim))
+
+
+@register_op("sequence_scatter")
+def sequence_scatter(x, index, updates):
+    """sequence_ops/sequence_scatter_op.cc (flat segments design):
+    add updates at flat row indices."""
+    return x.at[index.reshape(-1)].add(updates)
+
+
+@register_op("spectral_norm")
+def spectral_norm(weight, u, v, *, dim=0, power_iters=1, eps=1e-12):
+    """operators/spectral_norm_op.cc: normalize weight by its largest
+    singular value (power iteration on the given u/v vectors)."""
+    w = jnp.moveaxis(weight, dim, 0)
+    h = w.shape[0]
+    mat = w.reshape(h, -1)
+    for _ in range(max(int(power_iters), 0)):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = mat @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ mat @ v
+    return jnp.moveaxis((mat / sigma).reshape(w.shape), 0, dim)
+
+
+@register_op("row_conv")
+def row_conv(x, w):
+    """operators/row_conv_op.cc: lookahead row convolution over
+    [B, T, D] with filter [future_context + 1, D]."""
+    ctx = w.shape[0]
+    b, t, d = x.shape
+    pad = jnp.pad(x, ((0, 0), (0, ctx - 1), (0, 0)))
+    return sum(pad[:, i:i + t] * w[i][None, None, :] for i in range(ctx))
+
+
+@register_op("affine_channel")
+def affine_channel(x, scale, bias, *, data_format="NCHW"):
+    shape = [1, -1] + [1] * (x.ndim - 2) if data_format == "NCHW" else (
+        [1] * (x.ndim - 1) + [-1]
+    )
+    return x * scale.reshape(shape) + bias.reshape(shape)
+
+
+@register_op("print")
+def print_op(x, *, message="", summarize=20, first_n=-1):
+    """operators/print_op.cc via the host-callback print path; identity
+    on the value (XLA keeps the data flowing)."""
+    jax.debug.print(message + " {}", x)
+    return x
+
+
+@register_op("py_func")
+def py_func(*args, func, out_shapes, out_dtypes):
+    """operators/py_func_op.cc: run a python callable as an op, via
+    jax.pure_callback (works eagerly and under jit)."""
+    dts = [jnp.dtype(d) for d in out_dtypes]
+    spec = [
+        jax.ShapeDtypeStruct(tuple(s), d) for s, d in zip(out_shapes, dts)
+    ]
+
+    def wrapped(*a):
+        out = func(*a)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        cast = tuple(
+            np.asarray(o, dtype=d) for o, d in zip(outs, dts)
+        )
+        return cast if len(cast) > 1 else cast[0]
+
+    if len(spec) == 1:
+        spec = spec[0]
+    return jax.pure_callback(wrapped, spec, *args, vmap_method="sequential")
+
+
+@register_op("shard_index_ref")
+def shard_index_ref(x, *, index_num, nshards, shard_id, ignore_value=-1):
+    """operators/shard_index_op.cc semantics under its reference name."""
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)

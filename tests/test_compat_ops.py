@@ -1,0 +1,154 @@
+"""Compat-layer op tests (reference op-type aliases + tail kernels)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401
+from paddle_tpu.ops.registry import has_op, kernel
+
+
+def test_v2_aliases_dispatch():
+    x = jnp.asarray(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(
+        np.asarray(kernel("matmul_v2")(x, x.T)),
+        np.asarray(kernel("matmul")(x, x.T)),
+    )
+    out = kernel("reshape2")(x, shape=(3, 2))
+    assert out.shape == (3, 2)
+    assert has_op("top_k_v2") and has_op("lookup_table_v2")
+
+
+def test_tril_triu_op():
+    x = jnp.ones((3, 3))
+    lo = np.asarray(kernel("tril_triu")(x, lower=True))
+    hi = np.asarray(kernel("tril_triu")(x, lower=False))
+    np.testing.assert_allclose(lo, np.tril(np.ones((3, 3))))
+    np.testing.assert_allclose(hi, np.triu(np.ones((3, 3))))
+
+
+def test_max_pool_with_index_and_unpool():
+    x = np.zeros((1, 1, 4, 4), np.float32)
+    x[0, 0, 1, 2] = 5.0
+    x[0, 0, 3, 0] = 7.0
+    out, idx = kernel("max_pool2d_with_index")(
+        jnp.asarray(x), kernel_size=2, stride=2
+    )
+    assert float(out[0, 0, 0, 1]) == 5.0
+    assert int(idx[0, 0, 0, 1]) == 1 * 4 + 2
+    assert int(idx[0, 0, 1, 0]) == 3 * 4 + 0
+    restored = kernel("unpool")(out, idx, output_size=(4, 4))
+    np.testing.assert_allclose(np.asarray(restored)[0, 0, 1, 2], 5.0)
+    np.testing.assert_allclose(np.asarray(restored)[0, 0, 3, 0], 7.0)
+
+
+def test_lrn_shapes_and_norm():
+    rng = np.random.RandomState(0)
+    x = rng.rand(2, 8, 3, 3).astype(np.float32)
+    out, mid = kernel("lrn")(jnp.asarray(x), n=5, k=2.0, alpha=1e-4,
+                             beta=0.75)
+    assert out.shape == x.shape
+    assert (np.asarray(mid) >= 2.0 - 1e-6).all()
+    assert (np.abs(np.asarray(out)) <= np.abs(x) + 1e-6).all()
+
+
+def test_temporal_shift():
+    x = np.arange(2 * 2 * 4, dtype=np.float32).reshape(4, 4, 1, 1)
+    out = np.asarray(kernel("temporal_shift")(
+        jnp.asarray(x), seg_num=2, shift_ratio=0.25
+    ))
+    # first quarter channels shift forward in time: t=0 gets zeros
+    assert out[0, 0, 0, 0] == 0.0
+    assert out[1, 0, 0, 0] == x[0, 0, 0, 0]
+
+
+def test_rank_and_bpr_losses():
+    label = jnp.asarray([[1.0], [0.0]])
+    left = jnp.asarray([[2.0], [1.0]])
+    right = jnp.asarray([[1.0], [3.0]])
+    rl = np.asarray(kernel("rank_loss")(label, left, right))
+    want = np.log1p(np.exp([[1.0], [-2.0]])) - np.array([[1.0], [0.0]]) * \
+        np.array([[1.0], [-2.0]])
+    np.testing.assert_allclose(rl, want, rtol=1e-6)
+
+    x = jnp.asarray(np.array([[3.0, 1.0, 0.5]], np.float32))
+    lbl = jnp.asarray(np.array([[0]], np.int64))
+    bl = np.asarray(kernel("bpr_loss")(x, lbl))
+    assert bl.shape == (1, 1) and bl[0, 0] > 0
+
+
+def test_spectral_norm_unit_sigma():
+    rng = np.random.RandomState(1)
+    w = rng.randn(4, 6).astype(np.float32)
+    u = rng.randn(4).astype(np.float32)
+    v = rng.randn(6).astype(np.float32)
+    wn = np.asarray(kernel("spectral_norm")(
+        jnp.asarray(w), jnp.asarray(u), jnp.asarray(v), power_iters=30
+    ))
+    s = np.linalg.svd(wn, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_row_conv():
+    x = np.ones((1, 3, 2), np.float32)
+    w = np.array([[1.0, 1.0], [0.5, 0.5]], np.float32)
+    out = np.asarray(kernel("row_conv")(jnp.asarray(x), jnp.asarray(w)))
+    # interior rows see full context, last row runs off the padding
+    np.testing.assert_allclose(out[0, 0], [1.5, 1.5])
+    np.testing.assert_allclose(out[0, 2], [1.0, 1.0])
+
+
+def test_conv_shift_circular():
+    x = jnp.asarray(np.eye(1, 5, k=0, dtype=np.float32))  # [1,5] delta
+    y = jnp.asarray(np.array([[1.0, 2.0, 3.0]], np.float32))
+    out = np.asarray(kernel("conv_shift")(x, y))
+    assert out.shape == (1, 5)
+    # delta at 0 picks y centered there circularly
+    np.testing.assert_allclose(out[0, 0], 2.0)
+
+
+def test_center_loss_updates_centers():
+    x = jnp.asarray(np.ones((2, 3), np.float32))
+    label = jnp.asarray(np.array([0, 0], np.int64))
+    centers = jnp.asarray(np.zeros((4, 3), np.float32))
+    loss, diff, new_c = kernel("center_loss")(x, label, centers, alpha=0.5)
+    assert loss.shape == (2, 1)
+    assert float(np.asarray(new_c)[0, 0]) > 0  # class-0 center moved
+    np.testing.assert_allclose(np.asarray(new_c)[1], 0.0)
+
+
+def test_py_func_op():
+    def f(a):
+        return np.asarray(a) * 3
+
+    out = kernel("py_func")(
+        jnp.asarray([1.0, 2.0], jnp.float32), func=f,
+        out_shapes=[(2,)], out_dtypes=["float32"],
+    )
+    np.testing.assert_allclose(np.asarray(out), [3.0, 6.0])
+
+    @jax.jit
+    def g(a):
+        return kernel("py_func")(a, func=f, out_shapes=[(2,)],
+                                 out_dtypes=["float32"])
+
+    np.testing.assert_allclose(np.asarray(g(jnp.asarray([2.0, 4.0]))),
+                               [6.0, 12.0])
+
+
+def test_affine_channel_and_pad_like():
+    x = jnp.ones((1, 2, 2, 2))
+    s = jnp.asarray([2.0, 3.0])
+    b = jnp.asarray([1.0, 0.0])
+    out = np.asarray(kernel("affine_channel")(x, s, b))
+    np.testing.assert_allclose(out[0, 0], 3.0)
+    np.testing.assert_allclose(out[0, 1], 3.0)
+
+    big = jnp.zeros((3, 4))
+    small = jnp.ones((2, 2))
+    padded = np.asarray(kernel("pad_constant_like")(big, small,
+                                                    pad_value=9.0))
+    assert padded.shape == (3, 4)
+    np.testing.assert_allclose(padded[0, :2], 1.0)
+    np.testing.assert_allclose(padded[2], 9.0)

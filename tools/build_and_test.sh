@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# CI driver (paddle/scripts/paddle_build.sh role: cmake_gen/build/run_test
+# collapsed to what this runtime needs).
+#
+# Usage: tools/build_and_test.sh [fast|full|bench|check]
+#   fast  - unit tests minus slow/subprocess ones
+#   full  - entire suite (default)
+#   bench - bench.py smoke on the current backend
+#   check - static gates: op coverage + API spec + graft entry self-test
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+MODE="${1:-full}"
+
+native_build() {
+  # compile the native components into the cache (fails loudly here
+  # rather than lazily at first use)
+  python - <<'PY'
+import jax; jax.config.update("jax_platforms", "cpu")
+from paddle_tpu._native import ShmRing
+from paddle_tpu._native.capi import build_capi
+ShmRing._load()
+print("shm_ring OK")
+print("capi:", build_capi())
+PY
+}
+
+case "$MODE" in
+  fast)
+    native_build
+    python -m pytest tests/ -x -q -m "not slow"
+    ;;
+  full)
+    native_build
+    python -m pytest tests/ -q
+    ;;
+  bench)
+    python bench.py
+    ;;
+  check)
+    python tools/check_op_coverage.py --min-pct 55
+    python tools/print_signatures.py --check
+    JAX_PLATFORMS=cpu python __graft_entry__.py
+    ;;
+  *)
+    echo "unknown mode: $MODE (fast|full|bench|check)" >&2
+    exit 2
+    ;;
+esac

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Public-API signature dump + compatibility gate.
+
+Reference parity: tools/print_signatures.py + tools/diff_api.py — CI
+hashes every public API signature and fails when one changes without an
+approved spec update.
+
+Usage:
+    python tools/print_signatures.py                 # dump to stdout
+    python tools/print_signatures.py --update        # rewrite api_spec.txt
+    python tools/print_signatures.py --check         # diff vs api_spec.txt
+"""
+from __future__ import annotations
+
+import argparse
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.nn",
+    "paddle_tpu.nn.functional",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.static",
+    "paddle_tpu.static.nn",
+    "paddle_tpu.tensor",
+    "paddle_tpu.linalg",
+    "paddle_tpu.distributed",
+    "paddle_tpu.distributed.fleet",
+    "paddle_tpu.parallel",
+    "paddle_tpu.inference",
+    "paddle_tpu.metric",
+    "paddle_tpu.amp",
+    "paddle_tpu.slim",
+    "paddle_tpu.io",
+]
+
+
+def collect():
+    import importlib
+
+    lines = []
+    for mod_name in MODULES:
+        mod = importlib.import_module(mod_name)
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            obj = getattr(mod, name)
+            if inspect.ismodule(obj):
+                continue
+            try:
+                if inspect.isclass(obj) or callable(obj):
+                    try:
+                        sig = str(inspect.signature(obj))
+                    except (ValueError, TypeError):
+                        sig = "(...)"
+                    lines.append(f"{mod_name}.{name}{sig}")
+            except Exception:
+                continue
+    return sorted(set(lines))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--update", action="store_true")
+    ap.add_argument("--check", action="store_true")
+    ns = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    lines = collect()
+    spec_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "api_spec.txt")
+    if ns.update:
+        with open(spec_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+        print(f"wrote {len(lines)} signatures to {spec_path}")
+        return 0
+    if ns.check:
+        if not os.path.exists(spec_path):
+            print("no api_spec.txt; run --update first")
+            return 1
+        with open(spec_path) as f:
+            old = {l.strip() for l in f if l.strip()}
+        new = set(lines)
+        removed = sorted(old - new)
+        added = sorted(new - old)
+        for l in removed:
+            print("REMOVED", l)
+        for l in added:
+            print("ADDED  ", l)
+        if removed:
+            print(f"FAIL: {len(removed)} public APIs changed/removed "
+                  "(update tools/api_spec.txt if intended)")
+            return 1
+        print(f"OK ({len(new)} signatures, {len(added)} new)")
+        return 0
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
