@@ -96,6 +96,28 @@ def _op_key(base_key, op, it=None):
     return key
 
 
+def op_in_names(op):
+    """Positional input names of an op.
+
+    The reference's OpDesc keys io by named slots (framework.proto:42
+    name-maps); this runtime canonically uses one "X" slot, but ops MAY
+    declare named multi-slot inputs via the ``__in_slots__`` attr (an
+    ordered slot list) — the kernel then receives the slots' vars
+    concatenated in that order. Same for outputs via ``__out_slots__``.
+    """
+    slots = op.attrs.get("__in_slots__")
+    if slots:
+        return [n for s in slots for n in op.inputs.get(s, [])]
+    return op.inputs.get("X", [])
+
+
+def op_out_names(op):
+    slots = op.attrs.get("__out_slots__")
+    if slots:
+        return [n for s in slots for n in op.outputs.get(s, [])]
+    return op.outputs.get("Out", [])
+
+
 class _BlockRunner:
     """Traces a program's ops into jax, recursively through sub-blocks."""
 
@@ -243,8 +265,8 @@ class _BlockRunner:
 
     def _exec_one(self, op, env, base_key, written_persist, block=None,
                   iter_idx=None):
-            in_names = op.inputs.get("X", [])
-            out_names = op.outputs.get("Out", [])
+            in_names = op_in_names(op)
+            out_names = op_out_names(op)
             attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
 
             if op.type in _BLOCK_OPS:

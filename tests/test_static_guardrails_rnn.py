@@ -183,3 +183,44 @@ def test_lstm_oracle():
         c = sigmoid(f) * c + sigmoid(i) * np.tanh(gg)
         h = sigmoid(oo) * np.tanh(c)
         np.testing.assert_allclose(o[:, t], h, rtol=1e-4, atol=1e-5)
+
+
+# -- named io slots (framework.proto:42 name-map design) --------------------
+
+
+def test_named_multi_slot_op():
+    """Ops may declare named input/output slots beyond the canonical
+    "X"/"Out" via __in_slots__/__out_slots__ (the reference's OpDesc
+    name-map); the executor concatenates slots in declared order."""
+    from paddle_tpu.ops.registry import has_op, register_op
+
+    if not has_op("_test_axpby"):
+        @register_op("_test_axpby", num_outputs=2)
+        def _test_axpby(alpha, x, y, *, beta=1.0):
+            return alpha * x + beta * y, alpha * x - beta * y
+
+    static.enable_static()
+    prog = static.default_main_program()
+    block = prog.global_block()
+    a = static.data("a", [], "float32")
+    x = static.data("x", [3], "float32")
+    y = static.data("y", [3], "float32")
+    out1 = block.create_var(name="sum_out", shape=[3], dtype="float32")
+    out2 = block.create_var(name="diff_out", shape=[3], dtype="float32")
+    block.append_op(
+        "_test_axpby",
+        {"Alpha": ["a"], "Input": ["x"], "Other": ["y"]},
+        {"SumOut": ["sum_out"], "DiffOut": ["diff_out"]},
+        {"beta": 2.0,
+         "__in_slots__": ["Alpha", "Input", "Other"],
+         "__out_slots__": ["SumOut", "DiffOut"]},
+    )
+    exe = static.Executor()
+    res = exe.run(
+        feed={"a": np.float32(3.0),
+              "x": np.array([1.0, 2.0, 3.0], np.float32),
+              "y": np.array([10.0, 20.0, 30.0], np.float32)},
+        fetch_list=["sum_out", "diff_out"],
+    )
+    np.testing.assert_allclose(res[0], [23.0, 46.0, 69.0])
+    np.testing.assert_allclose(res[1], [-17.0, -34.0, -51.0])
