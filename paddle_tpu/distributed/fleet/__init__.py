@@ -17,6 +17,7 @@ from .base import (  # noqa: F401
     fleet,
 )
 from . import utils  # noqa: F401  (fs layer: LocalFS/HDFSClient)
+from . import metrics  # noqa: F401  (distributed metrics)
 
 # module-level facade functions, mirroring `from paddle.distributed import
 # fleet; fleet.init(...)`

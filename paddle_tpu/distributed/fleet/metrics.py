@@ -1,0 +1,79 @@
+"""Fleet distributed metrics.
+
+Reference parity: python/paddle/distributed/fleet/metrics/metric.py
+(:23-337) — sum/max/min/auc/mae/rmse/acc reduced across all trainers
+(the reference all-reduces over Gloo/PS; here the reduction rides the
+jax.distributed world when one exists, and is the identity in a single
+process).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["sum", "max", "min", "auc", "mae", "rmse", "acc"]
+
+_builtin_sum, _builtin_max, _builtin_min = sum, max, min
+
+
+def _allreduce(value, op):
+    arr = np.asarray(value, np.float64)
+    if jax.process_count() == 1:
+        return arr
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(arr)
+    if op == "sum":
+        return np.asarray(gathered).sum(axis=0)
+    if op == "max":
+        return np.asarray(gathered).max(axis=0)
+    return np.asarray(gathered).min(axis=0)
+
+
+def sum(input):  # noqa: A001 — reference API name
+    """fleet/metrics/metric.py:sum — global sum of a local stat."""
+    return _allreduce(input, "sum")
+
+
+def max(input):  # noqa: A001
+    return _allreduce(input, "max")
+
+
+def min(input):  # noqa: A001
+    return _allreduce(input, "min")
+
+
+def auc(stat_pos, stat_neg):
+    """metric.py:auc — AUC from per-trainer positive/negative score
+    histograms (the streaming stat-tensor design of auc_op)."""
+    pos = _allreduce(stat_pos, "sum")
+    neg = _allreduce(stat_neg, "sum")
+    # walk thresholds high→low accumulating TPR/FPR trapezoids
+    new_pos = pos[::-1].cumsum()
+    new_neg = neg[::-1].cumsum()
+    total_pos = new_pos[-1]
+    total_neg = new_neg[-1]
+    if total_pos == 0 or total_neg == 0:
+        return 0.5
+    area = np.trapezoid(new_pos / total_pos, new_neg / total_neg)
+    return float(area)
+
+
+def mae(abserr, total_ins_num):
+    """metric.py:mae — global mean absolute error."""
+    err = _allreduce(abserr, "sum")
+    cnt = _allreduce(total_ins_num, "sum")
+    return float(err / _builtin_max(cnt, 1.0))
+
+
+def rmse(sqrerr, total_ins_num):
+    err = _allreduce(sqrerr, "sum")
+    cnt = _allreduce(total_ins_num, "sum")
+    return float(np.sqrt(err / _builtin_max(cnt, 1.0)))
+
+
+def acc(correct, total):
+    c = _allreduce(correct, "sum")
+    t = _allreduce(total, "sum")
+    return float(c / _builtin_max(t, 1.0))

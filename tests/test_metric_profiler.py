@@ -70,3 +70,22 @@ def test_profiler_disabled_records_nothing(tmp_path):
     profiler.export_chrome_tracing(path)
     trace = json.load(open(path))
     assert trace["traceEvents"] == []
+
+
+def test_fleet_metrics_single_process():
+    """fleet/metrics/metric.py surface: identity reductions in a single
+    process; auc reconstructs from stat histograms."""
+    from paddle_tpu.distributed.fleet import metrics as fm
+
+    assert float(fm.sum(np.array([1.0, 2.0])).sum()) == 3.0
+    assert float(fm.max(5.0)) == 5.0
+    assert fm.mae(abserr=10.0, total_ins_num=4.0) == 2.5
+    assert fm.rmse(sqrerr=16.0, total_ins_num=4.0) == 2.0
+    assert fm.acc(correct=3.0, total=4.0) == 0.75
+    # perfect separation: all positives above all negatives -> auc 1
+    pos = np.zeros(100); pos[90] = 10
+    neg = np.zeros(100); neg[10] = 10
+    assert fm.auc(pos, neg) > 0.99
+    # random: identical histograms -> auc 0.5
+    same = np.ones(100)
+    assert abs(fm.auc(same, same) - 0.5) < 1e-3
