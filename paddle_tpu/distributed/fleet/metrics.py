@@ -19,7 +19,12 @@ _builtin_sum, _builtin_max, _builtin_min = sum, max, min
 
 def _allreduce(value, op):
     arr = np.asarray(value, np.float64)
-    if jax.process_count() == 1:
+    # check the distributed client WITHOUT touching the backend:
+    # jax.process_count() would initialize XLA, silently returning local
+    # values pre-fleet.init() and forbidding the later rendezvous
+    from ..env import _distributed_client_active
+
+    if not _distributed_client_active() or jax.process_count() == 1:
         return arr
     from jax.experimental import multihost_utils
 
@@ -49,14 +54,16 @@ def auc(stat_pos, stat_neg):
     histograms (the streaming stat-tensor design of auc_op)."""
     pos = _allreduce(stat_pos, "sum")
     neg = _allreduce(stat_neg, "sum")
-    # walk thresholds high→low accumulating TPR/FPR trapezoids
-    new_pos = pos[::-1].cumsum()
-    new_neg = neg[::-1].cumsum()
+    # walk thresholds high→low accumulating TPR/FPR trapezoids; the ROC
+    # starts at the origin (reference metric.py seeds pos/neg at 0)
+    new_pos = np.concatenate(([0.0], pos[::-1].cumsum()))
+    new_neg = np.concatenate(([0.0], neg[::-1].cumsum()))
     total_pos = new_pos[-1]
     total_neg = new_neg[-1]
     if total_pos == 0 or total_neg == 0:
         return 0.5
-    area = np.trapezoid(new_pos / total_pos, new_neg / total_neg)
+    trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2.0
+    area = trapezoid(new_pos / total_pos, new_neg / total_neg)
     return float(area)
 
 

@@ -44,7 +44,33 @@ __all__ = [
     "convert_logical_or",
     "convert_logical_not",
     "convert_to_static",
+    "UNDEF",
 ]
+
+
+class _Undefined:
+    """Sentinel for names not yet bound when a transformed control-flow
+    region starts (the reference's UndefinedVar,
+    dygraph_to_static/variable_trans_func.py)."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<paddle_tpu UNDEF>"
+
+    def __bool__(self):
+        raise NameError(
+            "variable is used before assignment inside transformed "
+            "control flow"
+        )
+
+
+UNDEF = _Undefined()
 
 
 # ---------------------------------------------------------------------------
@@ -86,18 +112,21 @@ def convert_ifelse(pred, true_fn, false_fn):
         return true_fn() if taken else false_fn()
     p = jnp.reshape(_arr(pred), ()).astype(bool)
 
-    # trace both branches; unify pytrees of Tensors/arrays
-    def mk(fn):
+    # trace both branches; unify pytrees of Tensors/arrays. The first
+    # trace of true_fn doubles as the Tensor-vs-array structure template
+    # (no extra call — branches may be expensive to trace).
+    sample = [None]
+
+    def mk(fn, capture=False):
         def f(_):
             out = fn()
+            if capture:
+                sample[0] = out
             return _unwrap_tree(out)
         return f
 
-    # branches must be pure (the reference's contract as well): true_fn
-    # runs once more here to recover the Tensor-vs-array structure
-    sample = true_fn()
-    out = lax.cond(p, mk(true_fn), mk(false_fn), None)
-    return _rewrap_like(out, sample)
+    out = lax.cond(p, mk(true_fn, capture=True), mk(false_fn), None)
+    return _rewrap_like(out, sample[0])
 
 
 def convert_while_loop(cond_fn, body_fn, loop_vars):
@@ -106,6 +135,22 @@ def convert_while_loop(cond_fn, body_fn, loop_vars):
     Note the XLA contract: a traced while_loop is not reverse-
     differentiable (use the scan construct for trainable loops).
     """
+    if any(v is UNDEF for v in loop_vars):
+        # a name assigned inside the loop but unbound before it: fine in
+        # the python path (it binds on the first iteration), impossible
+        # as an XLA loop carry (fixed structure)
+        if any(_is_traced(v) for v in loop_vars if v is not UNDEF):
+            raise NameError(
+                "transformed while loop: a carried variable is not "
+                "initialized before the loop; XLA loop carries need an "
+                "initial value — assign it before the while"
+            )
+        env = list(loop_vars)
+        while bool(np.asarray(_arr(cond_fn(*env)))):
+            out = body_fn(*env)
+            env = list(out) if isinstance(out, tuple) else [out]
+        return tuple(env) if len(env) > 1 else env[0]
+
     first = cond_fn(*loop_vars)
     if not _is_traced(first) and not any(_is_traced(v) for v in loop_vars):
         vars_ = tuple(loop_vars)
@@ -183,11 +228,22 @@ def convert_logical_not(x):
 # ---------------------------------------------------------------------------
 
 
+def _walk_same_scope(node):
+    """ast.walk that does NOT descend into nested function/class scopes
+    (their locals are not this scope's assignments)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+            continue
+        yield from _walk_same_scope(child)
+
+
 def _assigned_names(nodes):
-    """Names bound by assignment/augassign/for-targets within nodes."""
+    """Names bound by assignment/augassign within nodes (current scope)."""
     out = []
     for node in nodes:
-        for sub in ast.walk(node):
+        for sub in _walk_same_scope(node):
             if isinstance(sub, ast.Assign):
                 for t in sub.targets:
                     out.extend(_target_names(t))
@@ -198,6 +254,33 @@ def _assigned_names(nodes):
         if n not in seen:
             seen.append(n)
     return seen
+
+
+def _prelude(names):
+    """`try: n = n / except NameError: n = _pt_jst.UNDEF` per name — the
+    UndefinedVar seeding (variable_trans_func.py) so branch/loop closures
+    can always read and return every merged name."""
+    stmts = []
+    for n in names:
+        stmts.append(ast.Try(
+            body=[ast.Assign(
+                targets=[ast.Name(id=n, ctx=ast.Store())],
+                value=ast.Name(id=n, ctx=ast.Load()),
+            )],
+            handlers=[ast.ExceptHandler(
+                type=ast.Name(id="NameError", ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=n, ctx=ast.Store())],
+                    value=ast.Attribute(
+                        value=ast.Name(id="_pt_jst", ctx=ast.Load()),
+                        attr="UNDEF", ctx=ast.Load(),
+                    ),
+                )],
+            )],
+            orelse=[], finalbody=[],
+        ))
+    return stmts
 
 
 def _target_names(t):
@@ -296,7 +379,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             ),
         )
         return [
-            ast.copy_location(x, node) for x in (t_def, f_def, assign)
+            ast.copy_location(x, node)
+            for x in _prelude(modified) + [t_def, f_def, assign]
         ]
 
     # -- while --------------------------------------------------------------
@@ -308,10 +392,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         ):
             return node  # unsupported: keep python semantics
         uid = self._uid()
+        # the carry is EVERY name the body assigns — a write-only var's
+        # final value must survive the loop for post-loop readers
         carry = _assigned_names(node.body)
-        carry = [n for n in carry
-                 if n in _loaded_names(node.test)
-                 or any(n in _loaded_names(s) for s in node.body)]
         if not carry:
             return node
 
@@ -353,7 +436,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                  )],
             ),
         )
-        return [ast.copy_location(x, node) for x in (c_def, b_def, assign)]
+        return [
+            ast.copy_location(x, node)
+            for x in _prelude(carry) + [c_def, b_def, assign]
+        ]
 
     # -- and/or/not ---------------------------------------------------------
     def visit_BoolOp(self, node):

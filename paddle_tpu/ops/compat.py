@@ -43,9 +43,35 @@ _alias("top_k_v2", "top_k", num_outputs=2)
 _alias("lookup_table_v2", "lookup_table")
 _alias("elementwise_minus", "elementwise_sub")
 _alias("minus", "elementwise_sub")
-_alias("space_to_depth", "pixel_unshuffle")
-_alias("shuffle_channel", "channel_shuffle")
-_alias("fill_constant_batch_size_like", "fill_any_like")
+
+
+@register_op("space_to_depth")
+def space_to_depth(x, *, blocksize):
+    """operators/space_to_depth_op.cc — pixel_unshuffle under the
+    reference attr name."""
+    return get_op("pixel_unshuffle").fn(x, downscale_factor=blocksize)
+
+
+@register_op("shuffle_channel")
+def shuffle_channel(x, *, group=None, groups=None):
+    """operators/shuffle_channel_op.cc — channel_shuffle under the
+    reference attr name (``group``)."""
+    return get_op("channel_shuffle").fn(
+        x, groups=group if group is not None else groups
+    )
+
+
+@register_op("fill_constant_batch_size_like")
+def fill_constant_batch_size_like(x, *, shape, value=0.0, dtype="float32",
+                                  input_dim_idx=0, output_dim_idx=0):
+    """operators/fill_constant_batch_size_like_op.cc: constant tensor of
+    ``shape`` with dim ``output_dim_idx`` taken from the input's dim
+    ``input_dim_idx``."""
+    from ..framework.dtype import convert_dtype
+
+    out_shape = list(shape)
+    out_shape[output_dim_idx] = x.shape[input_dim_idx]
+    return jnp.full(out_shape, value, convert_dtype(dtype))
 
 
 @register_op("tril_triu")

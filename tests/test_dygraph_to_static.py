@@ -196,3 +196,66 @@ def test_closure_snapshot():
     tfn = convert_to_static(fn)
     out = tfn(paddle.to_tensor(np.array([2.0], np.float32)))
     np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])
+
+
+# -- review-hardening cases -------------------------------------------------
+
+
+def test_while_carries_write_only_vars():
+    """A name assigned in the loop body but never read there must still
+    hold its final value after the loop."""
+    def fn(x, n):
+        out = x
+        i = paddle.to_tensor(np.asarray(0, np.int32))
+        while i < n:
+            i = i + 1
+            out = x * i
+        return out
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.asarray(2.0, np.float32)),
+              paddle.to_tensor(np.asarray(3, np.int32)))
+    assert float(np.asarray(out.numpy())) == 6.0
+
+
+def test_if_single_branch_binding():
+    """`if c: y = ...` with no else must not NameError when the branch
+    is not taken (the UndefinedVar seeding)."""
+    def fn(flag):
+        if flag:
+            y = 1
+        return "done"
+
+    tfn = convert_to_static(fn)
+    assert tfn(False) == "done"
+    assert tfn(True) == "done"
+
+
+def test_nested_function_locals_not_merged():
+    """Locals of a def nested inside a branch are not branch outputs."""
+    def fn(flag):
+        if flag:
+            def helper():
+                inner_local = 5
+                return inner_local
+            z = helper()
+        else:
+            z = 0
+        return z
+
+    tfn = convert_to_static(fn)
+    assert tfn(True) == 5
+    assert tfn(False) == 0
+
+
+def test_loop_var_unbound_before_loop_python_path():
+    """Pure-python loops may bind a carry var on the first iteration."""
+    def fn(n):
+        i = 0
+        while i < n:
+            first_seen = i  # unbound before the loop
+            i = i + 1
+        return i
+
+    tfn = convert_to_static(fn)
+    assert tfn(3) == 3
