@@ -206,6 +206,7 @@ from . import errors  # noqa: E402  (platform/enforce.h error taxonomy)
 from . import incubate  # noqa: E402  (auto-checkpoint)
 from . import slim  # noqa: E402  (quantization: QAT + PTQ)
 from . import tensor  # noqa: E402  (2.0 tensor-API namespace split)
+from . import crypto  # noqa: E402  (encrypted model io, framework/io/crypto)
 from . import linalg  # noqa: E402  (2.0 linalg namespace)
 from .ops import (  # noqa: E402,F401  (2.0 tail additions, flat aliases)
     clone,

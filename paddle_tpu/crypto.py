@@ -1,0 +1,122 @@
+"""Encrypted model save/load.
+
+Reference parity: paddle/fluid/framework/io/crypto/ (AESCipher,
+aes_cipher.h:48, cipher_utils.h) exposed through pybind/crypto.cc —
+key generation + encrypt/decrypt of model files so checkpoints at rest
+are protected.
+
+The reference uses cryptopp AES-GCM; here the `cryptography` package
+provides AESGCM. File format: 12-byte nonce || ciphertext+tag.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["CipherUtils", "AESCipher", "encrypt_file", "decrypt_file",
+           "save_encrypted", "load_encrypted"]
+
+
+class CipherUtils:
+    """cipher_utils.h: key generation helpers."""
+
+    @staticmethod
+    def gen_key(length_bits: int = 256) -> bytes:
+        if length_bits not in (128, 192, 256):
+            from .errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"AES key length must be 128/192/256 bits, got {length_bits}"
+            )
+        return os.urandom(length_bits // 8)
+
+    @staticmethod
+    def gen_key_to_file(length_bits: int, path: str) -> bytes:
+        key = CipherUtils.gen_key(length_bits)
+        with open(path, "wb") as f:
+            f.write(key)
+        return key
+
+    @staticmethod
+    def read_key_from_file(path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+
+class AESCipher:
+    """aes_cipher.h:48 — AES-GCM encrypt/decrypt of byte strings and
+    files."""
+
+    NONCE_BYTES = 12
+
+    def __init__(self, key: bytes):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        self._aead = AESGCM(key)
+
+    def encrypt(self, plaintext: bytes) -> bytes:
+        nonce = os.urandom(self.NONCE_BYTES)
+        return nonce + self._aead.encrypt(nonce, plaintext, None)
+
+    def decrypt(self, blob: bytes) -> bytes:
+        from .errors import PreconditionNotMetError
+
+        if len(blob) < self.NONCE_BYTES + 16:
+            raise PreconditionNotMetError(
+                "ciphertext too short to hold nonce+tag (corrupt file?)"
+            )
+        try:
+            return self._aead.decrypt(
+                blob[:self.NONCE_BYTES], blob[self.NONCE_BYTES:], None
+            )
+        except Exception as e:
+            raise PreconditionNotMetError(
+                "decryption failed: wrong key or corrupted ciphertext"
+            ) from e
+
+    def encrypt_to_file(self, plaintext: bytes, path: str):
+        with open(path, "wb") as f:
+            f.write(self.encrypt(plaintext))
+
+    def decrypt_from_file(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return self.decrypt(f.read())
+
+
+def encrypt_file(key: bytes, in_path: str, out_path: str):
+    with open(in_path, "rb") as f:
+        AESCipher(key).encrypt_to_file(f.read(), out_path)
+
+
+def decrypt_file(key: bytes, in_path: str, out_path: str):
+    data = AESCipher(key).decrypt_from_file(in_path)
+    with open(out_path, "wb") as f:
+        f.write(data)
+
+
+def save_encrypted(obj, path: str, key: bytes):
+    """paddle.save + at-rest encryption (the fleet encrypted-persistables
+    flow, framework/io/crypto + save_combine)."""
+    import io as _io
+
+    from .framework import serialization
+
+    tmp = path + ".plain.tmp"
+    serialization.save(obj, tmp)
+    try:
+        with open(tmp, "rb") as f:
+            AESCipher(key).encrypt_to_file(f.read(), path)
+    finally:
+        os.remove(tmp)
+
+
+def load_encrypted(path: str, key: bytes):
+    from .framework import serialization
+
+    data = AESCipher(key).decrypt_from_file(path)
+    tmp = path + ".plain.tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    try:
+        return serialization.load(tmp)
+    finally:
+        os.remove(tmp)

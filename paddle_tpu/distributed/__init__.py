@@ -28,3 +28,4 @@ from .env import (  # noqa: F401
 from . import fleet  # noqa: F401
 from .fleet import DistributedStrategy  # noqa: F401
 from .launch import spawn  # noqa: F401
+from . import elastic  # noqa: F401  (heartbeat monitor + restart driver)

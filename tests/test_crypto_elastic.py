@@ -1,0 +1,151 @@
+"""Encrypted model io + elastic/heartbeat tests.
+
+Reference parity: framework/io/crypto/ (AESCipher round trip, wrong-key
+failure), operators/distributed/heart_beat_monitor.cc (dead-trainer
+detection), checkpoint-based elastic recovery.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import crypto
+from paddle_tpu.distributed.elastic import HeartbeatMonitor, elastic_run
+from paddle_tpu.errors import FatalError, PreconditionNotMetError
+
+
+def test_cipher_roundtrip():
+    key = crypto.CipherUtils.gen_key(256)
+    c = crypto.AESCipher(key)
+    msg = b"model bytes" * 100
+    blob = c.encrypt(msg)
+    assert blob != msg
+    assert c.decrypt(blob) == msg
+
+
+def test_wrong_key_fails():
+    c1 = crypto.AESCipher(crypto.CipherUtils.gen_key(256))
+    c2 = crypto.AESCipher(crypto.CipherUtils.gen_key(256))
+    blob = c1.encrypt(b"secret")
+    with pytest.raises(PreconditionNotMetError):
+        c2.decrypt(blob)
+
+
+def test_key_file_and_file_encrypt(tmp_path):
+    kpath = str(tmp_path / "k.bin")
+    key = crypto.CipherUtils.gen_key_to_file(256, kpath)
+    assert crypto.CipherUtils.read_key_from_file(kpath) == key
+    src = tmp_path / "plain.txt"
+    src.write_bytes(b"hello" * 50)
+    enc = str(tmp_path / "enc.bin")
+    dec = str(tmp_path / "dec.txt")
+    crypto.encrypt_file(key, str(src), enc)
+    crypto.decrypt_file(key, enc, dec)
+    assert open(dec, "rb").read() == b"hello" * 50
+
+
+def test_save_load_encrypted_state_dict(tmp_path):
+    paddle.seed(3)
+    m = nn.Linear(4, 3)
+    key = crypto.CipherUtils.gen_key(128)
+    path = str(tmp_path / "model.enc")
+    crypto.save_encrypted(m.state_dict(), path, key)
+    # ciphertext on disk, not a plain checkpoint
+    raw = open(path, "rb").read()
+    assert b"weight" not in raw
+    state = crypto.load_encrypted(path, key)
+    np.testing.assert_array_equal(
+        np.asarray(state["weight"].numpy()), np.asarray(m.weight.numpy())
+    )
+    with pytest.raises(PreconditionNotMetError):
+        crypto.load_encrypted(path, crypto.CipherUtils.gen_key(128))
+
+
+def test_bad_key_length():
+    from paddle_tpu.errors import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError):
+        crypto.CipherUtils.gen_key(100)
+
+
+# -- heartbeat / elastic ----------------------------------------------------
+
+
+def test_heartbeat_detects_dead_peers(tmp_path):
+    job = str(tmp_path)
+    m0 = HeartbeatMonitor(job, rank=0, world_size=3, interval=0.1,
+                          timeout=0.5)
+    m1 = HeartbeatMonitor(job, rank=1, world_size=3, interval=0.1,
+                          timeout=0.5)
+    m0.beat()
+    m1.beat()
+    # rank 2 never beat
+    assert m0.dead_ranks() == [2]
+    # rank 1 goes silent past the timeout
+    old = time.time() - 10
+    os.utime(m1._path(1), (old, old))
+    assert m0.dead_ranks() == [1, 2]
+    assert not m0.all_alive()
+
+
+def test_heartbeat_thread(tmp_path):
+    with HeartbeatMonitor(str(tmp_path), 0, 1, interval=0.05,
+                          timeout=0.4) as mon:
+        t0 = os.stat(mon._path(0)).st_mtime
+        time.sleep(0.2)
+    assert mon.dead_ranks() == []
+
+
+def test_elastic_run_restarts_then_succeeds():
+    calls = []
+
+    def train():
+        calls.append(1)
+        if len(calls) < 3:
+            raise RuntimeError("worker died")
+        return "converged"
+
+    assert elastic_run(train, max_restarts=3) == "converged"
+    assert len(calls) == 3
+
+
+def test_elastic_run_gives_up():
+    def train():
+        raise RuntimeError("always dies")
+
+    with pytest.raises(FatalError, match="giving up"):
+        elastic_run(train, max_restarts=2)
+
+
+def test_elastic_resume_with_auto_checkpoint(tmp_path, monkeypatch):
+    """The full recovery story: crash mid-training, elastic_run restarts,
+    auto-checkpoint resumes from the last snapshot."""
+    monkeypatch.setenv("PADDLE_RUNNING_ENV", "PADDLE_EDL_AUTO_CHECKPOINT")
+    monkeypatch.setenv("PADDLE_EDL_HDFS_CHECKPOINT_PATH", str(tmp_path))
+    monkeypatch.setenv("PADDLE_JOB_ID", "elastic_job")
+    monkeypatch.setenv("PADDLE_EDL_SAVE_CHECKPOINT_INTER", "0")
+    from paddle_tpu.incubate import auto_checkpoint as acp
+
+    acp.reset_registry()
+    epochs_seen = []
+    crashed = []
+
+    def train():
+        paddle.seed(0)
+        m = nn.Linear(2, 2)
+        acp.reset_registry()
+        acp.register(m)
+        for epoch in acp.train_epoch_range(4):
+            epochs_seen.append(epoch)
+            if epoch == 1 and not crashed:
+                crashed.append(True)
+                raise RuntimeError("preempted")
+        return "done"
+
+    assert elastic_run(train, max_restarts=2) == "done"
+    # epoch 0 snapshotted; epoch 1 crashed before its snapshot → redone
+    assert epochs_seen == [0, 1, 1, 2, 3], epochs_seen
+    acp.reset_registry()
