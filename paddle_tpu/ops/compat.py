@@ -375,3 +375,277 @@ def shard_index_ref(x, *, index_num, nshards, shard_id, ignore_value=-1):
     shard_size = (index_num + nshards - 1) // nshards
     in_shard = (x // shard_size) == shard_id
     return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+# -- round-3 batch 2: remaining reference tail --------------------------------
+
+_alias("expand_v2", "expand")
+_alias("expand_as_v2", "expand_as")
+_alias("grid_sampler", "grid_sample")
+_alias("cross_entropy2", "cross_entropy")
+_alias("kldiv_loss", "kl_div")
+
+
+@register_op("deformable_conv_v1")
+def deformable_conv_v1(x, offset, weight, **kw):
+    """deformable_conv_v1_op.cc: the unmodulated variant (no mask)."""
+    return get_op("deformable_conv").fn(x, offset, None, weight, **kw)
+
+
+@register_op("depthwise_conv2d_transpose")
+def depthwise_conv2d_transpose(x, w, *, stride=1, padding=0,
+                               output_padding=0, dilation=1,
+                               data_format="NCHW"):
+    return get_op("conv2d_transpose").fn(
+        x, w, stride=stride, padding=padding,
+        output_padding=output_padding, dilation=dilation,
+        groups=x.shape[1], data_format=data_format,
+    )
+
+
+@register_op("frobenius_norm")
+def frobenius_norm(x, *, axis=None, keepdim=False):
+    axes = tuple(axis) if axis is not None else None
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=keepdim))
+
+
+@register_op("l1_norm")
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+@register_op("huber_loss", num_outputs=2)
+def huber_loss(x, y, *, delta=1.0):
+    """operators/huber_loss_op.cc: returns (out, residual)."""
+    r = y - x
+    a = jnp.abs(r)
+    out = jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+    return out, r
+
+
+@register_op("crop_tensor")
+def crop_tensor(x, *, shape, offsets=None):
+    """operators/crop_tensor_op.cc: static-window crop."""
+    off = list(offsets) if offsets is not None else [0] * x.ndim
+    idx = tuple(
+        slice(o, o + s) for o, s in zip(off, shape)
+    )
+    return x[idx]
+
+
+_alias("crop", "crop_tensor")
+
+
+@register_op("gather_tree")
+def gather_tree(ids, parents):
+    """operators/gather_tree_op.cc: beam-search backtracking.
+    ids/parents [T, B, W] -> full sequences [T, B, W]."""
+    t, b, w = ids.shape
+
+    def step(beams, tp):
+        step_ids, step_parents = tp
+        new = jnp.take_along_axis(step_ids, beams, axis=1)
+        parent = jnp.take_along_axis(step_parents, beams, axis=1)
+        return parent, new
+
+    init = jnp.broadcast_to(jnp.arange(w, dtype=parents.dtype), (b, w))
+    _, out_rev = lax.scan(step, init, (ids[::-1], parents[::-1]))
+    return out_rev[::-1]
+
+
+@register_op("im2sequence")
+def im2sequence(x, *, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
+    """operators/im2sequence_op.cc on the dense design: [N,C,H,W] ->
+    [N, out_h*out_w, C*kh*kw] patch rows."""
+    kh, kw = kernels
+    n, c, h, w = x.shape
+    ph0, pw0, ph1, pw1 = paddings
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
+    patches = lax.conv_general_dilated_patches(
+        xp, (kh, kw), tuple(strides), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # [N, C*kh*kw, oh, ow]
+    ckk = patches.shape[1]
+    return jnp.transpose(
+        patches.reshape(n, ckk, -1), (0, 2, 1)
+    )
+
+
+@register_op("fsp")
+def fsp(x, y):
+    """operators/fsp_op.cc: flow-of-solution-procedure matrix (knowledge
+    distillation): [N,C1,H,W] x [N,C2,H,W] -> [N,C1,C2]."""
+    n, c1, h, w = x.shape
+    return jnp.einsum("nahw,nbhw->nab", x, y) / (h * w)
+
+
+@register_op("cvm", num_outputs=1)
+def cvm(x, cvm_in, *, use_cvm=True):
+    """operators/cvm_op.cc: show/click feature handling — with use_cvm
+    the first two columns are log-transformed, else dropped."""
+    show = jnp.log(x[:, 0:1] + 1.0)
+    click = jnp.log(x[:, 1:2] + 1.0) - jnp.log(x[:, 0:1] + 1.0)
+    if use_cvm:
+        return jnp.concatenate([show, click, x[:, 2:]], axis=1)
+    return x[:, 2:]
+
+
+@register_op("batch_fc")
+def batch_fc(x, w, bias=None):
+    """operators/batch_fc_op.cc: per-slot fc — [S,B,D] @ [S,D,O] + [S,1,O]."""
+    out = jnp.einsum("sbd,sdo->sbo", x, w)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op("gru_unit", num_outputs=3)
+def gru_unit(x, h_prev, weight, bias=None, *,
+             activation="tanh", gate_activation="sigmoid"):
+    """operators/gru_unit_op.cc: one GRU step. x [B,3D] (pre-projected),
+    weight [D, 3D] (update|reset | candidate). Returns (h, reset_h, gates)."""
+    b, d3 = x.shape
+    d = d3 // 3
+    act = getattr(jax.nn, activation if activation != "identity" else "relu")
+    gate = getattr(jax.nn, gate_activation)
+    xs = x + (bias if bias is not None else 0.0)
+    g_uz = gate(xs[:, :2 * d] + h_prev @ weight[:, :2 * d])
+    u, r = g_uz[:, :d], g_uz[:, d:]
+    rh = r * h_prev
+    c = act(xs[:, 2 * d:] + rh @ weight[:, 2 * d:])
+    h = u * h_prev + (1.0 - u) * c
+    return h, rh, jnp.concatenate([g_uz, c], axis=1)
+
+
+@register_op("lstm_unit", num_outputs=2)
+def lstm_unit(x, c_prev, *, forget_bias=0.0):
+    """operators/lstm_unit_op.cc: one LSTM cell step over pre-projected
+    gates x [B, 4D]. Returns (c, h)."""
+    b, d4 = x.shape
+    d = d4 // 4
+    i, f, o, g = (x[:, k * d:(k + 1) * d] for k in range(4))
+    c = c_prev * jax.nn.sigmoid(f + forget_bias) + \
+        jax.nn.sigmoid(i) * jnp.tanh(g)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return c, h
+
+
+@register_op("lstmp", num_outputs=2)
+def lstmp(x, w_proj, *, hidden_size):
+    """operators/lstmp_op.cc capability: LSTM with a projection of the
+    hidden state. x [T,B,4H] pre-projected gates; returns projected
+    outputs [T,B,P] and final cell [B,H]."""
+    t, b, h4 = x.shape
+    h = int(hidden_size)
+
+    def step(carry, xt):
+        c_prev = carry
+        c, hh = get_op("lstm_unit").fn(xt, c_prev)
+        return c, hh @ w_proj
+
+    c0 = jnp.zeros((b, h), x.dtype)
+    c_final, ys = lax.scan(step, c0, x)
+    return ys, c_final
+
+
+@register_op("max_pool3d_with_index", num_outputs=2)
+def max_pool3d_with_index(x, *, kernel_size, stride=None, padding=0):
+    """pool_with_index_op.cc 3D path."""
+    n, c, d, h, w = x.shape
+    ks = (kernel_size,) * 3 if isinstance(kernel_size, int) else tuple(kernel_size)
+    st = ks if stride is None else (
+        (stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
+    flat = jnp.arange(d * h * w, dtype=x.dtype).reshape(1, 1, d, h, w)
+    flat = jnp.broadcast_to(flat, x.shape)
+    neg = jnp.finfo(x.dtype).min
+
+    def sel(acc, cur):
+        av, ai = acc
+        cv, ci = cur
+        take = cv > av
+        return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+
+    out, idx = lax.reduce_window(
+        (x, flat), (jnp.asarray(neg, x.dtype), jnp.asarray(-1.0, x.dtype)),
+        sel, (1, 1) + ks, (1, 1) + st,
+        ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
+    )
+    return out, idx.astype(jnp.int32)
+
+
+@register_op("mean_iou", num_outputs=3)
+def mean_iou(predictions, labels, *, num_classes):
+    """operators/mean_iou_op.cc: mean intersection-over-union.
+    Returns (mean_iou, out_wrong, out_correct)."""
+    p = predictions.reshape(-1)
+    l = labels.reshape(-1)
+    k = int(num_classes)
+    correct = jnp.zeros(k, jnp.int64).at[l].add(
+        (p == l).astype(jnp.int64), mode="drop")
+    pred_cnt = jnp.zeros(k, jnp.int64).at[p].add(1, mode="drop")
+    label_cnt = jnp.zeros(k, jnp.int64).at[l].add(1, mode="drop")
+    union = pred_cnt + label_cnt - correct
+    valid = union > 0
+    iou = jnp.where(valid, correct / jnp.maximum(union, 1), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid), 1)
+    return miou, (label_cnt - correct).astype(jnp.int32), \
+        correct.astype(jnp.int32)
+
+
+@register_op("linear_chain_crf", num_outputs=4)
+def linear_chain_crf(emission, transition, label):
+    """operators/linear_chain_crf_op.cc on the dense [B,T,C] design:
+    negative log-likelihood of the label path under a linear-chain CRF.
+    transition [C+2, C]: row 0 start, row 1 stop, rows 2.. pairwise.
+    Returns (alpha [B,T,C], emission_exps, transition_exps, log_likelihood
+    [B,1] as the nll)."""
+    b, t, c = emission.shape
+    start = transition[0]
+    stop = transition[1]
+    trans = transition[2:]
+
+    def fwd(alpha, e_t):
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None, :, :], axis=1
+        ) + e_t
+        return nxt, nxt
+
+    a0 = start[None, :] + emission[:, 0]
+    alpha_f, alphas = lax.scan(
+        fwd, a0, jnp.moveaxis(emission[:, 1:], 1, 0)
+    )
+    alphas = jnp.concatenate(
+        [a0[None], alphas], axis=0
+    )  # [T,B,C]
+    logz = jax.nn.logsumexp(alpha_f + stop[None, :], axis=1)  # [B]
+
+    # score of the gold path
+    lbl = label.reshape(b, t)
+    e_score = jnp.take_along_axis(
+        emission, lbl[:, :, None], axis=2
+    )[..., 0].sum(axis=1)
+    tr_score = trans[lbl[:, :-1], lbl[:, 1:]].sum(axis=1) if t > 1 else 0.0
+    path = e_score + tr_score + start[lbl[:, 0]] + stop[lbl[:, -1]]
+    nll = (logz - path)[:, None]
+    return (jnp.moveaxis(alphas, 0, 1), jnp.exp(emission),
+            jnp.exp(transition), nll)
+
+
+@register_op("nce")
+def nce(x, weight, bias, label, sample_ids, *, num_total_classes,
+        num_neg_samples):
+    """operators/nce_op.cc capability: noise-contrastive estimation loss
+    with caller-provided negative samples (static-shape contract; the
+    reference samples internally). x [B,D]; weight [C,D]; label [B];
+    sample_ids [B,S] negatives."""
+    true_logit = jnp.sum(x * weight[label], axis=1) + (
+        bias[label] if bias is not None else 0.0
+    )
+    neg_w = weight[sample_ids]  # [B,S,D]
+    neg_logit = jnp.einsum("bd,bsd->bs", x, neg_w) + (
+        bias[sample_ids] if bias is not None else 0.0
+    )
+    pos_loss = -jax.nn.log_sigmoid(true_logit)
+    neg_loss = -jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=1)
+    return (pos_loss + neg_loss)[:, None]

@@ -152,3 +152,136 @@ def test_affine_channel_and_pad_like():
     assert padded.shape == (3, 4)
     np.testing.assert_allclose(padded[0, :2], 1.0)
     np.testing.assert_allclose(padded[2], 9.0)
+
+
+# -- batch 2 ----------------------------------------------------------------
+
+
+def test_huber_and_frobenius():
+    x = jnp.asarray([0.0, 0.0], jnp.float32)
+    y = jnp.asarray([0.5, 3.0], jnp.float32)
+    out, r = kernel("huber_loss")(x, y, delta=1.0)
+    np.testing.assert_allclose(np.asarray(out), [0.125, 2.5])
+    np.testing.assert_allclose(np.asarray(r), [0.5, 3.0])
+    f = kernel("frobenius_norm")(jnp.asarray([[3.0, 4.0]]))
+    assert float(f) == 5.0
+
+
+def test_crop_tensor():
+    x = jnp.asarray(np.arange(24).reshape(4, 6).astype(np.float32))
+    out = np.asarray(kernel("crop_tensor")(x, shape=[2, 3], offsets=[1, 2]))
+    np.testing.assert_allclose(out, [[8, 9, 10], [14, 15, 16]])
+
+
+def test_gather_tree_backtracks():
+    # T=3, B=1, W=2 beams
+    ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int32)
+    parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int32)
+    out = np.asarray(kernel("gather_tree")(jnp.asarray(ids),
+                                           jnp.asarray(parents)))
+    # beam 0 at t=2 came from parent beam 1 at t=1 (which came from 0)
+    np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+    np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+
+def test_im2sequence_patches():
+    x = jnp.asarray(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = np.asarray(kernel("im2sequence")(x, kernels=(2, 2),
+                                           strides=(2, 2)))
+    assert out.shape == (1, 4, 4)
+    np.testing.assert_allclose(out[0, 0], [0, 1, 4, 5])
+    np.testing.assert_allclose(out[0, 3], [10, 11, 14, 15])
+
+
+def test_gru_lstm_units():
+    rng = np.random.RandomState(0)
+    b, d = 3, 4
+    x = jnp.asarray(rng.randn(b, 3 * d).astype(np.float32))
+    h0 = jnp.asarray(rng.randn(b, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(d, 3 * d).astype(np.float32) * 0.1)
+    h, rh, g = kernel("gru_unit")(x, h0, w)
+    assert h.shape == (b, d)
+    assert np.isfinite(np.asarray(h)).all()
+
+    x4 = jnp.asarray(rng.randn(b, 4 * d).astype(np.float32))
+    c0 = jnp.asarray(rng.randn(b, d).astype(np.float32))
+    c, hh = kernel("lstm_unit")(x4, c0)
+    # oracle
+    i, f, o, gg = np.split(np.asarray(x4), 4, axis=1)
+    sig = lambda v: 1 / (1 + np.exp(-v))
+    c_want = np.asarray(c0) * sig(f) + sig(i) * np.tanh(gg)
+    np.testing.assert_allclose(np.asarray(c), c_want, rtol=1e-5)
+
+
+def test_mean_iou():
+    pred = jnp.asarray(np.array([0, 0, 1, 1], np.int64))
+    lbl = jnp.asarray(np.array([0, 1, 1, 1], np.int64))
+    miou, wrong, correct = kernel("mean_iou")(pred, lbl, num_classes=2)
+    # class0: inter 1, union 2 -> 0.5; class1: inter 2, union 3 -> 2/3
+    np.testing.assert_allclose(float(miou), (0.5 + 2 / 3) / 2, rtol=1e-6)
+
+
+def test_linear_chain_crf_degenerate():
+    """Single-class CRF: nll must be 0 (the only path is the gold one)."""
+    b, t, c = 2, 3, 1
+    emission = jnp.asarray(np.random.RandomState(0).randn(b, t, c)
+                           .astype(np.float32))
+    transition = jnp.asarray(np.zeros((c + 2, c), np.float32))
+    label = jnp.asarray(np.zeros((b, t), np.int64))
+    _, _, _, nll = kernel("linear_chain_crf")(emission, transition, label)
+    np.testing.assert_allclose(np.asarray(nll), 0.0, atol=1e-5)
+
+
+def test_linear_chain_crf_gradients():
+    rng = np.random.RandomState(1)
+    b, t, c = 2, 4, 3
+    emission = rng.randn(b, t, c).astype(np.float32)
+    transition = rng.randn(c + 2, c).astype(np.float32) * 0.1
+    label = rng.randint(0, c, (b, t))
+
+    def loss(e, tr):
+        _, _, _, nll = kernel("linear_chain_crf")(
+            e, tr, jnp.asarray(label))
+        return jnp.sum(nll)
+
+    l0 = float(loss(jnp.asarray(emission), jnp.asarray(transition)))
+    assert np.isfinite(l0) and l0 > 0  # nll of a random path
+    g = jax.grad(loss, argnums=(0, 1))(
+        jnp.asarray(emission), jnp.asarray(transition))
+    assert all(np.isfinite(np.asarray(x)).all() for x in g)
+
+
+def test_nce_loss():
+    rng = np.random.RandomState(2)
+    b, d, cls, s = 4, 8, 16, 5
+    x = jnp.asarray(rng.randn(b, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(cls, d).astype(np.float32) * 0.1)
+    bias = jnp.asarray(np.zeros(cls, np.float32))
+    label = jnp.asarray(rng.randint(0, cls, (b,)))
+    negs = jnp.asarray(rng.randint(0, cls, (b, s)))
+    out = kernel("nce")(x, w, bias, label, negs,
+                        num_total_classes=cls, num_neg_samples=s)
+    assert out.shape == (b, 1)
+    assert (np.asarray(out) > 0).all()
+
+
+def test_fsp_and_cvm_and_batch_fc():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 3, 4, 4).astype(np.float32))
+    y = jnp.asarray(rng.randn(2, 5, 4, 4).astype(np.float32))
+    f = kernel("fsp")(x, y)
+    assert f.shape == (2, 3, 5)
+
+    cx = jnp.asarray(np.abs(rng.randn(3, 6)).astype(np.float32))
+    out = kernel("cvm")(cx, None, use_cvm=True)
+    assert out.shape == (3, 6)
+    out2 = kernel("cvm")(cx, None, use_cvm=False)
+    assert out2.shape == (3, 4)
+
+    bx = jnp.asarray(rng.randn(2, 3, 4).astype(np.float32))
+    bw = jnp.asarray(rng.randn(2, 4, 5).astype(np.float32))
+    bf = kernel("batch_fc")(bx, bw)
+    assert bf.shape == (2, 3, 5)
+    np.testing.assert_allclose(
+        np.asarray(bf[0]), np.asarray(bx[0]) @ np.asarray(bw[0]), rtol=1e-5
+    )

@@ -38,7 +38,7 @@ case "$MODE" in
     python bench.py
     ;;
   check)
-    python tools/check_op_coverage.py --min-pct 55
+    python tools/check_op_coverage.py --min-pct 90
     python tools/print_signatures.py --check
     JAX_PLATFORMS=cpu python __graft_entry__.py
     ;;

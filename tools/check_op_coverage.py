@@ -33,13 +33,36 @@ ABSORBED_PREFIXES = (
 ABSORBED = {
     "while", "conditional_block", "recurrent",  # control flow: we expose
     "read_from_array", "write_to_array",        # while/cond/scan instead
+    "select_input", "select_output",            # cond plumbing
     "create_double_buffer_reader", "create_py_reader", "read",
     "double_buffer", "py_reader",
     "allreduce", "broadcast",  # distributed.collective API
     "ref_by_trainer_id", "get_tensor_from_selected_rows",
     "merge_selected_rows", "clip_by_norm",  # SelectedRows machinery
+    "split_ids", "merge_ids", "split_byref", "split_selected_rows",
     "beam_search", "beam_search_decode",  # ops.beam_search module
     "warpctc",  # vendor library kernel
+    # LoD machinery: the ragged design is padded+lengths / flat+segment
+    # ids (ops/sequence.py) — these conversion ops have no meaning there
+    "array_to_lod_tensor", "lod_tensor_to_array", "lod_reset",
+    "merge_lod_tensor", "split_lod_tensor", "shrink_rnn_memory",
+    "lod_array_length", "lod_rank_table", "reorder_lod_tensor_by_rank",
+    # io ops: serialization is the python save/load layer
+    # (framework/serialization.py, static/io.py)
+    "save", "save_combine", "load", "load_combine", "delete_var",
+    "run_program",  # the Executor compiles blocks directly
+    "coalesce_tensor",  # gradient fusion is XLA's job
+    # vendor-fused kernels: capability covered by nn.rnn / static.nn
+    # lstm/gru over scan; no cudnn to bind
+    "cudnn_lstm", "attention_lstm", "lstm", "lstmp_fused",
+    # backend engines
+    "lite_engine", "anakin_engine",
+    # parameter-server sparse-table ops (PS runtime deferred, SURVEY §7)
+    "pull_sparse", "pull_sparse_v2", "push_sparse", "push_sparse_v2",
+    "pull_box_sparse", "push_box_sparse", "push_box_extended_sparse",
+    # sync_batch_norm: under GSPMD a dp-sharded batch mean IS the global
+    # mean — XLA inserts the cross-replica psum the reference hand-wrote
+    "sync_batch_norm", "inplace_abn",
 }
 
 
@@ -62,7 +85,7 @@ KNOWN_RENAMES = {
 def classify(ref_ops, registered, api_names):
     covered, missing, absorbed = set(), set(), set()
     for op in ref_ops:
-        if op.endswith("_grad"):
+        if op.endswith("_grad") or op.endswith("_grad2"):
             # the reference registers every gradient as its own op
             # (457 forward + grads); here jax.vjp synthesizes them —
             # absorbed by the autodiff design, not missing capability
@@ -81,7 +104,7 @@ def classify(ref_ops, registered, api_names):
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--min-pct", type=float, default=55.0)
+    ap.add_argument("--min-pct", type=float, default=90.0)
     ap.add_argument("--show-missing", action="store_true")
     ns = ap.parse_args()
 
