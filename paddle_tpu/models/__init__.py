@@ -22,3 +22,11 @@ from .bert import (  # noqa: F401
 )
 from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
 from .word2vec import Word2Vec  # noqa: F401
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenet import (  # noqa: F401
+    MobileNetV1,
+    MobileNetV2,
+    mobilenet_v1,
+    mobilenet_v2,
+)
+from .seq2seq import TransformerSeq2Seq  # noqa: F401

@@ -1,0 +1,160 @@
+"""Transformer sequence-to-sequence (machine translation).
+
+Reference parity: the dist_transformer.py test fixture and
+tests/book/test_machine_translation.py — an encoder-decoder translation
+model with greedy and beam-search decoding (beam via the
+beam_search/beam_search_decode op pair, ops/beam_search.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layers import Dropout, Embedding, Linear
+from ..nn.transformer import Transformer
+
+__all__ = ["TransformerSeq2Seq"]
+
+
+def _positional_encoding(max_len, d_model):
+    pos = np.arange(max_len)[:, None]
+    i = np.arange(d_model)[None, :]
+    angle = pos / np.power(10000.0, (2 * (i // 2)) / d_model)
+    enc = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
+    return enc.astype(np.float32)
+
+
+class TransformerSeq2Seq(Layer):
+    """Encoder-decoder MT model over the nn.Transformer stack.
+
+    pad_id tokens are masked out of attention; the decoder uses the
+    standard causal mask. ``beam_search`` follows the reference's
+    beam_search + beam_search_decode op contract.
+    """
+
+    def __init__(self, src_vocab, tgt_vocab, d_model=128, nhead=4,
+                 num_layers=2, dim_feedforward=256, dropout=0.1,
+                 max_len=256, bos_id=0, eos_id=1, pad_id=2):
+        super().__init__()
+        self.bos_id, self.eos_id, self.pad_id = bos_id, eos_id, pad_id
+        self.d_model = d_model
+        # the x*sqrt(d) transformer convention assumes N(0, 1/sqrt(d))
+        # embedding init (net unit variance); paddle's Embedding default
+        # N(0,1) would saturate attention after the scale
+        from ..nn import initializer as I
+
+        emb_init = I.Normal(0.0, d_model ** -0.5)
+        self.src_emb = Embedding(src_vocab, d_model, weight_attr=emb_init)
+        self.tgt_emb = Embedding(tgt_vocab, d_model, weight_attr=emb_init)
+        self.register_buffer(
+            "pos_enc", Tensor(_positional_encoding(max_len, d_model))
+        )
+        self.dropout = Dropout(dropout)
+        self.core = Transformer(
+            d_model=d_model, nhead=nhead, num_encoder_layers=num_layers,
+            num_decoder_layers=num_layers, dim_feedforward=dim_feedforward,
+            dropout=dropout,
+        )
+        self.out_proj = Linear(d_model, tgt_vocab)
+
+    # -- pieces --------------------------------------------------------------
+    def _embed(self, emb, ids):
+        seq_len = ids.shape[1]
+        x = emb(ids) * float(np.sqrt(self.d_model))
+        pos = ops.slice(self.pos_enc, [0], [0], [seq_len])
+        return self.dropout(ops.add(x, ops.unsqueeze(pos, 0)))
+
+    def _pad_mask(self, ids):
+        # [B, L] -> additive [B, 1, 1, L]
+        m = ops.cast(
+            ops.not_equal(ids, ops.full_like(ids, self.pad_id)), "float32"
+        )
+        return ops.scale(ops.subtract(ops.full([], 1.0),
+                                      ops.unsqueeze(m, [1, 2])), -1e9)
+
+    def encode(self, src_ids):
+        return self.core.encoder(
+            self._embed(self.src_emb, src_ids), self._pad_mask(src_ids)
+        )
+
+    def decode_logits(self, memory, memory_mask, tgt_ids):
+        t = tgt_ids.shape[1]
+        causal = Transformer.generate_square_subsequent_mask(t)
+        out = self.core.decoder(
+            self._embed(self.tgt_emb, tgt_ids), memory,
+            tgt_mask=causal, memory_mask=memory_mask,
+        )
+        return self.out_proj(out)
+
+    def forward(self, src_ids, tgt_ids):
+        """Teacher-forced training logits [B, T, V]."""
+        memory = self.encode(src_ids)
+        return self.decode_logits(memory, self._pad_mask(src_ids), tgt_ids)
+
+    # -- decoding -------------------------------------------------------------
+    def greedy_decode(self, src_ids, max_len=20):
+        """Greedy decoding (book test_machine_translation's decode loop)."""
+        b = src_ids.shape[0]
+        memory = self.encode(src_ids)
+        src_mask = self._pad_mask(src_ids)
+        ys = ops.full([b, 1], self.bos_id, "int64")
+        for _ in range(max_len - 1):
+            logits = self.decode_logits(memory, src_mask, ys)
+            nxt = ops.argmax(logits[:, -1], axis=-1)
+            ys = ops.concat([ys, ops.reshape(nxt, [b, 1]).astype("int64")],
+                            axis=1)
+        return ys
+
+    def beam_search(self, src_ids, beam_size=4, max_len=20):
+        """Beam-search decoding over the beam_search op pair.
+
+        Returns (sequences [T, B, beam], scores [B, beam]) — best
+        hypothesis at argmax score, backtracked by beam_search_decode.
+        """
+        from ..ops.registry import kernel
+
+        b = src_ids.shape[0]
+        memory = self.encode(src_ids)
+        src_mask = self._pad_mask(src_ids)
+        mem = memory._array if isinstance(memory, Tensor) else memory
+        # expand memory over beams: [B*K, L, D]
+        k = int(beam_size)
+        mem_k = jnp.repeat(mem, k, axis=0)
+        mask_k = jnp.repeat(
+            src_mask._array if isinstance(src_mask, Tensor) else src_mask,
+            k, axis=0,
+        )
+        scores = jnp.zeros((b, k), jnp.float32)
+        ys = jnp.full((b * k, 1), self.bos_id, jnp.int32)
+        parents_hist, tokens_hist = [], []
+        for t in range(max_len - 1):
+            logits = self.decode_logits(
+                Tensor._from_array(mem_k), Tensor._from_array(mask_k),
+                Tensor._from_array(ys),
+            )
+            arr = logits._array if isinstance(logits, Tensor) else logits
+            logp = jnp.log(jnp.maximum(
+                F.softmax(Tensor._from_array(arr[:, -1]))._array, 1e-9
+            )).reshape(b, k, -1)
+            scores, parent, token = kernel("beam_search_step")(
+                logp, scores, beam_size=k, first_step=(t == 0)
+            )
+            parents_hist.append(parent)
+            tokens_hist.append(token)
+            # reorder beams and append tokens
+            flat_parent = (
+                parent + jnp.arange(b)[:, None] * k
+            ).reshape(-1)
+            ys = ys[flat_parent]
+            ys = jnp.concatenate(
+                [ys, token.reshape(-1, 1).astype(jnp.int32)], axis=1
+            )
+        seqs, final = kernel("beam_search_decode")(
+            jnp.stack(parents_hist), jnp.stack(tokens_hist), scores
+        )
+        return seqs, final

@@ -1,0 +1,90 @@
+"""VGG family.
+
+Reference parity: python/paddle/incubate/hapi/vision/models/vgg.py —
+the stacked-conv classifier used in the reference's vision model zoo
+and book tests (tests/book/test_image_classification.py uses a
+VGG-style net).
+"""
+from __future__ import annotations
+
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layers import (
+    AdaptiveAvgPool2D,
+    BatchNorm2D,
+    Conv2D,
+    Dropout,
+    Linear,
+    MaxPool2D,
+    Sequential,
+)
+
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
+
+_CFGS = {
+    "A": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "B": [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+          512, 512, "M"],
+    "D": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512,
+          "M", 512, 512, 512, "M"],
+    "E": [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512,
+          512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def _make_features(cfg, batch_norm):
+    layers = []
+    in_c = 3
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(2, 2))
+            continue
+        layers.append(Conv2D(in_c, v, 3, padding=1))
+        if batch_norm:
+            layers.append(BatchNorm2D(v))
+        layers.append(_ReLU())
+        in_c = v
+    return Sequential(*layers)
+
+
+class _ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class VGG(Layer):
+    """hapi/vision/models/vgg.py VGG."""
+
+    def __init__(self, cfg="D", num_classes=1000, batch_norm=False,
+                 dropout=0.5):
+        super().__init__()
+        self.features = _make_features(_CFGS[cfg], batch_norm)
+        self.avgpool = AdaptiveAvgPool2D((7, 7))
+        self.classifier = Sequential(
+            Linear(512 * 7 * 7, 4096), _ReLU(), Dropout(dropout),
+            Linear(4096, 4096), _ReLU(), Dropout(dropout),
+            Linear(4096, num_classes),
+        )
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        from .. import ops
+
+        x = ops.flatten(x, start_axis=1)
+        return self.classifier(x)
+
+
+def vgg11(**kw):
+    return VGG("A", **kw)
+
+
+def vgg13(**kw):
+    return VGG("B", **kw)
+
+
+def vgg16(**kw):
+    return VGG("D", **kw)
+
+
+def vgg19(**kw):
+    return VGG("E", **kw)

@@ -106,10 +106,14 @@ class Layer:
         name = None
         trainable = True
         if attr is not None and attr is not False:
-            # ParamAttr-like: accept dict or ParamAttr
-            init = getattr(attr, "initializer", None) or init
-            name = getattr(attr, "name", None)
-            trainable = getattr(attr, "trainable", True)
+            if isinstance(attr, I.Initializer):
+                # paddle accepts a bare Initializer as weight_attr
+                init = attr
+            else:
+                # ParamAttr-like: accept dict or ParamAttr
+                init = getattr(attr, "initializer", None) or init
+                name = getattr(attr, "name", None)
+                trainable = getattr(attr, "trainable", True)
         init = I._resolve(init, is_bias=is_bias)
         arr = init(shape, dtype or self._dtype or get_default_dtype())
         return Parameter.from_array(arr, name=name, trainable=trainable)
