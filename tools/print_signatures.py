@@ -36,6 +36,10 @@ MODULES = [
     "paddle_tpu.amp",
     "paddle_tpu.slim",
     "paddle_tpu.io",
+    "paddle_tpu.models",
+    "paddle_tpu.incubate.auto_checkpoint",
+    "paddle_tpu.crypto",
+    "paddle_tpu.distributed.elastic",
 ]
 
 
