@@ -462,3 +462,41 @@ def test_eager_wrappers_exist():
         "polygon_box_transform",
     ]:
         assert hasattr(ops, name), name
+
+
+def test_prroi_pool_constant_region():
+    """On a constant feature map every PrRoI bin integrates to the
+    constant; on a linear ramp the bin equals the ramp at its center
+    (exactness of the bilinear integral)."""
+    x = np.full((1, 1, 8, 8), 3.0, np.float32)
+    rois = np.array([[1.0, 1.0, 6.0, 6.0]], np.float32)
+    out = np.asarray(kernel("prroi_pool")(
+        jnp.asarray(x), jnp.asarray(rois), pooled_height=2, pooled_width=2,
+    ))
+    np.testing.assert_allclose(out, 3.0, rtol=1e-5)
+
+    ramp = np.broadcast_to(
+        np.arange(8, dtype=np.float32)[None, :], (8, 8)
+    ).reshape(1, 1, 8, 8).copy()
+    out2 = np.asarray(kernel("prroi_pool")(
+        jnp.asarray(ramp), jnp.asarray(rois), pooled_height=1,
+        pooled_width=2,
+    ))
+    # bins [1, 3.5] and [3.5, 6] of a linear ramp → means 2.25 and 4.75
+    np.testing.assert_allclose(out2[0, 0, 0], [2.25, 4.75], rtol=1e-5)
+
+
+def test_prroi_pool_differentiable_wrt_rois():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, 2, 8, 8).astype(np.float64))
+
+    def f(coords):
+        rois = coords.reshape(1, 4)
+        return jnp.sum(kernel("prroi_pool")(
+            x, rois, pooled_height=2, pooled_width=2
+        ))
+
+    coords = jnp.asarray(np.array([1.0, 1.0, 6.0, 6.0]))
+    g = jax.grad(f)(coords)
+    assert np.isfinite(np.asarray(g)).all()
+    assert np.abs(np.asarray(g)).sum() > 0  # coordinates get gradients
