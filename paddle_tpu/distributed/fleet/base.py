@@ -348,6 +348,14 @@ class DistributedOptimizer:
             getattr(strategy, "lars", False) or getattr(strategy, "lamb", False)
         ):
             return inner
+        if not hasattr(inner, "_parameter_list"):
+            from ...errors import UnimplementedError
+
+            raise UnimplementedError(
+                "strategy.lars/lamb swap the eager optimizer's update "
+                "rule; for static programs construct the static "
+                "optimizer with the desired rule directly"
+            )
         from ... import optimizer as opt_mod
         from ...ops import optimizer_kernels as ok
 
@@ -420,6 +428,36 @@ class DistributedOptimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
+        from ...static.program import Variable, in_static_mode
+
+        if in_static_mode() and isinstance(loss, Variable):
+            # static fleet path (fleet_base.py:291 over a Program): the
+            # wrapped optimizer's minimize appends backward + update ops.
+            # Collective gradient sync is GSPMD's job at run time; the
+            # compiled-step-only strategy behaviors cannot rewrite a
+            # static program — refuse loudly rather than silently train
+            # without them (strategy_compiler contract).
+            unsupported = [
+                name for name, on in (
+                    ("recompute", self._opts.get("recompute")),
+                    ("gradient_merge", self._opts.get("grad_accum_steps", 1) > 1),
+                    ("sharding", self._opts.get("zero1")),
+                    ("localsgd", self._opts.get("localsgd")),
+                ) if on
+            ]
+            if unsupported:
+                from ...errors import UnimplementedError
+
+                raise UnimplementedError(
+                    f"DistributedStrategy.{'/'.join(unsupported)} applies "
+                    "to compiled train steps (hapi Model / "
+                    "parallel.sharded_train_step), not static programs; "
+                    "unset the flag or use the functional path"
+                )
+            return self.inner_opt.minimize(
+                loss, startup_program=startup_program,
+                parameter_list=parameter_list, no_grad_set=no_grad_set,
+            )
         loss.backward()
         self.step()
         return None, None

@@ -390,3 +390,68 @@ def test_lars_strategy_swaps_optimizer():
     dopt.minimize(loss)
     after = np.asarray(m.fc1.weight._array)
     assert not np.allclose(before, after)
+
+
+# -- static fleet path ------------------------------------------------------
+
+
+def test_fleet_minimize_static_program():
+    """fleet.distributed_optimizer over a static Program (the reference's
+    primary fleet flow, fleet_base.py:291): minimize appends backward +
+    update ops; training runs through the Executor."""
+    import paddle_tpu.static as static
+
+    static.reset_default_programs()
+    static.global_scope().clear()
+    static.enable_static()
+    try:
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss_var = paddle.ops.mean(
+            paddle.ops.square(paddle.ops.subtract(pred, y))
+        )
+        sgd = static.optimizer.SGD(learning_rate=0.05)
+        dopt = fleet.fleet.init().distributed_optimizer(
+            sgd, fleet.DistributedStrategy()
+        )
+        dopt.minimize(loss_var)
+        exe = static.Executor()
+        exe.run_startup()
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 4).astype("float32")
+        W = rng.randn(4, 1).astype("float32")
+        Yv = X @ W
+        losses = [
+            float(exe.run(feed={"x": X, "y": Yv},
+                          fetch_list=[loss_var])[0])
+            for _ in range(40)
+        ]
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+
+def test_fleet_static_rejects_compiled_only_flags():
+    import paddle_tpu.static as static
+
+    static.reset_default_programs()
+    static.global_scope().clear()
+    static.enable_static()
+    try:
+        x = static.data("x", [4], "float32")
+        loss_var = paddle.ops.mean(paddle.ops.square(x))
+        strategy = fleet.DistributedStrategy()
+        strategy.recompute = True
+        sgd = static.optimizer.SGD(learning_rate=0.1)
+        dopt = fleet.fleet.init().distributed_optimizer(sgd, strategy)
+        import paddle_tpu.errors as errors
+
+        with pytest.raises(errors.UnimplementedError, match="recompute"):
+            dopt.minimize(loss_var)
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
