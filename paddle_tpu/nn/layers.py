@@ -546,3 +546,164 @@ class Pad2D(Layer):
 
     def forward(self, x):
         return ops.pad(x, self.paddings, mode=self.mode, value=self.value)
+
+
+class CosineSimilarity(Layer):
+    """paddle.nn.CosineSimilarity (nn/layer/distance.py)."""
+
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self._axis, self._eps = axis, eps
+
+    def forward(self, x1, x2):
+        import jax.numpy as jnp
+
+        a, b = x1._array, x2._array
+        num = jnp.sum(a * b, axis=self._axis)
+        den = jnp.maximum(
+            jnp.linalg.norm(a, axis=self._axis)
+            * jnp.linalg.norm(b, axis=self._axis),
+            self._eps,
+        )
+        return Tensor._from_array(num / den)
+
+
+class PairwiseDistance(Layer):
+    """paddle.nn.PairwiseDistance (nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False):
+        super().__init__()
+        self._p, self._eps, self._keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        import jax.numpy as jnp
+
+        d = x._array - y._array + self._eps
+        out = jnp.linalg.norm(d, ord=self._p, axis=-1,
+                              keepdims=self._keepdim)
+        return Tensor._from_array(out)
+
+
+class Bilinear(Layer):
+    """paddle.nn.Bilinear: out_k = x1 @ W_k @ x2 + b_k
+    (nn/layer/common.py Bilinear; operators/bilinear_tensor_product_op.cc)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            [out_features, in1_features, in2_features], attr=weight_attr,
+            default_initializer=I.XavierUniform(),
+        )
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            [out_features], attr=bias_attr, is_bias=True))
+
+    def forward(self, x1, x2):
+        import jax.numpy as jnp
+
+        out = jnp.einsum("bi,oij,bj->bo", x1._array, self.weight._array,
+                         x2._array)
+        if self.bias is not None:
+            out = out + self.bias._array
+        return Tensor._from_array(out)
+
+
+class SpectralNorm(Layer):
+    """paddle.nn.SpectralNorm (nn/layer/norm.py; spectral_norm_op.cc):
+    normalizes a weight tensor by its largest singular value, keeping the
+    power-iteration vectors as buffers."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
+        super().__init__()
+        import numpy as _np
+
+        self._dim, self._iters, self._eps = dim, power_iters, eps
+        h = weight_shape[dim]
+        w = int(_np.prod(weight_shape)) // h
+        rng = _np.random.RandomState(0)
+        self.register_buffer(
+            "weight_u", Tensor((rng.randn(h) / _np.sqrt(h)).astype("float32"))
+        )
+        self.register_buffer(
+            "weight_v", Tensor((rng.randn(w) / _np.sqrt(w)).astype("float32"))
+        )
+
+    def forward(self, weight):
+        from ..ops.registry import kernel
+
+        w = weight._array if isinstance(weight, Tensor) else weight
+        out = kernel("spectral_norm")(
+            w, self.weight_u._array, self.weight_v._array,
+            dim=self._dim, power_iters=self._iters, eps=self._eps,
+        )
+        return Tensor._from_array(out)
+
+
+class Unfold(Layer):
+    """paddle.nn.Unfold (im2col, nn/layer/common.py): [N,C,H,W] ->
+    [N, C*kh*kw, L]."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        pair = lambda v: tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+        self._ks = pair(kernel_sizes)
+        self._st = pair(strides)
+        self._pd = pair(paddings)
+        self._dl = pair(dilations)
+
+    def forward(self, x):
+        from jax import lax
+
+        arr = x._array
+        p = self._pd
+        import jax.numpy as jnp
+
+        arr = jnp.pad(arr, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        patches = lax.conv_general_dilated_patches(
+            arr, self._ks, self._st, "VALID", rhs_dilation=self._dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [N, C*kh*kw, oh, ow]
+        n, ckk = patches.shape[:2]
+        return Tensor._from_array(patches.reshape(n, ckk, -1))
+
+
+class Fold(Layer):
+    """paddle.nn.Fold (col2im): inverse of Unfold — overlapping patches
+    sum back into the [N, C, H, W] image."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        pair = lambda v: tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+        self._out = pair(output_sizes)
+        self._ks = pair(kernel_sizes)
+        self._st = pair(strides)
+        self._pd = pair(paddings)
+        self._dl = pair(dilations)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        arr = x._array  # [N, C*kh*kw, L]
+        kh, kw = self._ks
+        oh, ow = self._out
+        ph, pw = self._pd
+        n, ckk, l = arr.shape
+        c = ckk // (kh * kw)
+        hh = oh + 2 * ph
+        ww = ow + 2 * pw
+        n_h = (hh - (self._dl[0] * (kh - 1) + 1)) // self._st[0] + 1
+        n_w = (ww - (self._dl[1] * (kw - 1) + 1)) // self._st[1] + 1
+        cols = arr.reshape(n, c, kh, kw, n_h, n_w)
+        out = jnp.zeros((n, c, hh, ww), arr.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                yi = i * self._dl[0]
+                xj = j * self._dl[1]
+                out = out.at[
+                    :, :,
+                    yi:yi + n_h * self._st[0]:self._st[0],
+                    xj:xj + n_w * self._st[1]:self._st[1],
+                ].add(cols[:, :, i, j])
+        out = out[:, :, ph:ph + oh, pw:pw + ow]
+        return Tensor._from_array(out)

@@ -198,3 +198,50 @@ def test_hooks():
     h.remove()
     layer(pt.ones([1, 2]))
     assert calls == [1]
+
+
+def test_distance_and_bilinear_layers():
+    rng = np.random.RandomState(0)
+    a = pt.to_tensor(rng.randn(4, 8).astype("float32"))
+    b = pt.to_tensor(rng.randn(4, 8).astype("float32"))
+    cs = nn.CosineSimilarity(axis=1)(a, b)
+    want = np.sum(a.numpy() * b.numpy(), 1) / (
+        np.linalg.norm(a.numpy(), axis=1) * np.linalg.norm(b.numpy(), axis=1)
+    )
+    np.testing.assert_allclose(np.asarray(cs.numpy()), want, rtol=1e-5)
+
+    pd = nn.PairwiseDistance(p=2.0)(a, b)
+    np.testing.assert_allclose(
+        np.asarray(pd.numpy()),
+        np.linalg.norm(a.numpy() - b.numpy() + 1e-6, axis=1), rtol=1e-5,
+    )
+
+    bl = nn.Bilinear(8, 8, 3)
+    out = bl(a, b)
+    assert list(out.shape) == [4, 3]
+    w = np.asarray(bl.weight.numpy())
+    want = np.einsum("bi,oij,bj->bo", a.numpy(), w, b.numpy()) + \
+        np.asarray(bl.bias.numpy())
+    np.testing.assert_allclose(np.asarray(out.numpy()), want, rtol=1e-4)
+
+
+def test_spectral_norm_layer():
+    rng = np.random.RandomState(1)
+    w = pt.to_tensor(rng.randn(6, 10).astype("float32"))
+    sn = nn.SpectralNorm([6, 10], power_iters=30)
+    wn = sn(w)
+    s = np.linalg.svd(np.asarray(wn.numpy()), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-2)
+
+
+def test_unfold_fold_roundtrip():
+    rng = np.random.RandomState(2)
+    x = pt.to_tensor(rng.randn(2, 3, 6, 6).astype("float32"))
+    unfold = nn.Unfold(kernel_sizes=2, strides=2)
+    cols = unfold(x)
+    assert list(cols.shape) == [2, 3 * 4, 9]
+    fold = nn.Fold(output_sizes=(6, 6), kernel_sizes=2, strides=2)
+    back = fold(cols)
+    # non-overlapping patches: fold(unfold(x)) == x
+    np.testing.assert_allclose(np.asarray(back.numpy()), x.numpy(),
+                               rtol=1e-6)
