@@ -95,28 +95,14 @@ def decrypt_file(key: bytes, in_path: str, out_path: str):
 
 def save_encrypted(obj, path: str, key: bytes):
     """paddle.save + at-rest encryption (the fleet encrypted-persistables
-    flow, framework/io/crypto + save_combine)."""
-    import io as _io
-
+    flow, framework/io/crypto + save_combine). Fully in-memory: the
+    plaintext checkpoint never touches disk."""
     from .framework import serialization
 
-    tmp = path + ".plain.tmp"
-    serialization.save(obj, tmp)
-    try:
-        with open(tmp, "rb") as f:
-            AESCipher(key).encrypt_to_file(f.read(), path)
-    finally:
-        os.remove(tmp)
+    AESCipher(key).encrypt_to_file(serialization.dumps(obj), path)
 
 
 def load_encrypted(path: str, key: bytes):
     from .framework import serialization
 
-    data = AESCipher(key).decrypt_from_file(path)
-    tmp = path + ".plain.tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    try:
-        return serialization.load(tmp)
-    finally:
-        os.remove(tmp)
+    return serialization.loads(AESCipher(key).decrypt_from_file(path))

@@ -44,9 +44,10 @@ class HeartbeatMonitor:
             os.utime(self._path(self.rank), None)
 
     def start(self):
-        """Background beats every ``interval`` seconds."""
+        """Background beats every ``interval`` seconds (restartable)."""
         if self._thread is not None:
             return self
+        self._stop.clear()  # a previous stop() must not kill the new thread
         self.beat()
 
         def loop():

@@ -443,6 +443,7 @@ class DistributedOptimizer:
                     ("gradient_merge", self._opts.get("grad_accum_steps", 1) > 1),
                     ("sharding", self._opts.get("zero1")),
                     ("localsgd", self._opts.get("localsgd")),
+                    ("amp", self._opts.get("amp")),
                 ) if on
             ]
             if unsupported:

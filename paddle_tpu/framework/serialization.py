@@ -40,6 +40,26 @@ def _to_host(obj):
     return obj
 
 
+def dumps(obj, protocol=4) -> bytes:
+    """Checkpoint bytes (magic + payload) without touching disk — the
+    buffer the encrypted-save path feeds straight into the cipher."""
+    host = _to_host(obj)
+    buf = _io.BytesIO()
+    buf.write(_MAGIC)
+    pickle.dump(host, buf, protocol=protocol)
+    return buf.getvalue()
+
+
+def loads(data: bytes, return_numpy=False):
+    """Inverse of dumps."""
+    if not data.startswith(_MAGIC):
+        raise ValueError(
+            f"not a paddle_tpu checkpoint (bad magic {data[:8]!r})"
+        )
+    obj = pickle.loads(data[len(_MAGIC):])
+    return obj if return_numpy else _to_tensor(obj)
+
+
 def save(obj, path, protocol=4):
     """Serialize a (nested) state dict / object to ``path``.
 
@@ -49,12 +69,8 @@ def save(obj, path, protocol=4):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    host = _to_host(obj)
-    buf = _io.BytesIO()
-    pickle.dump(host, buf, protocol=protocol)
     with open(path, "wb") as f:
-        f.write(_MAGIC)
-        f.write(buf.getvalue())
+        f.write(dumps(obj, protocol=protocol))
 
 
 def _to_tensor(obj):

@@ -652,19 +652,18 @@ class Unfold(Layer):
         self._dl = pair(dilations)
 
     def forward(self, x):
-        from jax import lax
-
-        arr = x._array
-        p = self._pd
         import jax.numpy as jnp
 
-        arr = jnp.pad(arr, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
-        patches = lax.conv_general_dilated_patches(
-            arr, self._ks, self._st, "VALID", rhs_dilation=self._dl,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        )  # [N, C*kh*kw, oh, ow]
-        n, ckk = patches.shape[:2]
-        return Tensor._from_array(patches.reshape(n, ckk, -1))
+        from ..ops.registry import kernel
+
+        # one im2col implementation: the im2sequence kernel (compat.py)
+        # produces [N, L, C*kh*kw]; Unfold's layout is the transpose
+        p = self._pd
+        rows = kernel("im2sequence")(
+            x._array, kernels=self._ks, strides=self._st,
+            paddings=(p[0], p[1], p[0], p[1]), dilations=self._dl,
+        )
+        return Tensor._from_array(jnp.swapaxes(rows, 1, 2))
 
 
 class Fold(Layer):

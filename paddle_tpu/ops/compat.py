@@ -396,10 +396,11 @@ def deformable_conv_v1(x, offset, weight, **kw):
 def depthwise_conv2d_transpose(x, w, *, stride=1, padding=0,
                                output_padding=0, dilation=1,
                                data_format="NCHW"):
+    channels = x.shape[1] if data_format == "NCHW" else x.shape[-1]
     return get_op("conv2d_transpose").fn(
         x, w, stride=stride, padding=padding,
         output_padding=output_padding, dilation=dilation,
-        groups=x.shape[1], data_format=data_format,
+        groups=channels, data_format=data_format,
     )
 
 
@@ -454,15 +455,18 @@ def gather_tree(ids, parents):
 
 
 @register_op("im2sequence")
-def im2sequence(x, *, kernels, strides=(1, 1), paddings=(0, 0, 0, 0)):
+def im2sequence(x, *, kernels, strides=(1, 1), paddings=(0, 0, 0, 0),
+                dilations=(1, 1)):
     """operators/im2sequence_op.cc on the dense design: [N,C,H,W] ->
-    [N, out_h*out_w, C*kh*kw] patch rows."""
+    [N, out_h*out_w, C*kh*kw] patch rows. Also the im2col core behind
+    nn.Unfold (paddings are (top, left, bottom, right))."""
     kh, kw = kernels
     n, c, h, w = x.shape
     ph0, pw0, ph1, pw1 = paddings
     xp = jnp.pad(x, ((0, 0), (0, 0), (ph0, ph1), (pw0, pw1)))
     patches = lax.conv_general_dilated_patches(
         xp, (kh, kw), tuple(strides), "VALID",
+        rhs_dilation=tuple(dilations),
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )  # [N, C*kh*kw, oh, ow]
     ckk = patches.shape[1]
@@ -506,8 +510,10 @@ def gru_unit(x, h_prev, weight, bias=None, *,
     weight [D, 3D] (update|reset | candidate). Returns (h, reset_h, gates)."""
     b, d3 = x.shape
     d = d3 // 3
-    act = getattr(jax.nn, activation if activation != "identity" else "relu")
-    gate = getattr(jax.nn, gate_activation)
+    act = ((lambda v: v) if activation == "identity"
+           else getattr(jax.nn, activation))
+    gate = ((lambda v: v) if gate_activation == "identity"
+            else getattr(jax.nn, gate_activation))
     xs = x + (bias if bias is not None else 0.0)
     g_uz = gate(xs[:, :2 * d] + h_prev @ weight[:, :2 * d])
     u, r = g_uz[:, :d], g_uz[:, d:]
