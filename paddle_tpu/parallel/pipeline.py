@@ -292,7 +292,7 @@ class PipelineParallel:
     """
 
     def __init__(self, stages, opt_factory, loss_fn, num_microbatches,
-                 mesh=None, axis="pp", schedule="1f1b"):
+                 mesh=None, axis="pp", schedule="1f1b", rules=None):
         from collections import OrderedDict
 
         from jax.sharding import Mesh, NamedSharding
@@ -333,10 +333,21 @@ class PipelineParallel:
             stage.train()
             opt_i = opt_factory(stage.parameters())
             st = fjit.init_opt_state(stage, opt_i)
-            repl = NamedSharding(self.submeshes[i], P())
-            st = jax.tree_util.tree_map(
-                lambda a: jax.device_put(a, repl), st
-            )
+            if rules is not None:
+                # tensor-parallel INSIDE each pipeline stage: the rule
+                # table partitions stage params over the submesh's tp/ep
+                # axes (pp × tp composition); unmatched params replicate
+                from .sharding import shard_state
+
+                shardings = shard_state(st, rules, self.submeshes[i])
+                st = jax.tree_util.tree_map(
+                    jax.device_put, st, shardings
+                )
+            else:
+                repl = NamedSharding(self.submeshes[i], P())
+                st = jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, repl), st
+                )
             self.opts.append(opt_i)
             self.states.append(st)
             is_last = i == self.S - 1
