@@ -30,3 +30,8 @@ from .mobilenet import (  # noqa: F401
     mobilenet_v2,
 )
 from .seq2seq import TransformerSeq2Seq  # noqa: F401
+from .se_resnext import (  # noqa: F401
+    SEResNeXt,
+    se_resnext50_32x4d,
+    se_resnext101_32x4d,
+)

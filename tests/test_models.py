@@ -168,3 +168,26 @@ def test_transformer_seq2seq_copy_task():
         [seqs[:, b, best[b]] for b in range(2)], axis=0
     )[:, :L]
     np.testing.assert_array_equal(beam_best, src)
+
+
+def test_se_resnext_forward_and_trains():
+    """dist_se_resnext.py fixture model: forward shape + one train step."""
+    from paddle_tpu.models import se_resnext50_32x4d
+
+    paddle.seed(0)
+    m = se_resnext50_32x4d(num_classes=10)
+    m.eval()
+    x = paddle.to_tensor(np.random.randn(2, 3, 64, 64).astype("float32"))
+    out = m(x)
+    assert list(out.shape) == [2, 10]
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+    o = opt.Momentum(learning_rate=0.01, parameters=m.parameters())
+    step = fjit.train_step(
+        m, o, lambda mm, xx, yy: F.cross_entropy(mm(xx), yy).mean()
+    )
+    X = np.random.randn(4, 3, 64, 64).astype("float32")
+    Y = np.random.randint(0, 10, (4,)).astype("int64")
+    l0 = float(np.asarray(step(X, Y)["loss"]))
+    l1 = float(np.asarray(step(X, Y)["loss"]))
+    assert np.isfinite(l0) and l1 < l0
