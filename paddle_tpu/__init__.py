@@ -193,12 +193,14 @@ from . import nn  # noqa: E402
 from . import optimizer  # noqa: E402
 from . import parallel  # noqa: E402
 from . import distributed  # noqa: E402
+from .distributed import DataParallel  # noqa: E402  (dygraph DP wrapper)
 from . import models  # noqa: E402
 from . import static  # noqa: E402
 from . import metric  # noqa: E402
 from . import inference  # noqa: E402
 from . import jit_api as jit  # noqa: E402  (paddle.jit.to_static/save/load)
 from .hapi import Model  # noqa: E402
+from .hapi.model import summary  # noqa: E402  (hapi/model_summary.py)
 from . import vision  # noqa: E402
 from . import profiler  # noqa: E402
 from . import distribution  # noqa: E402

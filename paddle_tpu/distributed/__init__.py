@@ -19,6 +19,7 @@ from .collective import (  # noqa: F401
     alltoall,
     new_group,
 )
+from .env import DataParallel  # noqa: F401
 from .env import (  # noqa: F401
     ParallelEnv,
     get_rank,

@@ -96,3 +96,41 @@ def init_parallel_env():
         )
     _initialized = True
     return env
+
+
+class DataParallel:
+    """paddle.DataParallel (fluid/dygraph/parallel.py:225) on the
+    single-controller runtime.
+
+    The reference wraps a Layer so each process all-reduces coalesced
+    gradients after backward (parallel.py:386 apply_collective_grads).
+    Here one process drives every local device and gradient averaging is
+    GSPMD's job inside the sharded step, so the wrapper forwards
+    transparently and scale_loss/apply_collective_grads keep the API as
+    no-ops with exact semantics (world averaging happens in-step).
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False):
+        self._layers = layers
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss  # the compiled step's global-mean loss already scales
+
+    def apply_collective_grads(self):
+        pass  # gradient sync is in-program (GSPMD), not a post-hoc pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

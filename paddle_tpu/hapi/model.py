@@ -303,3 +303,33 @@ def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
         data, batch_size=batch_size, shuffle=shuffle, drop_last=drop_last,
         num_workers=num_workers,
     )
+
+
+def summary(net, input_size=None, dtypes=None):
+    """paddle.summary (hapi/model_summary.py): layer table + param counts."""
+    import numpy as np_
+
+    rows = []
+    total, trainable = 0, 0
+    for name, layer in [("", net)] + list(net.named_sublayers()):
+        own = sum(
+            int(np_.prod(p.shape)) for p in layer._parameters.values()
+            if p is not None
+        )
+        if own or not name:
+            cls = type(layer).__name__
+            rows.append((name or cls, cls, own))
+    for _, p in net.named_parameters():
+        n = int(np_.prod(p.shape))
+        total += n
+        if getattr(p, "trainable", True):
+            trainable += n
+    lines = [f"{'Layer':40s} {'Type':24s} {'Params':>12s}"]
+    lines += [f"{n[:40]:40s} {c[:24]:24s} {p:12,d}" for n, c, p in rows]
+    lines.append("-" * 78)
+    lines.append(f"Total params: {total:,d}")
+    lines.append(f"Trainable params: {trainable:,d}")
+    lines.append(f"Non-trainable params: {total - trainable:,d}")
+    text = "\n".join(lines)
+    print(text)
+    return {"total_params": total, "trainable_params": trainable}

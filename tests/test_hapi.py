@@ -82,3 +82,22 @@ def test_fit_with_amp():
     )
     logs = model.fit(_dataset(), batch_size=16, epochs=3, verbose=0)
     assert np.isfinite(logs["loss"])
+
+
+def test_summary_counts_params():
+    net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    info = paddle.summary(net)
+    assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+    assert info["trainable_params"] == info["total_params"]
+
+
+def test_data_parallel_wrapper():
+    net = nn.Linear(4, 2)
+    dp = paddle.DataParallel(net)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = dp(x)
+    assert list(out.shape) == [2, 2]
+    loss = out.mean()
+    assert dp.scale_loss(loss) is loss
+    dp.apply_collective_grads()  # API no-op with in-step semantics
+    assert "weight" in dp.state_dict()
