@@ -1,0 +1,64 @@
+"""2-process collective-op fixture (reference:
+tests/unittests/test_collective_base.py:35 — 2-rank subprocess runs of
+single collective ops with rendezvous).
+
+Runs all_reduce / all_gather / reduce_scatter inside shard_map over the
+cross-process mesh and prints one JSON line of results.
+"""
+import json
+import os
+import sys
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+
+def main():
+    from paddle_tpu.distributed import fleet
+
+    fleet.fleet.init(is_collective=True)  # rendezvous first
+
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import distributed as dist
+    from paddle_tpu import parallel
+
+    n = len(jax.devices())
+    mesh = parallel.create_mesh(dp=n)
+
+    def body(x):
+        s = dist.all_reduce(x)                       # psum over dp
+        g = dist.all_gather(None, x)                 # [n, ...] stack
+        rs = dist.reduce_scatter(jnp.tile(x, (n,)))  # scatter the sum
+        return s, g, rs
+
+    sm = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=P("dp"), out_specs=(P(), P(), P("dp")),
+        check_vma=False,
+    )
+    # per-device distinct values: device i holds [i+1]
+    x = jnp.arange(1, n + 1, dtype=jnp.float32)
+    with parallel.mesh_scope(mesh):
+        s, g, rs = jax.jit(sm)(x)
+    # rs stays dp-sharded across processes: gather it for inspection
+    from jax.experimental import multihost_utils
+
+    rs_full = multihost_utils.process_allgather(rs, tiled=True)
+    print(json.dumps({
+        "rank": fleet.fleet.worker_index(),
+        "n": n,
+        "allreduce": float(np.asarray(s)[0]),
+        "allgather": np.asarray(g).reshape(-1).tolist(),
+        "reducescatter": np.asarray(rs_full).tolist(),
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -76,3 +76,52 @@ def test_two_process_dp_matches_single_process():
                                rtol=1e-5, atol=1e-6)
     # and training progressed
     assert dist[0]["losses"][-1] < dist[0]["losses"][0]
+
+
+FIXTURE_COLLECTIVE = os.path.join(REPO, "tests", "fixtures",
+                                  "dist_collective.py")
+
+
+def _run_fixture(path, nproc, devices_per_proc, timeout=240):
+    from paddle_tpu.distributed.launch import _build_env, _free_port
+
+    base = dict(os.environ)
+    base.pop("PYTEST_CURRENT_TEST", None)
+    base["JAX_PLATFORMS"] = "cpu"
+    base["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    base["JAX_ENABLE_X64"] = "true"
+    base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
+    coordinator = f"127.0.0.1:{_free_port()}"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, path],
+            env=_build_env(rank, nproc, coordinator, base),
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+        for rank in range(nproc)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, err[-4000:]
+        outs.append(json.loads(
+            [l for l in out.strip().splitlines() if l.startswith("{")][-1]
+        ))
+    return outs
+
+
+@pytest.mark.slow
+def test_two_process_collective_ops():
+    """test_collective_base.py parity: all_reduce/all_gather/
+    reduce_scatter across 2 real processes (2 devices each)."""
+    outs = _run_fixture(FIXTURE_COLLECTIVE, nproc=2, devices_per_proc=2)
+    n = outs[0]["n"]
+    assert n == 4
+    want_sum = float(sum(range(1, n + 1)))  # 1+2+3+4
+    for r in outs:
+        assert r["allreduce"] == want_sum
+        assert r["allgather"] == [1.0, 2.0, 3.0, 4.0]
+        # reduce_scatter of tile(x, n): every shard holds the sum
+        assert all(v == want_sum for v in r["reducescatter"])
