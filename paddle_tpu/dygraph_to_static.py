@@ -441,6 +441,65 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             for x in _prelude(carry) + [c_def, b_def, assign]
         ]
 
+    # -- for over range -----------------------------------------------------
+    def visit_For(self, node):
+        """``for i in range(...)`` desugars to the while form, which then
+        lowers through visit_While (loop_transformer.py's for→while)."""
+        self.generic_visit(node)
+        if (
+            node.orelse
+            or not isinstance(node.target, ast.Name)
+            or not isinstance(node.iter, ast.Call)
+            or not isinstance(node.iter.func, ast.Name)
+            or node.iter.func.id != "range"
+            or node.iter.keywords
+            or not 1 <= len(node.iter.args) <= 3
+            or any(
+                isinstance(s, (ast.Break, ast.Continue, ast.Return))
+                for stmt in node.body for s in ast.walk(stmt)
+            )
+        ):
+            return node
+        uid = self._uid()
+        args = node.iter.args
+        start = args[0] if len(args) >= 2 else ast.Constant(0)
+        stop = args[1] if len(args) >= 2 else args[0]
+        step = args[2] if len(args) == 3 else ast.Constant(1)
+        if len(args) == 3 and not (
+            isinstance(step, ast.Constant) and isinstance(step.value, int)
+            and step.value > 0
+        ):
+            return node  # negative/dynamic step: keep python semantics
+        it = f"_pt_for_{uid}"
+        init = ast.Assign(
+            targets=[ast.Name(id=it, ctx=ast.Store())], value=start
+        )
+        # pre-bind the loop target so it is a well-defined XLA loop carry
+        # (python would leave it unbound before the first iteration)
+        pre_bind = ast.Assign(
+            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+            value=ast.Name(id=it, ctx=ast.Load()),
+        )
+        test = ast.Compare(
+            left=ast.Name(id=it, ctx=ast.Load()), ops=[ast.Lt()],
+            comparators=[stop],
+        )
+        bind = ast.Assign(
+            targets=[node.target], value=ast.Name(id=it, ctx=ast.Load())
+        )
+        bump = ast.AugAssign(
+            target=ast.Name(id=it, ctx=ast.Store()), op=ast.Add(),
+            value=step,
+        )
+        loop = ast.While(test=test, body=[bind] + node.body + [bump],
+                         orelse=[])
+        out = [ast.copy_location(x, node) for x in (init, pre_bind, loop)]
+        lowered = self.visit_While(out[2])
+        lowered = lowered if isinstance(lowered, list) else [lowered]
+        return out[:2] + [
+            ast.copy_location(x, node) for x in lowered
+        ]
+
     # -- and/or/not ---------------------------------------------------------
     def visit_BoolOp(self, node):
         self.generic_visit(node)

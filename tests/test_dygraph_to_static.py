@@ -259,3 +259,45 @@ def test_loop_var_unbound_before_loop_python_path():
 
     tfn = convert_to_static(fn)
     assert tfn(3) == 3
+
+
+def test_for_range_transform():
+    """for i in range(...) desugars to the while form (loop_transformer
+    for→while) — python semantics preserved, carry vars survive."""
+    def fn(x, n):
+        acc = x
+        for i in range(n):
+            acc = acc + x * (i + 1)
+        return acc
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.asarray(1.0, np.float32)), 3)
+    # 1 + 1 + 2 + 3 = 7
+    assert float(np.asarray(out.numpy())) == 7.0
+
+    def fn2(x):
+        s = 0
+        for i in range(2, 8, 2):
+            s = s + i
+        return s + int(np.asarray(x.numpy()) * 0)
+
+    tfn2 = convert_to_static(fn2)
+    assert tfn2(paddle.to_tensor(np.asarray(1.0))) == 12
+
+
+def test_for_range_with_traced_bound():
+    """Loop bound that is a traced value lowers to lax.while_loop."""
+    def fn(x, n):
+        acc = x
+        for i in range(n):
+            acc = acc * 2
+        return acc
+
+    tfn = convert_to_static(fn)
+
+    @jax.jit
+    def jf(a, n):
+        return tfn(Tensor._from_array(a), n)._array
+
+    out = jf(jnp.asarray(1.0), jnp.asarray(4, jnp.int32))
+    assert float(out) == 16.0
