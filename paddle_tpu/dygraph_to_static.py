@@ -471,14 +471,33 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         ):
             return node  # negative/dynamic step: keep python semantics
         it = f"_pt_for_{uid}"
+        stop_name = f"_pt_stop_{uid}"
         init = ast.Assign(
             targets=[ast.Name(id=it, ctx=ast.Store())], value=start
         )
-        # pre-bind the loop target so it is a well-defined XLA loop carry
-        # (python would leave it unbound before the first iteration)
-        pre_bind = ast.Assign(
-            targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
-            value=ast.Name(id=it, ctx=ast.Load()),
+        # snapshot the bound: python evaluates range() args exactly once,
+        # so a body that mutates the bound variable must not change the
+        # trip count
+        init_stop = ast.Assign(
+            targets=[ast.Name(id=stop_name, ctx=ast.Store())], value=stop
+        )
+        stop = ast.Name(id=stop_name, ctx=ast.Load())
+        # pre-bind the loop target ONLY if currently unbound (an empty
+        # range must not clobber a prior value) — it then is a
+        # well-defined XLA loop carry
+        pre_bind = ast.Try(
+            body=[ast.Assign(
+                targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+                value=ast.Name(id=node.target.id, ctx=ast.Load()),
+            )],
+            handlers=[ast.ExceptHandler(
+                type=ast.Name(id="NameError", ctx=ast.Load()), name=None,
+                body=[ast.Assign(
+                    targets=[ast.Name(id=node.target.id, ctx=ast.Store())],
+                    value=ast.Name(id=it, ctx=ast.Load()),
+                )],
+            )],
+            orelse=[], finalbody=[],
         )
         test = ast.Compare(
             left=ast.Name(id=it, ctx=ast.Load()), ops=[ast.Lt()],
@@ -493,10 +512,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         )
         loop = ast.While(test=test, body=[bind] + node.body + [bump],
                          orelse=[])
-        out = [ast.copy_location(x, node) for x in (init, pre_bind, loop)]
-        lowered = self.visit_While(out[2])
+        out = [ast.copy_location(x, node)
+               for x in (init, init_stop, pre_bind, loop)]
+        lowered = self.visit_While(out[3])
         lowered = lowered if isinstance(lowered, list) else [lowered]
-        return out[:2] + [
+        return out[:3] + [
             ast.copy_location(x, node) for x in lowered
         ]
 

@@ -18,7 +18,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURE = os.path.join(REPO, "tests", "fixtures", "dist_dp_trainer.py")
 
 
-def _run_world(nproc: int, devices_per_proc: int, timeout=240):
+def _run_world(nproc: int, devices_per_proc: int, timeout=240,
+               fixture=FIXTURE):
     """Launch the fixture in an nproc world; returns list of result dicts."""
     from paddle_tpu.distributed.launch import _build_env, _free_port
 
@@ -37,7 +38,7 @@ def _run_world(nproc: int, devices_per_proc: int, timeout=240):
         env = _build_env(rank, nproc, coordinator, base)
         procs.append(
             subprocess.Popen(
-                [sys.executable, FIXTURE],
+                [sys.executable, fixture],
                 env=env,
                 stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE,
@@ -82,41 +83,12 @@ FIXTURE_COLLECTIVE = os.path.join(REPO, "tests", "fixtures",
                                   "dist_collective.py")
 
 
-def _run_fixture(path, nproc, devices_per_proc, timeout=240):
-    from paddle_tpu.distributed.launch import _build_env, _free_port
-
-    base = dict(os.environ)
-    base.pop("PYTEST_CURRENT_TEST", None)
-    base["JAX_PLATFORMS"] = "cpu"
-    base["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={devices_per_proc}"
-    )
-    base["JAX_ENABLE_X64"] = "true"
-    base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
-    coordinator = f"127.0.0.1:{_free_port()}"
-    procs = [
-        subprocess.Popen(
-            [sys.executable, path],
-            env=_build_env(rank, nproc, coordinator, base),
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        )
-        for rank in range(nproc)
-    ]
-    outs = []
-    for p in procs:
-        out, err = p.communicate(timeout=timeout)
-        assert p.returncode == 0, err[-4000:]
-        outs.append(json.loads(
-            [l for l in out.strip().splitlines() if l.startswith("{")][-1]
-        ))
-    return outs
-
-
 @pytest.mark.slow
 def test_two_process_collective_ops():
     """test_collective_base.py parity: all_reduce/all_gather/
     reduce_scatter across 2 real processes (2 devices each)."""
-    outs = _run_fixture(FIXTURE_COLLECTIVE, nproc=2, devices_per_proc=2)
+    outs = _run_world(nproc=2, devices_per_proc=2,
+                      fixture=FIXTURE_COLLECTIVE)
     n = outs[0]["n"]
     assert n == 4
     want_sum = float(sum(range(1, n + 1)))  # 1+2+3+4

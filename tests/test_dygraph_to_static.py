@@ -301,3 +301,24 @@ def test_for_range_with_traced_bound():
 
     out = jf(jnp.asarray(1.0), jnp.asarray(4, jnp.int32))
     assert float(out) == 16.0
+
+
+def test_for_range_python_edge_semantics():
+    """Review-pinned edge cases: mutated bound doesn't change trip count;
+    empty range doesn't clobber a prior target binding."""
+    def fn(n):
+        c = 0
+        for i in range(n):
+            n = 0  # python evaluated range(n) once: still 5 iterations
+            c = c + 1
+        return c
+
+    assert convert_to_static(fn)(5) == 5
+
+    def fn2():
+        i = 10
+        for i in range(0):
+            pass
+        return i
+
+    assert convert_to_static(fn2)() == 10
