@@ -1,0 +1,47 @@
+"""Train → save_inference_model → optimized Predictor → C API.
+
+Run: JAX_PLATFORMS=cpu python examples/inference_deploy.py
+"""
+import numpy as np
+
+import paddle_tpu.static as static
+from paddle_tpu import ops
+from paddle_tpu.inference import Config, create_predictor
+
+static.enable_static()
+x = static.data("x", [None, 8], "float32")
+y = static.data("y", [None, 1], "float32")
+h = static.nn.fc(x, 16, activation="relu")
+pred = static.nn.fc(h, 1)
+loss = ops.mean(ops.square(ops.subtract(pred, y)))
+test_prog = static.default_main_program().clone(for_test=True)
+static.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+exe = static.Executor()
+exe.run_startup()
+rng = np.random.RandomState(0)
+X = rng.randn(256, 8).astype("float32")
+W = rng.randn(8, 1).astype("float32")
+Y = X @ W
+for i in range(100):
+    l = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])[0]
+print("final train loss", float(l))
+
+static.save_inference_model("/tmp/lin_model", ["x"], [pred], exe)
+static.disable_static()
+static.reset_default_programs()
+static.global_scope().clear()
+
+cfg = Config("/tmp/lin_model")      # switch_ir_optim on by default:
+pred_ = create_predictor(cfg)       # const-fold + DCE run at load
+print("pass stats:", pred_.pass_stats)
+h_in = pred_.get_input_handle("x")
+h_in.copy_from_cpu(X[:4])
+pred_.run()
+out = pred_.get_output_handle(pred_.get_output_names()[0]).copy_to_cpu()
+print("predictions:", out.ravel(), "targets:", Y[:4].ravel())
+
+# the C API builds libpaddle_tpu_capi.so for non-Python hosts:
+from paddle_tpu._native.capi import build_capi
+
+print("C API library:", build_capi())

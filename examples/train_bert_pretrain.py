@@ -1,0 +1,81 @@
+"""BERT MLM pretraining — single chip or sharded mesh.
+
+Usage:
+    python examples/train_bert_pretrain.py              # single device
+    python examples/train_bert_pretrain.py --dp 2 --tp 4  # 8-chip mesh
+
+On CPU dev boxes: JAX_PLATFORMS=cpu
+XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh.
+"""
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp, parallel
+from paddle_tpu.framework import jit as fjit
+from paddle_tpu.models import (
+    BertConfig,
+    BertForPretraining,
+    BertPretrainingCriterion,
+    bert_sharding_rules,
+    bert_tiny_config,
+)
+
+
+def synthetic_batch(cfg, batch, seq, n_pred, rng):
+    ids = rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64")
+    tt = rng.randint(0, 2, (batch, seq)).astype("int64")
+    pos = np.stack(
+        [rng.choice(seq, n_pred, replace=False) + i * seq
+         for i in range(batch)]
+    ).ravel().astype("int64")
+    mlm = rng.randint(0, cfg.vocab_size, (batch * n_pred,)).astype("int64")
+    nsp = rng.randint(0, 2, (batch, 1)).astype("int64")
+    return ids, tt, pos, mlm, nsp
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dp", type=int, default=0, help="data-parallel degree")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--tiny", action="store_true", default=True)
+    ns = ap.parse_args()
+
+    cfg = bert_tiny_config() if ns.tiny else BertConfig()
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+
+    def loss_fn(m, ids, tt, pos, mlm, nsp):
+        with amp.auto_cast():  # bf16 on the MXU
+            pred, rel = m(ids, tt, masked_positions=pos)
+        return crit(pred.astype("float32"), rel.astype("float32"), mlm, nsp)
+
+    if ns.dp or ns.tp > 1:
+        mesh = parallel.create_mesh(dp=ns.dp or 1, tp=ns.tp)
+        step = parallel.sharded_train_step(
+            model, optimizer, loss_fn, mesh, rules=bert_sharding_rules()
+        )
+    else:
+        step = fjit.train_step(model, optimizer, loss_fn)
+
+    rng = np.random.RandomState(0)
+    for i in range(ns.steps):
+        batch = synthetic_batch(cfg, ns.batch, ns.seq, 8, rng)
+        loss = float(np.asarray(step(*batch)["loss"]))
+        if i % 5 == 0:
+            print(f"step {i:4d}  loss {loss:.4f}")
+    step.sync()  # device state -> eager model (for save/eval)
+    paddle.save(model.state_dict(), "/tmp/bert_example.pdparams")
+    print("saved /tmp/bert_example.pdparams")
+
+
+if __name__ == "__main__":
+    main()
