@@ -50,7 +50,7 @@ def bench_resnet50(on_tpu):
     from paddle_tpu.models import resnet50, resnet18
 
     if on_tpu:
-        batch, size, iters, make = 128, 224, 8, resnet50
+        batch, size, iters, make = 128, 224, 20, resnet50
         name = "resnet50_images_per_sec_per_chip"
     else:  # CPU smoke: tiny net, tiny images
         batch, size, iters, make = 8, 32, 2, resnet18
@@ -118,7 +118,7 @@ def main():
     # completes quickly in dev environments.
     if on_tpu:
         cfg = BertConfig()  # base: 12L/768H
-        batch, seq, iters = 128, 128, 10
+        batch, seq, iters = 128, 128, 30  # more iters: tunnel-noise smoothing
     else:
         cfg = BertConfig(
             vocab_size=8192, hidden_size=256, num_hidden_layers=4,

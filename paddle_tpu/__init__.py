@@ -229,5 +229,6 @@ from .ops import (  # noqa: E402,F401  (2.0 tail additions, flat aliases)
     standard_normal,
     stanh,
 )
+from . import utils  # noqa: E402  (run_check, gated download)
 from . import flags as _flags_mod  # noqa: E402
 from .flags import get_flags, set_flags  # noqa: E402  (core.globals() API)

@@ -1,0 +1,4 @@
+"""Utilities (reference: python/paddle/utils/ — install_check.py,
+download.py)."""
+from .install_check import run_check  # noqa: F401
+from . import download  # noqa: F401

@@ -1,0 +1,27 @@
+"""paddle.utils.download (python/paddle/utils/download.py).
+
+This build targets air-gapped TPU environments (zero network egress):
+``get_weights_path_from_url`` resolves already-downloaded files from the
+cache directory and raises a clear error instead of fetching.
+"""
+from __future__ import annotations
+
+import os
+
+WEIGHTS_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_WEIGHTS_HOME", "~/.cache/paddle_tpu/weights")
+)
+
+
+def get_weights_path_from_url(url: str, md5sum=None) -> str:
+    fname = url.split("/")[-1].split("?")[0]
+    path = os.path.join(WEIGHTS_HOME, fname)
+    if os.path.exists(path):
+        return path
+    from ..errors import UnavailableError
+
+    raise UnavailableError(
+        f"cannot download {url!r}: this runtime has no network egress. "
+        f"Place the file at {path} (WEIGHTS_HOME={WEIGHTS_HOME}, override "
+        "with PADDLE_TPU_WEIGHTS_HOME) and retry."
+    )

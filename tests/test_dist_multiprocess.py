@@ -97,3 +97,23 @@ def test_two_process_collective_ops():
         assert r["allgather"] == [1.0, 2.0, 3.0, 4.0]
         # reduce_scatter of tile(x, n): every shard holds the sum
         assert all(v == want_sum for v in r["reducescatter"])
+
+
+@pytest.mark.slow
+def test_launch_cli_main():
+    """python -m paddle_tpu.distributed.launch --nproc 2 <fixture> — the
+    reference launch.py CLI contract."""
+    base = dict(os.environ)
+    base.pop("PYTEST_CURRENT_TEST", None)
+    base["JAX_PLATFORMS"] = "cpu"
+    base["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc", "2", FIXTURE],
+        env=base, capture_output=True, text=True, timeout=240,
+    )
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    ranks = sorted(json.loads(l)["rank"] for l in lines)
+    assert ranks == [0, 1]

@@ -1,4 +1,5 @@
 """hapi Model.fit/evaluate/predict tests (incubate/hapi/tests patterns)."""
+import pytest
 import numpy as np
 
 import paddle_tpu as paddle
@@ -101,3 +102,22 @@ def test_data_parallel_wrapper():
     assert dp.scale_loss(loss) is loss
     dp.apply_collective_grads()  # API no-op with in-step semantics
     assert "weight" in dp.state_dict()
+
+
+def test_run_check(capsys):
+    paddle.utils.run_check()
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+    assert "sharded train step: OK" in out  # 8-device virtual mesh
+
+
+def test_download_gated(tmp_path, monkeypatch):
+    from paddle_tpu.errors import UnavailableError
+    from paddle_tpu.utils import download
+
+    monkeypatch.setattr(download, "WEIGHTS_HOME", str(tmp_path))
+    with pytest.raises(UnavailableError, match="no network egress"):
+        download.get_weights_path_from_url("http://x/y/model.pdparams")
+    (tmp_path / "model.pdparams").write_bytes(b"x")
+    p = download.get_weights_path_from_url("http://x/y/model.pdparams")
+    assert p.endswith("model.pdparams")
