@@ -43,10 +43,11 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=16)
     ap.add_argument("--seq", type=int, default=64)
-    ap.add_argument("--tiny", action="store_true", default=True)
+    ap.add_argument("--full", action="store_true",
+                    help="BERT-base 12L/768H (default: tiny test config)")
     ns = ap.parse_args()
 
-    cfg = bert_tiny_config() if ns.tiny else BertConfig()
+    cfg = BertConfig() if ns.full else bert_tiny_config()
     paddle.seed(0)
     model = BertForPretraining(cfg)
     crit = BertPretrainingCriterion(cfg.vocab_size)

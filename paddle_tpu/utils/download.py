@@ -8,20 +8,35 @@ from __future__ import annotations
 
 import os
 
-WEIGHTS_HOME = os.path.expanduser(
-    os.environ.get("PADDLE_TPU_WEIGHTS_HOME", "~/.cache/paddle_tpu/weights")
-)
+def _weights_home() -> str:
+    # resolved per call: the error message tells the user to set the env
+    # var and retry, which must work within the same process
+    return os.path.expanduser(
+        os.environ.get("PADDLE_TPU_WEIGHTS_HOME",
+                       "~/.cache/paddle_tpu/weights")
+    )
 
 
 def get_weights_path_from_url(url: str, md5sum=None) -> str:
-    fname = url.split("/")[-1].split("?")[0]
-    path = os.path.join(WEIGHTS_HOME, fname)
-    if os.path.exists(path):
-        return path
-    from ..errors import UnavailableError
+    from ..errors import PreconditionNotMetError, UnavailableError
 
+    home = _weights_home()
+    fname = url.split("/")[-1].split("?")[0]
+    path = os.path.join(home, fname)
+    if os.path.exists(path):
+        if md5sum is not None:
+            import hashlib
+
+            with open(path, "rb") as f:
+                got = hashlib.md5(f.read()).hexdigest()
+            if got != md5sum:
+                raise PreconditionNotMetError(
+                    f"{path} exists but its md5 {got} != expected "
+                    f"{md5sum} (corrupt or truncated copy?)"
+                )
+        return path
     raise UnavailableError(
         f"cannot download {url!r}: this runtime has no network egress. "
-        f"Place the file at {path} (WEIGHTS_HOME={WEIGHTS_HOME}, override "
+        f"Place the file at {path} (WEIGHTS_HOME={home}, override "
         "with PADDLE_TPU_WEIGHTS_HOME) and retry."
     )

@@ -27,11 +27,16 @@ def run_check():
         lambda m, x, y: F.cross_entropy(m(x), y).mean(),
     )
     rng = np.random.RandomState(0)
-    x = rng.randn(16, 8).astype("float32")
-    y = rng.randint(0, 2, (16,)).astype("int64")
+    batch = max(16, 2 * len(devices))  # dp-shardable on any device count
+    x = rng.randn(batch, 8).astype("float32")
+    y = rng.randint(0, 2, (batch,)).astype("int64")
     l0 = float(np.asarray(step(x, y)["loss"]))
     l1 = float(np.asarray(step(x, y)["loss"]))
-    assert np.isfinite(l0) and l1 < l0, (l0, l1)
+    if not (np.isfinite(l0) and l1 < l0):
+        raise RuntimeError(
+            f"compiled train step did not reduce the loss "
+            f"({l0} -> {l1}); the installation is broken"
+        )
     print("single-device compiled train step: OK")
 
     if len(devices) > 1:
@@ -47,7 +52,13 @@ def run_check():
             lambda m, xx, yy: F.cross_entropy(m(xx), yy).mean(), mesh,
         )
         sl = float(np.asarray(sstep(x, y)["loss"]))
-        assert abs(sl - l0) < 1e-4, (sl, l0)
+        # relative tolerance: bf16 MXU math + a different cross-replica
+        # reduction order shift the value slightly on real TPUs
+        if abs(sl - l0) > 5e-3 * max(abs(l0), 1e-6):
+            raise RuntimeError(
+                f"sharded-step loss {sl} diverges from single-device "
+                f"loss {l0}; the multi-device path is broken"
+            )
         print(f"{len(devices)}-device sharded train step: OK "
               "(matches single-device loss)")
     print("paddle_tpu is installed successfully!")
