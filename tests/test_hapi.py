@@ -115,7 +115,7 @@ def test_download_gated(tmp_path, monkeypatch):
     from paddle_tpu.errors import UnavailableError
     from paddle_tpu.utils import download
 
-    monkeypatch.setattr(download, "WEIGHTS_HOME", str(tmp_path))
+    monkeypatch.setenv("PADDLE_TPU_WEIGHTS_HOME", str(tmp_path))
     with pytest.raises(UnavailableError, match="no network egress"):
         download.get_weights_path_from_url("http://x/y/model.pdparams")
     (tmp_path / "model.pdparams").write_bytes(b"x")
