@@ -121,3 +121,18 @@ def test_download_gated(tmp_path, monkeypatch):
     (tmp_path / "model.pdparams").write_bytes(b"x")
     p = download.get_weights_path_from_url("http://x/y/model.pdparams")
     assert p.endswith("model.pdparams")
+
+
+def test_download_md5_verification(tmp_path, monkeypatch):
+    import hashlib
+
+    from paddle_tpu.errors import PreconditionNotMetError
+    from paddle_tpu.utils import download
+
+    monkeypatch.setenv("PADDLE_TPU_WEIGHTS_HOME", str(tmp_path))
+    (tmp_path / "w.bin").write_bytes(b"good")
+    ok = hashlib.md5(b"good").hexdigest()
+    assert download.get_weights_path_from_url("http://x/w.bin", md5sum=ok)
+    with pytest.raises(PreconditionNotMetError, match="md5"):
+        download.get_weights_path_from_url("http://x/w.bin",
+                                           md5sum="0" * 32)
