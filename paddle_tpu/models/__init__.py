@@ -16,6 +16,10 @@ from .bert import (  # noqa: F401
     bert_tiny_config,
     bert_sharding_rules,
     bert_pipeline_stages,
+    ernie_base_config,
+    ErnieModel,
+    ErnieForPretraining,
+    knowledge_masking,
     BertEmbeddingStage,
     BertEncoderStage,
     BertHeadStage,

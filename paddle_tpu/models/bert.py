@@ -400,3 +400,65 @@ def bert_sharding_rules() -> ShardingRules:
         (r"\.linear2\.weight$", P("tp", None)),
         (r"word_embeddings\.weight$", P("tp", None)),
     ])
+
+
+# -- ERNIE (BASELINE.md row 4) ------------------------------------------------
+
+
+def ernie_base_config() -> BertConfig:
+    """ERNIE 1.0 base hyperparameters. Architecturally ERNIE 1.0 IS the
+    BERT encoder (12L/768H/12 heads, relu activation in the original
+    release) — what differs is the pretraining DATA strategy
+    (entity/phrase-level knowledge masking), which lives in the input
+    pipeline, not the model graph."""
+    return BertConfig(hidden_act="relu", vocab_size=18000)
+
+
+class ErnieModel(BertModel):
+    """ERNIE 1.0 encoder = BertModel with the ERNIE config defaults."""
+
+    def __init__(self, cfg: BertConfig | None = None, **kwargs):
+        super().__init__(cfg or ernie_base_config(), **kwargs)
+
+
+class ErnieForPretraining(BertForPretraining):
+    """MLM(+NSP) pretraining head over ErnieModel; pair with
+    knowledge_masking() for the ERNIE masking recipe."""
+
+    def __init__(self, cfg: BertConfig | None = None, **kwargs):
+        super().__init__(cfg or ernie_base_config(), **kwargs)
+
+
+def knowledge_masking(ids, spans, mask_id, key, mask_prob=0.15):
+    """ERNIE's entity/phrase-level masking: whole spans are masked
+    together (vs BERT's independent subword masking).
+
+    ids [B, L] int; spans [B, L] int span-ids (tokens sharing a span id
+    belong to one entity/phrase; 0 = single-token span). Returns
+    (masked_ids, mask_positions_bool [B, L]).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    b, l = ids.shape
+    # decide per SPAN, then broadcast the decision to every member token
+    span_key = jnp.where(spans > 0, spans, l + jnp.arange(l)[None, :])
+    draw = jax.random.uniform(key, (b, l))
+    # a span is masked iff its FIRST token drew < mask_prob
+    first = jnp.concatenate(
+        [jnp.ones((b, 1), bool), span_key[:, 1:] != span_key[:, :-1]],
+        axis=1,
+    )
+    span_draw = jnp.where(first, draw, 1.0)
+    # propagate the span head's decision rightward across the span
+    def scan_fn(carry, xs):
+        is_first, d = xs
+        m = jnp.where(is_first, d < mask_prob, carry)
+        return m, m
+
+    _, masked_t = jax.lax.scan(
+        scan_fn, jnp.zeros(b, bool),
+        (first.T, draw.T),
+    )
+    mask = masked_t.T
+    return jnp.where(mask, mask_id, ids), mask

@@ -655,3 +655,68 @@ def nce(x, weight, bias, label, sample_ids, *, num_total_classes,
     pos_loss = -jax.nn.log_sigmoid(true_logit)
     neg_loss = -jnp.sum(jax.nn.log_sigmoid(-neg_logit), axis=1)
     return (pos_loss + neg_loss)[:, None]
+
+
+@register_op("sample_logits", num_outputs=4)
+def sample_logits(logits, labels, *, key, num_samples, use_customized_samples=False,
+                  customized_samples=None, customized_probabilities=None,
+                  remove_accidental_hits=True, seed=0):
+    """operators/sample_logits_op.cc: sampled-softmax preparation — keep
+    the true-label logits plus ``num_samples`` uniformly sampled negative
+    classes, with log-probability correction and accidental-hit removal.
+
+    logits [B, C]; labels [B, T] (T true labels per row). Returns
+    (samples [B, T+S], probabilities [B, T+S], sampled_logits [B, T+S],
+    sampled_labels [B, T]) — labels remapped to positions 0..T-1, the
+    fixed-size contract the reference's LoD-free path uses.
+    """
+    b, c = logits.shape
+    t = labels.shape[1]
+    s = int(num_samples)
+    if use_customized_samples:
+        samples = customized_samples
+        probs = customized_probabilities
+    else:
+        neg = jax.random.randint(key, (b, s), 0, c)
+        samples = jnp.concatenate([labels.astype(neg.dtype), neg], axis=1)
+        # uniform proposal: q = 1/C for every sampled class
+        probs = jnp.full((b, t + s), 1.0 / c, logits.dtype)
+    picked = jnp.take_along_axis(logits, samples, axis=1)
+    # subtract log(q) (sampled-softmax correction)
+    sampled_logits = picked - jnp.log(jnp.maximum(probs, 1e-20))
+    if remove_accidental_hits:
+        # a negative that equals a true label would double-count: mask it
+        hit = jnp.any(
+            samples[:, None, t:] == labels[:, :, None], axis=1
+        )  # [B, S]
+        mask = jnp.concatenate(
+            [jnp.zeros((b, t), bool), hit], axis=1
+        )
+        sampled_logits = jnp.where(mask, sampled_logits - 1e20,
+                                   sampled_logits)
+    sampled_labels = jnp.broadcast_to(jnp.arange(t), (b, t))
+    return samples, probs, sampled_logits, sampled_labels
+
+
+@register_op("filter_by_instag", num_outputs=3, eager_only=True)
+def filter_by_instag(x, instags, filter_tags, *, is_lod=True,
+                     out_val_if_empty=0.0):
+    """operators/filter_by_instag_op.cc: keep rows whose instance tags
+    intersect the filter set. Output row count is data-dependent —
+    eager-only (same contract as masked_select). Returns
+    (out, loss_weight [kept, 1], kept_index)."""
+    xs = np.asarray(x)
+    tags = np.asarray(instags)
+    fset = set(np.asarray(filter_tags).reshape(-1).tolist())
+    keep = np.array([
+        bool(fset.intersection(row.reshape(-1).tolist()))
+        for row in tags
+    ])
+    idx = np.nonzero(keep)[0]
+    if idx.size == 0:
+        out = np.full((1,) + xs.shape[1:], out_val_if_empty, xs.dtype)
+        return (jnp.asarray(out), jnp.zeros((1, 1), xs.dtype),
+                jnp.asarray(np.zeros(1, np.int64)))
+    return (jnp.asarray(xs[idx]),
+            jnp.ones((idx.size, 1), xs.dtype),
+            jnp.asarray(idx.astype(np.int64)))

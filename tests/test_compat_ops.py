@@ -285,3 +285,43 @@ def test_fsp_and_cvm_and_batch_fc():
     np.testing.assert_allclose(
         np.asarray(bf[0]), np.asarray(bx[0]) @ np.asarray(bw[0]), rtol=1e-5
     )
+
+
+def test_sample_logits():
+    rng = np.random.RandomState(0)
+    b, c, t, s = 4, 20, 1, 6
+    logits = jnp.asarray(rng.randn(b, c).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, c, (b, t)))
+    samples, probs, slog, slab = kernel("sample_logits")(
+        logits, labels, key=jax.random.PRNGKey(0), num_samples=s,
+    )
+    assert samples.shape == (b, t + s)
+    assert slog.shape == (b, t + s)
+    np.testing.assert_array_equal(np.asarray(slab), np.zeros((b, t)))
+    # true-label column holds logit - log(1/C)
+    want = np.take_along_axis(
+        np.asarray(logits), np.asarray(labels), axis=1
+    ) + np.log(c)
+    np.testing.assert_allclose(np.asarray(slog[:, :t]), want, rtol=1e-5)
+    # accidental hits are masked far below the true logits
+    samples_np = np.asarray(samples)
+    hits = samples_np[:, t:] == np.asarray(labels)
+    assert (np.asarray(slog[:, t:])[hits] < -1e19).all() or not hits.any()
+
+
+def test_filter_by_instag():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    instags = np.array([[1], [2], [3], [2]], np.int64)
+    out, w, idx = kernel("filter_by_instag")(
+        jnp.asarray(x), jnp.asarray(instags), jnp.asarray([2]),
+    )
+    np.testing.assert_array_equal(np.asarray(idx), [1, 3])
+    np.testing.assert_allclose(np.asarray(out), x[[1, 3]])
+    np.testing.assert_allclose(np.asarray(w), 1.0)
+    # empty result contract
+    out2, w2, _ = kernel("filter_by_instag")(
+        jnp.asarray(x), jnp.asarray(instags), jnp.asarray([99]),
+        out_val_if_empty=7.0,
+    )
+    np.testing.assert_allclose(np.asarray(out2), 7.0)
+    np.testing.assert_allclose(np.asarray(w2), 0.0)

@@ -191,3 +191,41 @@ def test_se_resnext_forward_and_trains():
     l0 = float(np.asarray(step(X, Y)["loss"]))
     l1 = float(np.asarray(step(X, Y)["loss"]))
     assert np.isfinite(l0) and l1 < l0
+
+
+def test_ernie_model_and_knowledge_masking():
+    """ERNIE = BERT encoder + knowledge-masking recipe (whole spans
+    masked together)."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.models import ErnieForPretraining, knowledge_masking
+    from paddle_tpu.models.bert import BertConfig
+
+    cfg = BertConfig(vocab_size=512, hidden_size=64, num_hidden_layers=1,
+                     num_attention_heads=2, intermediate_size=128,
+                     max_position_embeddings=64, hidden_act="relu")
+    paddle.seed(0)
+    m = ErnieForPretraining(cfg)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(1, 512, (2, 16)).astype("int64"))
+    pred, rel = m(ids)
+    assert list(pred.shape) == [2, 16, 512]
+
+    # span masking: members of one span share the mask decision
+    ids_np = jnp.asarray(rng.randint(5, 512, (4, 12)))
+    spans = jnp.asarray(np.array(
+        [[1, 1, 1, 0, 0, 2, 2, 0, 0, 3, 3, 3]] * 4
+    ))
+    masked, mask = knowledge_masking(
+        ids_np, spans, mask_id=3, key=jax.random.PRNGKey(1),
+        mask_prob=0.5,
+    )
+    mask = np.asarray(mask)
+    for row in mask:
+        assert row[0] == row[1] == row[2]      # span 1 together
+        assert row[5] == row[6]                # span 2 together
+        assert row[9] == row[10] == row[11]    # span 3 together
+    assert mask.any()  # p=0.5 over many spans: some masked
+    got = np.asarray(masked)
+    assert (got[mask] == 3).all()
