@@ -203,3 +203,34 @@ def test_ptq_static_program(tmp_path):
     prog2, feeds, fetches = static.load_inference_model(path, exe)
     got2 = exe.run(prog2, feed={"x": Xtest}, fetch_list=fetches)[0]
     np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
+
+
+def test_qat_conv2d_path():
+    """QuantizedConv2D: per-output-channel weight scales + training."""
+    import paddle_tpu.nn as pnn
+
+    class ConvNet(pnn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = pnn.Conv2D(3, 8, 3, padding=1)
+            self.fc = pnn.Linear(8 * 4 * 4, 4)
+
+        def forward(self, x):
+            h = F.relu(self.conv(x))
+            return self.fc(ops.reshape(h, [x.shape[0], -1]))
+
+    paddle.seed(0)
+    m = ConvNet()
+    slim.ImperativeQuantAware().quantize(m)
+    assert isinstance(m.conv, slim.QuantizedConv2D)
+    assert m.conv.weight_scales().shape == (8,)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(16, 3, 4, 4).astype("float32")
+    Y = rng.randint(0, 4, (16,)).astype("int64")
+    o = opt.Adam(learning_rate=0.01, parameters=m.parameters())
+    step = fjit.train_step(
+        m, o, lambda mm, x, y: F.cross_entropy(mm(x), y).mean()
+    )
+    losses = [float(np.asarray(step(X, Y)["loss"])) for _ in range(20)]
+    assert losses[-1] < losses[0]

@@ -455,3 +455,36 @@ def test_fleet_static_rejects_compiled_only_flags():
         static.disable_static()
         static.reset_default_programs()
         static.global_scope().clear()
+
+
+def test_recompute_plus_gradient_merge_combo():
+    """Both flags on together: parity against the big-batch step."""
+    k = 2
+    micro = [_data(32, seed=i) for i in range(k)]
+    bigX = np.concatenate([x for x, _ in micro])
+    bigY = np.concatenate([y for _, y in micro])
+    mesh = parallel.create_mesh(dp=8)
+
+    m0 = _make()
+    o0 = opt.SGD(learning_rate=0.1, parameters=m0.parameters())
+    s0 = parallel.sharded_train_step(m0, o0, _loss_fn, mesh)
+    s0(bigX, bigY)
+    s0.sync()
+    ref = {n: np.asarray(p._array) for n, p in m0.named_parameters()}
+
+    strategy = fleet.DistributedStrategy()
+    strategy.recompute = True
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs.k_steps = k
+    strategy.sharding = True  # triple combo: remat + gm + ZeRO-1
+    m1 = _make()
+    o1 = opt.SGD(learning_rate=0.1, parameters=m1.parameters())
+    s1 = parallel.sharded_train_step(m1, o1, _loss_fn, mesh,
+                                     strategy=strategy)
+    for x, y in micro:
+        s1(x, y)
+    s1.sync()
+    got = {n: np.asarray(p._array) for n, p in m1.named_parameters()}
+    for n in ref:
+        np.testing.assert_allclose(ref[n], got[n], rtol=1e-5, atol=1e-6,
+                                   err_msg=n)
