@@ -6,19 +6,48 @@
 // executor driven from Python; this library embeds the CPython
 // interpreter (the inverse of the reference's pybind direction) and
 // exposes the same create/set-input/run/fetch surface as C symbols.
-// One interpreter serves all predictors; calls are GIL-serialized so
-// the ABI is thread-safe for independent handles.
+//
+// Threading contract: every exported entry point acquires the GIL via
+// PyGILState_Ensure/Release, and PD_Init releases the GIL it acquired
+// by initializing the interpreter (PyEval_SaveThread) — so PD_* calls
+// are safe from any host thread; they serialize on the GIL.
+// Name-pointer lifetime: the const char* returned by PD_GetInputName /
+// PD_GetOutputName stays valid until the NEXT call to the same pair of
+// functions from any thread; copy it out if you need it longer.
 #include <Python.h>
 
 #include <cstring>
+#include <mutex>
 #include <string>
 #include <vector>
 
 namespace {
 
 PyObject* g_helpers = nullptr;  // module dict with the helper functions
+std::mutex g_init_mutex;        // serializes first-time interpreter init
+std::mutex g_error_mutex;       // guards g_last_error (readable GIL-less)
 std::string g_last_error;
-std::string g_scratch;  // returned const char*s point here
+std::string g_name_scratch;  // PD_Get{Input,Output}Name return pointers here
+
+// Acquire the GIL for the scope of one exported call.
+struct GilGuard {
+  PyGILState_STATE st;
+  GilGuard() : st(PyGILState_Ensure()) {}
+  ~GilGuard() { PyGILState_Release(st); }
+};
+
+// The documented fetch sequence is ndim -> shape -> copy; each used to
+// re-run the device->host transfer. Cache the last fetched output per
+// (pred, name) and invalidate on PD_Run / PD_DeletePredictor.
+struct OutputCache {
+  void* pred = nullptr;
+  std::string name;
+  std::string bytes;
+  std::vector<long long> shape;
+  std::string dtype;
+  bool valid = false;
+};
+OutputCache g_out_cache;
 
 const char kHelperSrc[] = R"PY(
 import numpy as np
@@ -46,11 +75,19 @@ def _get_output(pred, name):
     return out.tobytes(), list(out.shape), str(out.dtype)
 )PY";
 
+void set_error(const std::string& msg) {
+  std::lock_guard<std::mutex> lock(g_error_mutex);
+  g_last_error = msg;
+}
+
 void set_error_from_python() {
   PyObject *type, *value, *tb;
   PyErr_Fetch(&type, &value, &tb);
   PyObject* s = value ? PyObject_Str(value) : nullptr;
-  g_last_error = s ? PyUnicode_AsUTF8(s) : "unknown python error";
+  // PyUnicode_AsUTF8 can itself fail (lone surrogates) and return NULL
+  const char* msg = s ? PyUnicode_AsUTF8(s) : nullptr;
+  if (!msg) PyErr_Clear();
+  set_error(msg ? msg : "unknown python error");
   Py_XDECREF(s);
   Py_XDECREF(type);
   Py_XDECREF(value);
@@ -61,22 +98,13 @@ PyObject* helper(const char* name) {
   return PyDict_GetItemString(g_helpers, name);  // borrowed
 }
 
-}  // namespace
-
-extern "C" {
-
-// All functions return 0 on success, -1 on error (PD_GetLastError tells).
-
-const char* PD_GetLastError() { return g_last_error.c_str(); }
-
-int PD_Init() {
+// Must be called with the GIL held.
+int init_helpers_locked() {
   if (g_helpers) return 0;
-  if (!Py_IsInitialized()) Py_Initialize();
   PyObject* mod = PyModule_New("paddle_tpu_capi_helpers");
   PyObject* dict = PyModule_GetDict(mod);
   PyDict_SetItemString(dict, "__builtins__", PyEval_GetBuiltins());
-  PyObject* res =
-      PyRun_String(kHelperSrc, Py_file_input, dict, dict);
+  PyObject* res = PyRun_String(kHelperSrc, Py_file_input, dict, dict);
   if (!res) {
     set_error_from_python();
     Py_DECREF(mod);
@@ -88,8 +116,79 @@ int PD_Init() {
   return 0;
 }
 
+// Fetch (or reuse) an output; returns the cache entry or nullptr.
+// GIL must be held.
+const OutputCache* get_output_locked(void* pred, const char* name) {
+  if (g_out_cache.valid && g_out_cache.pred == pred &&
+      g_out_cache.name == name) {
+    return &g_out_cache;
+  }
+  PyObject* out = PyObject_CallFunction(
+      helper("_get_output"), "Os", static_cast<PyObject*>(pred), name);
+  if (!out) {
+    set_error_from_python();
+    return nullptr;
+  }
+  PyObject* bytes = PyTuple_GetItem(out, 0);
+  PyObject* shp = PyTuple_GetItem(out, 1);
+  g_out_cache.pred = pred;
+  g_out_cache.name = name;
+  g_out_cache.bytes.assign(PyBytes_AsString(bytes),
+                           static_cast<size_t>(PyBytes_Size(bytes)));
+  g_out_cache.shape.clear();
+  for (Py_ssize_t d = 0; d < PyList_Size(shp); ++d) {
+    g_out_cache.shape.push_back(
+        PyLong_AsLongLong(PyList_GetItem(shp, d)));
+  }
+  g_out_cache.dtype = PyUnicode_AsUTF8(PyTuple_GetItem(out, 2));
+  g_out_cache.valid = true;
+  Py_DECREF(out);
+  return &g_out_cache;
+}
+
+void invalidate_output_cache(void* pred) {
+  // full reset, not just the flag: the byte buffer may be huge and must
+  // not stay resident after PD_Run/PD_DeletePredictor
+  if (g_out_cache.pred == pred) g_out_cache = OutputCache();
+}
+
+}  // namespace
+
+extern "C" {
+
+// All functions return 0 on success, -1 on error (PD_GetLastError tells).
+
+const char* PD_GetLastError() {
+  // copy under the mutex into thread-local storage: another thread's
+  // failing call may reassign g_last_error while the caller reads
+  static thread_local std::string tls_error;
+  std::lock_guard<std::mutex> lock(g_error_mutex);
+  tls_error = g_last_error;
+  return tls_error.c_str();
+}
+
+int PD_Init() {
+  // g_init_mutex: two threads racing here on a fresh process would both
+  // see Py_IsInitialized()==false; the loser would then run the helper
+  // setup without the GIL and release a GIL it never held.
+  std::lock_guard<std::mutex> lock(g_init_mutex);
+  if (g_helpers) return 0;
+  if (!Py_IsInitialized()) {
+    Py_Initialize();
+    // Py_Initialize leaves this thread holding the GIL. Do the one-time
+    // setup, then hand the GIL back so other host threads can enter via
+    // PyGILState_Ensure.
+    int rc = init_helpers_locked();
+    PyEval_SaveThread();
+    return rc;
+  }
+  GilGuard gil;
+  return init_helpers_locked();
+}
+
 void* PD_CreatePredictor(const char* model_dir) {
   if (PD_Init() != 0) return nullptr;
+  GilGuard gil;
   PyObject* out = PyObject_CallFunction(helper("_create"), "s", model_dir);
   if (!out) {
     set_error_from_python();
@@ -99,10 +198,14 @@ void* PD_CreatePredictor(const char* model_dir) {
 }
 
 void PD_DeletePredictor(void* pred) {
+  GilGuard gil;
+  invalidate_output_cache(pred);
   Py_XDECREF(static_cast<PyObject*>(pred));
 }
 
-static int name_at(const char* fn, void* pred, int i, const char** out) {
+// GIL must be held by the caller.
+static int name_at_locked(const char* fn, void* pred, int i,
+                          const char** out) {
   PyObject* names = PyObject_CallFunction(
       helper(fn), "O", static_cast<PyObject*>(pred));
   if (!names) {
@@ -111,17 +214,18 @@ static int name_at(const char* fn, void* pred, int i, const char** out) {
   }
   Py_ssize_t n = PyList_Size(names);
   if (i < 0 || i >= n) {
-    g_last_error = "index out of range";
+    set_error("index out of range");
     Py_DECREF(names);
     return -1;
   }
-  g_scratch = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  g_name_scratch = PyUnicode_AsUTF8(PyList_GetItem(names, i));
   Py_DECREF(names);
-  *out = g_scratch.c_str();
+  *out = g_name_scratch.c_str();
   return 0;
 }
 
 int PD_GetInputNum(void* pred) {
+  GilGuard gil;
   PyObject* names = PyObject_CallFunction(
       helper("_input_names"), "O", static_cast<PyObject*>(pred));
   if (!names) {
@@ -134,6 +238,7 @@ int PD_GetInputNum(void* pred) {
 }
 
 int PD_GetOutputNum(void* pred) {
+  GilGuard gil;
   PyObject* names = PyObject_CallFunction(
       helper("_output_names"), "O", static_cast<PyObject*>(pred));
   if (!names) {
@@ -146,18 +251,21 @@ int PD_GetOutputNum(void* pred) {
 }
 
 const char* PD_GetInputName(void* pred, int i) {
+  GilGuard gil;
   const char* out = nullptr;
-  return name_at("_input_names", pred, i, &out) == 0 ? out : nullptr;
+  return name_at_locked("_input_names", pred, i, &out) == 0 ? out : nullptr;
 }
 
 const char* PD_GetOutputName(void* pred, int i) {
+  GilGuard gil;
   const char* out = nullptr;
-  return name_at("_output_names", pred, i, &out) == 0 ? out : nullptr;
+  return name_at_locked("_output_names", pred, i, &out) == 0 ? out : nullptr;
 }
 
-static int set_input(void* pred, const char* name, const void* data,
-                     size_t bytes, const long long* shape, int ndim,
-                     const char* dtype) {
+// GIL must be held by the caller.
+static int set_input_locked(void* pred, const char* name, const void* data,
+                            size_t bytes, const long long* shape, int ndim,
+                            const char* dtype) {
   PyObject* shp = PyList_New(ndim);
   for (int d = 0; d < ndim; ++d) {
     PyList_SetItem(shp, d, PyLong_FromLongLong(shape[d]));
@@ -179,21 +287,25 @@ static int set_input(void* pred, const char* name, const void* data,
 
 int PD_SetInputFloat(void* pred, const char* name, const float* data,
                      const long long* shape, int ndim) {
+  GilGuard gil;
   size_t numel = 1;
   for (int d = 0; d < ndim; ++d) numel *= static_cast<size_t>(shape[d]);
-  return set_input(pred, name, data, numel * sizeof(float), shape, ndim,
-                   "float32");
+  return set_input_locked(pred, name, data, numel * sizeof(float), shape,
+                          ndim, "float32");
 }
 
 int PD_SetInputInt64(void* pred, const char* name, const long long* data,
                      const long long* shape, int ndim) {
+  GilGuard gil;
   size_t numel = 1;
   for (int d = 0; d < ndim; ++d) numel *= static_cast<size_t>(shape[d]);
-  return set_input(pred, name, data, numel * sizeof(long long), shape,
-                   ndim, "int64");
+  return set_input_locked(pred, name, data, numel * sizeof(long long),
+                          shape, ndim, "int64");
 }
 
 int PD_Run(void* pred) {
+  GilGuard gil;
+  invalidate_output_cache(pred);  // outputs change after a run
   PyObject* res = PyObject_CallFunction(
       helper("_run"), "O", static_cast<PyObject*>(pred));
   if (!res) {
@@ -204,63 +316,44 @@ int PD_Run(void* pred) {
   return 0;
 }
 
-// Fetch: query ndim/shape first, then copy the flat float data.
+// Fetch: query ndim/shape first, then copy the flat float data. The
+// device->host transfer happens once; ndim/shape/copy share the cache.
 int PD_GetOutputNdim(void* pred, const char* name) {
-  PyObject* out = PyObject_CallFunction(
-      helper("_get_output"), "Os", static_cast<PyObject*>(pred), name);
-  if (!out) {
-    set_error_from_python();
-    return -1;
-  }
-  int ndim = static_cast<int>(PyList_Size(PyTuple_GetItem(out, 1)));
-  Py_DECREF(out);
-  return ndim;
+  GilGuard gil;
+  const OutputCache* c = get_output_locked(pred, name);
+  return c ? static_cast<int>(c->shape.size()) : -1;
 }
 
 int PD_GetOutputShape(void* pred, const char* name, long long* shape_out) {
-  PyObject* out = PyObject_CallFunction(
-      helper("_get_output"), "Os", static_cast<PyObject*>(pred), name);
-  if (!out) {
-    set_error_from_python();
-    return -1;
-  }
-  PyObject* shp = PyTuple_GetItem(out, 1);
-  for (Py_ssize_t d = 0; d < PyList_Size(shp); ++d) {
-    shape_out[d] = PyLong_AsLongLong(PyList_GetItem(shp, d));
-  }
-  Py_DECREF(out);
+  GilGuard gil;
+  const OutputCache* c = get_output_locked(pred, name);
+  if (!c) return -1;
+  for (size_t d = 0; d < c->shape.size(); ++d) shape_out[d] = c->shape[d];
   return 0;
 }
 
 int PD_CopyOutputFloat(void* pred, const char* name, float* buf,
                        long long numel) {
-  PyObject* out = PyObject_CallFunction(
-      helper("_get_output"), "Os", static_cast<PyObject*>(pred), name);
-  if (!out) {
-    set_error_from_python();
+  GilGuard gil;
+  const OutputCache* c = get_output_locked(pred, name);
+  if (!c) return -1;
+  if (c->dtype != "float32") {
+    set_error("output dtype is " + c->dtype +
+              ", use the matching PD_CopyOutput*");
     return -1;
   }
-  PyObject* bytes = PyTuple_GetItem(out, 0);
-  const char* dtype = PyUnicode_AsUTF8(PyTuple_GetItem(out, 2));
-  if (std::strcmp(dtype, "float32") != 0) {
-    g_last_error = std::string("output dtype is ") + dtype +
-                   ", use the matching PD_CopyOutput*";
-    Py_DECREF(out);
-    return -1;
-  }
-  Py_ssize_t have = PyBytes_Size(bytes);
   size_t want = static_cast<size_t>(numel) * sizeof(float);
-  if (static_cast<size_t>(have) != want) {
-    g_last_error = "output size mismatch";
-    Py_DECREF(out);
+  if (c->bytes.size() != want) {
+    set_error("output size mismatch");
     return -1;
   }
-  std::memcpy(buf, PyBytes_AsString(bytes), want);
-  Py_DECREF(out);
+  std::memcpy(buf, c->bytes.data(), want);
   return 0;
 }
 
 void PD_Finalize() {
+  GilGuard gil;
+  g_out_cache = OutputCache();
   Py_XDECREF(g_helpers);
   g_helpers = nullptr;
   // the interpreter stays up: other predictors/embedders may share it

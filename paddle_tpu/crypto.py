@@ -32,7 +32,11 @@ class CipherUtils:
     @staticmethod
     def gen_key_to_file(length_bits: int, path: str) -> bytes:
         key = CipherUtils.gen_key(length_bits)
-        with open(path, "wb") as f:
+        # owner-only permissions: a world-readable key file would undo the
+        # at-rest protection this module exists to provide
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        os.fchmod(fd, 0o600)  # the mode arg is ignored for pre-existing files
+        with os.fdopen(fd, "wb") as f:
             f.write(key)
         return key
 

@@ -99,8 +99,14 @@ def elastic_run(train_fn, max_restarts: int = 3, exceptions=(Exception,)):
     """
     from ..errors import FatalError
 
+    from ..incubate import auto_checkpoint as acp
+
     attempt = 0
     while True:
+        # each attempt is a logical process restart: reset the registry so
+        # a re-built Model claims the same deterministic snapshot names and
+        # _load_latest restores into the new instances, not the dead ones
+        acp.reset_registry()
         try:
             return train_fn()
         except exceptions as e:

@@ -169,7 +169,13 @@ class Model:
 
         if acp.AutoCheckpointChecker().valid():
             self._sync_from_step()
+            # namespace per model instance: a fixed name would let a second
+            # Model.fit in the same process hijack the first one's snapshots;
+            # the claimed name is deterministic so restarted programs resume
+            if not hasattr(self, "_acp_name"):
+                self._acp_name = acp.claim_name(type(self.network).__name__)
             acp.register(self.network, self._optimizer,
+                         name=self._acp_name,
                          sync_fn=self._sync_from_step)
             # the restore (inside train_epoch_range) rewrites the eager
             # state; drop any compiled step so it rebuilds from it

@@ -53,6 +53,17 @@ class AutoCheckpointChecker:
 # what a snapshot covers: name -> (model, optimizer|None, sync_fn|None)
 _REGISTRY: dict[str, tuple] = {}
 _MAX_KEPT = 2  # checkpoint_saver.py max_num_checkpoints
+_NAME_COUNTS: dict[str, int] = {}
+
+
+def claim_name(prefix: str) -> str:
+    """Deterministic registry name: ``prefix-N`` where N counts prior
+    claims of the same prefix in this process. Identical restarted
+    programs re-derive the same names, so resume finds its snapshot
+    files, while two different models in one process stay disjoint."""
+    n = _NAME_COUNTS.get(prefix, 0)
+    _NAME_COUNTS[prefix] = n + 1
+    return f"{prefix}-{n}"
 
 
 def register(model, optimizer=None, name="default", sync_fn=None):
@@ -67,6 +78,7 @@ def register(model, optimizer=None, name="default", sync_fn=None):
 
 def reset_registry():
     _REGISTRY.clear()
+    _NAME_COUNTS.clear()
 
 
 def _snapshot_path(checker, epoch):
@@ -119,7 +131,12 @@ def _load_latest(checker, fs):
     epoch = found[-1]
     path = _snapshot_path(checker, epoch)
     for name, (model, optimizer, _sync) in _REGISTRY.items():
-        model.set_state_dict(load(os.path.join(path, f"{name}.pdparams")))
+        params_file = os.path.join(path, f"{name}.pdparams")
+        if not fs.is_file(params_file):
+            # registered after this snapshot was written (e.g. a second
+            # Model.fit in the same process): nothing to restore for it
+            continue
+        model.set_state_dict(load(params_file))
         opt_file = os.path.join(path, f"{name}.pdopt")
         if optimizer is not None and fs.is_file(opt_file):
             optimizer.set_state_dict(load(opt_file))

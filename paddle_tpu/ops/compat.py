@@ -116,7 +116,10 @@ def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0,
     p = padding if isinstance(padding, (list, tuple)) else (padding, padding)
     if global_pooling:
         ks, st, p = (h, w), (1, 1), (0, 0)
-    flat_idx = jnp.arange(h * w, dtype=x.dtype).reshape(1, 1, h, w)
+    # Index carrier is int32 regardless of x.dtype: bf16/f16 cannot
+    # represent integers above ~256 (and f32 breaks past 2**24), which
+    # silently corrupts the argmax plane.
+    flat_idx = jnp.arange(h * w, dtype=jnp.int32).reshape(1, 1, h, w)
     flat_idx = jnp.broadcast_to(flat_idx, x.shape)
     neg = jnp.finfo(x.dtype).min
 
@@ -131,10 +134,10 @@ def max_pool2d_with_index(x, *, kernel_size, stride=None, padding=0,
     pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
     out, idx = lax.reduce_window(
         (x, flat_idx),
-        (jnp.asarray(neg, x.dtype), jnp.asarray(-1.0, x.dtype)),
+        (jnp.asarray(neg, x.dtype), jnp.asarray(-1, jnp.int32)),
         sel, window, strides, pads,
     )
-    return out, idx.astype(jnp.int32)
+    return out, idx
 
 
 @register_op("unpool")
@@ -562,7 +565,8 @@ def max_pool3d_with_index(x, *, kernel_size, stride=None, padding=0):
     st = ks if stride is None else (
         (stride,) * 3 if isinstance(stride, int) else tuple(stride))
     p = (padding,) * 3 if isinstance(padding, int) else tuple(padding)
-    flat = jnp.arange(d * h * w, dtype=x.dtype).reshape(1, 1, d, h, w)
+    # int32 index carrier (see max_pool2d_with_index: bf16/f32 overflow)
+    flat = jnp.arange(d * h * w, dtype=jnp.int32).reshape(1, 1, d, h, w)
     flat = jnp.broadcast_to(flat, x.shape)
     neg = jnp.finfo(x.dtype).min
 
@@ -573,11 +577,11 @@ def max_pool3d_with_index(x, *, kernel_size, stride=None, padding=0):
         return jnp.where(take, cv, av), jnp.where(take, ci, ai)
 
     out, idx = lax.reduce_window(
-        (x, flat), (jnp.asarray(neg, x.dtype), jnp.asarray(-1.0, x.dtype)),
+        (x, flat), (jnp.asarray(neg, x.dtype), jnp.asarray(-1, jnp.int32)),
         sel, (1, 1) + ks, (1, 1) + st,
         ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
     )
-    return out, idx.astype(jnp.int32)
+    return out, idx
 
 
 @register_op("mean_iou", num_outputs=3)

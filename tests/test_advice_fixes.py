@@ -7,6 +7,8 @@
 3. send/recv must lower to a valid single-pair ppermute.
 4. paddle.load(return_numpy=False) must reconstruct Tensors.
 """
+import os
+
 import numpy as np
 
 import jax
@@ -185,3 +187,66 @@ def test_multiclass_nms_zero_score_kept():
     assert int(num) == 2
     kept_scores = sorted(float(s) for s in np.asarray(out)[: int(num), 1])
     np.testing.assert_allclose(kept_scores, [-0.1, 0.0], atol=1e-6)
+
+
+def test_max_pool_with_index_bf16_indices():
+    """ADVICE r3: index carrier must survive bf16 inputs — bf16 cannot
+    represent integers above ~256, so the argmax plane must be computed
+    in float32 regardless of x.dtype."""
+    from paddle_tpu.ops import compat
+
+    rng = np.random.default_rng(0)
+    x32 = rng.standard_normal((1, 1, 30, 30)).astype(np.float32)
+    xb = jnp.asarray(x32).astype(jnp.bfloat16)
+    # reference indices computed from the bf16 values themselves (so the
+    # argmax positions agree) but with a float32 index plane
+    _, idx_b = compat.max_pool2d_with_index(xb, kernel_size=2)
+    _, idx_32 = compat.max_pool2d_with_index(
+        jnp.asarray(xb).astype(jnp.float32), kernel_size=2)
+    np.testing.assert_array_equal(np.asarray(idx_b), np.asarray(idx_32))
+    # and unpool scatters back to the right flat positions
+    out_b, idx = compat.max_pool2d_with_index(xb, kernel_size=2)
+    restored = compat.unpool(out_b, idx, output_size=(30, 30))
+    flat = np.asarray(restored).reshape(-1)
+    nz = np.flatnonzero(flat)
+    src = np.asarray(xb.astype(jnp.float32)).reshape(-1)
+    np.testing.assert_allclose(flat[nz], src[nz], rtol=1e-2)
+
+
+def test_max_pool3d_with_index_bf16_indices():
+    from paddle_tpu.ops import compat
+
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(
+        rng.standard_normal((1, 1, 8, 12, 12)).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    _, idx_b = compat.max_pool3d_with_index(x, kernel_size=2)
+    _, idx_32 = compat.max_pool3d_with_index(
+        x.astype(jnp.float32), kernel_size=2)
+    np.testing.assert_array_equal(np.asarray(idx_b), np.asarray(idx_32))
+
+
+def test_gen_key_to_file_owner_only(tmp_path):
+    """ADVICE r3: AES key files must be created 0o600."""
+    import stat
+    from paddle_tpu.crypto import CipherUtils
+
+    p = str(tmp_path / "aes.key")
+    key = CipherUtils.gen_key_to_file(256, p)
+    assert len(key) == 32
+    mode = stat.S_IMODE(os.stat(p).st_mode)
+    assert mode == 0o600, oct(mode)
+
+
+def test_auto_checkpoint_claim_name_deterministic():
+    """ADVICE r3: two models registering must not collide on 'default',
+    and a restarted program must re-derive the same names."""
+    from paddle_tpu.incubate import auto_checkpoint as acp
+
+    acp.reset_registry()
+    a = acp.claim_name("LeNet")
+    b = acp.claim_name("LeNet")
+    c = acp.claim_name("ResNet")
+    assert (a, b, c) == ("LeNet-0", "LeNet-1", "ResNet-0")
+    acp.reset_registry()  # "process restart"
+    assert acp.claim_name("LeNet") == "LeNet-0"
