@@ -140,7 +140,14 @@ const OutputCache* get_output_locked(void* pred, const char* name) {
     g_out_cache.shape.push_back(
         PyLong_AsLongLong(PyList_GetItem(shp, d)));
   }
-  g_out_cache.dtype = PyUnicode_AsUTF8(PyTuple_GetItem(out, 2));
+  const char* dtype = PyUnicode_AsUTF8(PyTuple_GetItem(out, 2));
+  if (!dtype) {  // encoding failure: don't construct string from NULL
+    PyErr_Clear();
+    set_error("output dtype string is not UTF-8 decodable");
+    Py_DECREF(out);
+    return nullptr;
+  }
+  g_out_cache.dtype = dtype;
   g_out_cache.valid = true;
   Py_DECREF(out);
   return &g_out_cache;
@@ -218,7 +225,14 @@ static int name_at_locked(const char* fn, void* pred, int i,
     Py_DECREF(names);
     return -1;
   }
-  g_name_scratch = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  const char* name = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+  if (!name) {
+    PyErr_Clear();
+    set_error("tensor name is not UTF-8 decodable");
+    Py_DECREF(names);
+    return -1;
+  }
+  g_name_scratch = name;
   Py_DECREF(names);
   *out = g_name_scratch.c_str();
   return 0;

@@ -171,9 +171,14 @@ class Model:
             self._sync_from_step()
             # namespace per model instance: a fixed name would let a second
             # Model.fit in the same process hijack the first one's snapshots;
-            # the claimed name is deterministic so restarted programs resume
-            if not hasattr(self, "_acp_name"):
+            # the claimed name is deterministic so restarted programs resume.
+            # A cached name goes stale when reset_registry() ran (elastic
+            # restart) — re-claim so surviving and rebuilt models cannot
+            # collide on the restarted counter.
+            if (getattr(self, "_acp_epoch", None) != acp.registry_epoch()
+                    or not hasattr(self, "_acp_name")):
                 self._acp_name = acp.claim_name(type(self.network).__name__)
+                self._acp_epoch = acp.registry_epoch()
             acp.register(self.network, self._optimizer,
                          name=self._acp_name,
                          sync_fn=self._sync_from_step)

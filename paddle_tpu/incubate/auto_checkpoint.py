@@ -54,13 +54,20 @@ class AutoCheckpointChecker:
 _REGISTRY: dict[str, tuple] = {}
 _MAX_KEPT = 2  # checkpoint_saver.py max_num_checkpoints
 _NAME_COUNTS: dict[str, int] = {}
+_REGISTRY_EPOCH = 0  # bumped by reset_registry; stale claims re-claim
+
+
+def registry_epoch() -> int:
+    return _REGISTRY_EPOCH
 
 
 def claim_name(prefix: str) -> str:
     """Deterministic registry name: ``prefix-N`` where N counts prior
     claims of the same prefix in this process. Identical restarted
     programs re-derive the same names, so resume finds its snapshot
-    files, while two different models in one process stay disjoint."""
+    files, while two different models in one process stay disjoint.
+    Callers caching the claimed name must also cache registry_epoch()
+    and re-claim after a reset (see hapi.Model.fit)."""
     n = _NAME_COUNTS.get(prefix, 0)
     _NAME_COUNTS[prefix] = n + 1
     return f"{prefix}-{n}"
@@ -77,8 +84,10 @@ def register(model, optimizer=None, name="default", sync_fn=None):
 
 
 def reset_registry():
+    global _REGISTRY_EPOCH
     _REGISTRY.clear()
     _NAME_COUNTS.clear()
+    _REGISTRY_EPOCH += 1
 
 
 def _snapshot_path(checker, epoch):

@@ -44,6 +44,9 @@ class BertConfig:
     type_vocab_size: int = 2
     initializer_range: float = 0.02
     pad_token_id: int = 0
+    # dispatch attention to the pallas flash kernel (ops/pallas); dropout
+    # runs inside the kernel via the TPU PRNG
+    use_flash_attention: bool = False
 
 
 def bert_base_config() -> BertConfig:
@@ -155,6 +158,7 @@ class BertModel(Layer):
                 activation=cfg.hidden_act,
                 attn_dropout=cfg.attention_probs_dropout_prob,
                 act_dropout=0.0,
+                use_flash_attention=cfg.use_flash_attention,
             )
 
         self._pipelined = pipeline_stages > 1
@@ -352,6 +356,7 @@ def bert_pipeline_stages(cfg: BertConfig, n_stages: int):
             activation=cfg.hidden_act,
             attn_dropout=cfg.attention_probs_dropout_prob,
             act_dropout=0.0,
+            use_flash_attention=cfg.use_flash_attention,
         )
 
     n_layers = cfg.num_hidden_layers

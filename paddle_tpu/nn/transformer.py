@@ -14,6 +14,11 @@ from . import functional as F
 from .layer_base import Layer
 from .layers import Dropout, LayerList, LayerNorm, Linear
 
+# Sequence length from which use_flash_attention dispatches to the pallas
+# kernel; below it XLA's fused attention is faster on TPU (measured, see
+# COVERAGE.md "Flash attention"). Tests may lower it to force the kernel.
+FLASH_ATTENTION_MIN_SEQ = 512
+
 
 def _convert_attention_mask(attn_mask, dtype):
     if attn_mask is None:
@@ -41,12 +46,13 @@ class MultiHeadAttention(Layer):
         self.need_weights = need_weights
         # TPU extensions: sequence-parallel ring attention over the sp mesh
         # axis (parallel/ring_attention.py) and the fused pallas flash
-        # kernel (ops/pallas/flash_attention.py). Both require dropout == 0.
+        # kernel (ops/pallas/flash_attention.py). Flash supports attention
+        # dropout (in-kernel TPU PRNG); ring still requires dropout == 0.
         self.use_ring_attention = use_ring_attention
         self.use_flash_attention = use_flash_attention
-        if (use_ring_attention or use_flash_attention) and dropout:
+        if use_ring_attention and dropout:
             raise ValueError(
-                "ring/flash attention does not support attn dropout"
+                "ring attention does not support attn dropout"
             )
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim
@@ -86,11 +92,21 @@ class MultiHeadAttention(Layer):
             mask = _convert_attention_mask(attn_mask, q.dtype)
             out = ring_attention(q, k, v, mask=mask, scale=scale)
         elif (self.use_flash_attention and not self.need_weights
-                and cache is None):
+                and cache is None
+                and k.shape[2] >= FLASH_ATTENTION_MIN_SEQ):
+            # Pallas flash kernel: wins once the [L, L] score tiles stop
+            # fitting XLA's fused-attention working set (measured on v5e:
+            # >=1.5x at L=512+, but 0.8x at L=128 where XLA's batched
+            # fusion is already optimal — see COVERAGE.md "Flash
+            # attention"). Below the threshold the XLA path runs, so the
+            # flag is always safe to enable.
             from ..ops.pallas import flash_attention
 
             mask = _convert_attention_mask(attn_mask, q.dtype)
-            out = flash_attention(q, k, v, bias=mask, scale=scale)
+            out = flash_attention(
+                q, k, v, bias=mask, scale=scale,
+                dropout_rate=self.dropout if self.training else 0.0,
+            )
         else:
             scores = ops.matmul(q, k, transpose_y=True) * scale
             mask = _convert_attention_mask(attn_mask, q.dtype)
@@ -122,13 +138,14 @@ class MultiHeadAttention(Layer):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1, activation="relu",
                  attn_dropout=None, act_dropout=None, normalize_before=False,
-                 weight_attr=None, bias_attr=None):
+                 weight_attr=None, bias_attr=None, use_flash_attention=False):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
-                                            weight_attr=weight_attr, bias_attr=bias_attr)
+                                            weight_attr=weight_attr, bias_attr=bias_attr,
+                                            use_flash_attention=use_flash_attention)
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.dropout = Dropout(act_dropout)
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import paddle_tpu as paddle
 import paddle_tpu.nn as nn
 from paddle_tpu.ops.pallas.flash_attention import (
+    _flash,
     _plain_attention,
     flash_attention,
 )
@@ -46,7 +47,10 @@ def test_eager_tensor_backward():
     assert kt.grad is not None and vt.grad is not None
 
 
-def test_mha_flash_flag():
+def test_mha_flash_flag(monkeypatch):
+    from paddle_tpu.nn import transformer as _tf
+
+    monkeypatch.setattr(_tf, "FLASH_ATTENTION_MIN_SEQ", 1)
     paddle.seed(0)
     mha = nn.MultiHeadAttention(32, 4, dropout=0.0, use_flash_attention=True)
     x = paddle.to_tensor(np.random.RandomState(0).randn(2, 16, 32).astype("float32"))
@@ -60,9 +64,100 @@ def test_mha_flash_flag():
     np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-4, atol=1e-5)
 
 
-def test_dropout_conflict_raises():
+def test_ring_dropout_conflict_raises():
+    """Ring attention still rejects dropout; flash now supports it."""
     try:
-        nn.MultiHeadAttention(32, 4, dropout=0.1, use_flash_attention=True)
+        nn.MultiHeadAttention(32, 4, dropout=0.1, use_ring_attention=True)
         assert False
     except ValueError:
         pass
+    nn.MultiHeadAttention(32, 4, dropout=0.1, use_flash_attention=True)
+
+
+def test_dropout_forward_stats():
+    """Dropout drops ~rate of attention probs and rescales survivors, so
+    the output mean stays in the same ballpark and some outputs change."""
+    q, k, v = _qkv(l=64)
+    key = jax.random.PRNGKey(7)
+    out0 = np.asarray(flash_attention(q, k, v))
+    outd = np.asarray(
+        flash_attention(q, k, v, dropout_rate=0.5, dropout_key=key)
+    )
+    assert not np.allclose(out0, outd)
+    # upscale-in-train keeps expectation: means agree loosely
+    np.testing.assert_allclose(out0.mean(), outd.mean(), atol=0.05)
+
+
+def test_dropout_deterministic_per_key():
+    q, k, v = _qkv(l=64)
+    key = jax.random.PRNGKey(3)
+    a = np.asarray(flash_attention(q, k, v, dropout_rate=0.3, dropout_key=key))
+    b = np.asarray(flash_attention(q, k, v, dropout_rate=0.3, dropout_key=key))
+    np.testing.assert_array_equal(a, b)
+    c = np.asarray(
+        flash_attention(q, k, v, dropout_rate=0.3,
+                        dropout_key=jax.random.PRNGKey(4))
+    )
+    assert not np.array_equal(a, c)
+
+
+def test_dropout_backward_consistent_mask():
+    """The recompute backward must see the same mask as the forward:
+    grad via custom_vjp == grad of the seeded plain implementation."""
+    q, k, v = _qkv(l=32, d=8)
+    key = jax.random.PRNGKey(11)
+    seed = jax.random.bits(key, (), "uint32").astype(jnp.int32)
+    scale = q.shape[-1] ** -0.5
+
+    def loss_custom(q, k, v):
+        return jnp.sum(_flash(q, k, v, seed, False, scale, 0.4) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            _plain_attention(q, k, v, None, False, scale, 0.4, seed) ** 2
+        )
+
+    gc = jax.grad(loss_custom, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gc, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_mha_flash_dropout_trains(monkeypatch):
+    """Flash attention with dropout under the eager autograd tape."""
+    from paddle_tpu.nn import transformer as _tf
+
+    monkeypatch.setattr(_tf, "FLASH_ATTENTION_MIN_SEQ", 1)
+    paddle.seed(0)
+    mha = nn.MultiHeadAttention(32, 4, dropout=0.2, use_flash_attention=True)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 16, 32).astype("float32"),
+        stop_gradient=False,
+    )
+    out = mha(x, x, x)
+    out.sum().backward()
+    g = mha.q_proj.weight.grad
+    assert g is not None and np.isfinite(g.numpy()).all()
+
+
+def test_bert_flash_config_matches_plain_eval(monkeypatch):
+    """BertModel(use_flash_attention=True) in eval mode (dropout off)
+    matches the plain-attention model with identical weights."""
+    from paddle_tpu.models import BertConfig, BertModel
+    from paddle_tpu.nn import transformer as _tf
+
+    monkeypatch.setattr(_tf, "FLASH_ATTENTION_MIN_SEQ", 1)
+    paddle.seed(0)
+    cfg = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+               num_attention_heads=4, intermediate_size=128,
+               max_position_embeddings=64)
+    m1 = BertModel(BertConfig(**cfg))
+    m2 = BertModel(BertConfig(**cfg, use_flash_attention=True))
+    m2.set_state_dict(m1.state_dict())
+    m1.eval(), m2.eval()
+    ids = paddle.to_tensor(
+        np.random.RandomState(1).randint(1, 256, (2, 16)).astype("int64"))
+    s1, p1 = m1(ids)
+    s2, p2 = m2(ids)
+    np.testing.assert_allclose(s1.numpy(), s2.numpy(), rtol=1e-4, atol=1e-5)
