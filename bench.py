@@ -50,7 +50,11 @@ def bench_resnet50(on_tpu):
     from paddle_tpu.models import resnet50, resnet18
 
     if on_tpu:
-        batch, size, iters, make = 128, 224, 20, resnet50
+        # 50 iters: the axon tunnel's final value-fetch costs ~170ms fixed;
+        # at 20 iters that inflates per-step time ~8ms (15%). 50 iters
+        # amortizes it below 2% — the steady-state rate a real training
+        # loop (which fetches loss rarely) actually sees.
+        batch, size, iters, make = 128, 224, 50, resnet50
         name = "resnet50_images_per_sec_per_chip"
     else:  # CPU smoke: tiny net, tiny images
         batch, size, iters, make = 8, 32, 2, resnet18
@@ -123,7 +127,7 @@ def main():
         # 129k tokens/s vs 104k for the kernel — see COVERAGE.md "Flash
         # attention" for the committed A/B).
         cfg = BertConfig(use_flash_attention=True)  # base: 12L/768H
-        batch, seq, iters = 128, 128, 30  # more iters: tunnel-noise smoothing
+        batch, seq, iters = 128, 128, 50  # amortize tunnel fetch latency
     else:
         cfg = BertConfig(
             vocab_size=8192, hidden_size=256, num_hidden_layers=4,

@@ -152,3 +152,70 @@ def available() -> bool:
         return True
     except Exception:
         return False
+
+
+_datafeed_lib = [None]
+
+
+def _load_datafeed():
+    if _datafeed_lib[0] is None:
+        lib = ctypes.CDLL(_build("datafeed", "datafeed.cpp"))
+        LL = ctypes.c_longlong
+        lib.pt_multislot_parse.restype = LL
+        lib.pt_multislot_parse.argtypes = [
+            ctypes.c_char_p, LL,
+            ctypes.POINTER(ctypes.c_int), ctypes.c_int,
+            ctypes.POINTER(LL), LL,
+            ctypes.POINTER(LL), LL,
+            ctypes.POINTER(ctypes.c_float), LL,
+            ctypes.POINTER(LL), ctypes.POINTER(LL),
+        ]
+        _datafeed_lib[0] = lib
+    return _datafeed_lib[0]
+
+
+def multislot_parse(buf: bytes, slot_is_float):
+    """Parse MultiSlot text (data_feed.cc format) via the native parser.
+
+    Returns (counts[n_inst, n_slots] int64, ints int64[], floats float32[]).
+    Raises ValueError on malformed input (with the byte offset).
+    """
+    import numpy as np
+
+    lib = _load_datafeed()
+    LL = ctypes.c_longlong
+    n_slots = len(slot_is_float)
+    sif = (ctypes.c_int * n_slots)(*[1 if f else 0 for f in slot_is_float])
+    ti, tf = LL(0), LL(0)
+    # pass 1: size
+    n_inst = lib.pt_multislot_parse(
+        buf, len(buf), sif, n_slots,
+        None, 0, None, 0, None, 0,
+        ctypes.byref(ti), ctypes.byref(tf),
+    )
+    if n_inst < 0:
+        raise ValueError(
+            f"malformed MultiSlot record near byte {-(n_inst + 1)}"
+        )
+    counts = np.zeros(n_inst * n_slots, np.int64)
+    ints = np.zeros(max(1, ti.value), np.int64)
+    floats = np.zeros(max(1, tf.value), np.float32)
+    rc = lib.pt_multislot_parse(
+        buf, len(buf), sif, n_slots,
+        counts.ctypes.data_as(ctypes.POINTER(LL)), counts.size,
+        ints.ctypes.data_as(ctypes.POINTER(LL)), ints.size,
+        floats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), floats.size,
+        ctypes.byref(ti), ctypes.byref(tf),
+    )
+    if rc != n_inst:
+        raise ValueError("MultiSlot parse pass mismatch")
+    return (counts.reshape(n_inst, n_slots), ints[:ti.value],
+            floats[:tf.value])
+
+
+def datafeed_available() -> bool:
+    try:
+        _load_datafeed()
+        return True
+    except Exception:
+        return False

@@ -29,3 +29,9 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .feed import (  # noqa: F401
+    DatasetBase,
+    DatasetFactory,
+    InMemoryDataset,
+    QueueDataset,
+)

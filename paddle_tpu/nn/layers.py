@@ -146,40 +146,44 @@ class Conv2DTranspose(Layer):
 
 
 class MaxPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
         super().__init__()
-        self._attrs = dict(kernel_size=kernel_size, stride=stride, padding=padding, ceil_mode=ceil_mode)
+        self._attrs = dict(kernel_size=kernel_size, stride=stride, padding=padding,
+                           ceil_mode=ceil_mode, data_format=data_format)
 
     def forward(self, x):
         return F.max_pool2d(x, **self._attrs)
 
 
 class AvgPool2D(Layer):
-    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True):
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+                 data_format="NCHW"):
         super().__init__()
         self._attrs = dict(kernel_size=kernel_size, stride=stride, padding=padding,
-                           ceil_mode=ceil_mode, exclusive=exclusive)
+                           ceil_mode=ceil_mode, exclusive=exclusive, data_format=data_format)
 
     def forward(self, x):
         return F.avg_pool2d(x, **self._attrs)
 
 
 class AdaptiveAvgPool2D(Layer):
-    def __init__(self, output_size):
+    def __init__(self, output_size, data_format="NCHW"):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_avg_pool2d(x, self.output_size)
+        return F.adaptive_avg_pool2d(x, self.output_size, data_format=self.data_format)
 
 
 class AdaptiveMaxPool2D(Layer):
-    def __init__(self, output_size):
+    def __init__(self, output_size, data_format="NCHW"):
         super().__init__()
         self.output_size = output_size
+        self.data_format = data_format
 
     def forward(self, x):
-        return F.adaptive_max_pool2d(x, self.output_size)
+        return F.adaptive_max_pool2d(x, self.output_size, data_format=self.data_format)
 
 
 # -- normalization -----------------------------------------------------------

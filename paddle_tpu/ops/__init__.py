@@ -623,12 +623,14 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusiv
                 pooling_type="avg", ceil_mode=ceil_mode, exclusive=exclusive, data_format=data_format)
 
 
-def adaptive_avg_pool2d(x, output_size):
-    return _run("adaptive_pool2d", _t(x), output_size=output_size, pooling_type="avg")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _run("adaptive_pool2d", _t(x), output_size=output_size, pooling_type="avg",
+                data_format=data_format)
 
 
-def adaptive_max_pool2d(x, output_size):
-    return _run("adaptive_pool2d", _t(x), output_size=output_size, pooling_type="max")
+def adaptive_max_pool2d(x, output_size, data_format="NCHW"):
+    return _run("adaptive_pool2d", _t(x), output_size=output_size, pooling_type="max",
+                data_format=data_format)
 
 
 def batch_norm(x, running_mean, running_var, weight, bias, training=False,

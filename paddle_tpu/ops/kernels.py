@@ -711,8 +711,11 @@ def conv2d(x, w, *, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW
             pad = [(p[0], p[0]), (p[1], p[1])]
         else:
             pad = [(p[0], p[1]), (p[2], p[3])]
+    # weight layout is OIHW for both data formats (paddle convention); for
+    # NHWC only the activation layout changes. XLA:TPU folds the weight
+    # relayout into the conv.
     dn = lax.conv_dimension_numbers(
-        x.shape, w.shape, ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
+        x.shape, w.shape, ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC")
     )
     return lax.conv_general_dilated(
         x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
@@ -767,22 +770,31 @@ def conv1d(x, w, *, stride=1, padding=0, dilation=1, groups=1):
 @register_op("pool2d")
 def pool2d(x, *, kernel_size, stride=None, padding=0, pooling_type="max",
            ceil_mode=False, exclusive=True, adaptive=False, data_format="NCHW"):
-    assert data_format == "NCHW"
     if adaptive:
-        return _adaptive_pool2d(x, kernel_size, pooling_type)
+        return _adaptive_pool2d(x, kernel_size, pooling_type, data_format)
     ks = _pair(kernel_size)
     st = _pair(stride) if stride is not None else ks
     p = _pair(padding)
-    window = (1, 1, ks[0], ks[1])
-    strides = (1, 1, st[0], st[1])
-    pads = ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1]))
+    h_ax = 2 if data_format == "NCHW" else 1
+    spatial = x.shape[h_ax:h_ax + 2]
+    if data_format == "NCHW":
+        window = (1, 1, ks[0], ks[1])
+        strides = (1, 1, st[0], st[1])
+    else:  # NHWC
+        window = (1, ks[0], ks[1], 1)
+        strides = (1, st[0], st[1], 1)
+    hp, wp = (p[0], p[0]), (p[1], p[1])
     if ceil_mode:
         extra = []
-        for i, (dim, k, s, pp) in enumerate(zip(x.shape[2:], ks, st, p)):
+        for dim, k, s, pp in zip(spatial, ks, st, p):
             out_ceil = -(-(dim + 2 * pp - k) // s) + 1
             need = (out_ceil - 1) * s + k - (dim + 2 * pp)
             extra.append(max(0, need))
-        pads = ((0, 0), (0, 0), (p[0], p[0] + extra[0]), (p[1], p[1] + extra[1]))
+        hp, wp = (p[0], p[0] + extra[0]), (p[1], p[1] + extra[1])
+    if data_format == "NCHW":
+        pads = ((0, 0), (0, 0), hp, wp)
+    else:
+        pads = ((0, 0), hp, wp, (0, 0))
     if pooling_type == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, strides, pads)
@@ -795,8 +807,13 @@ def pool2d(x, *, kernel_size, stride=None, padding=0, pooling_type="max",
     return summed / (ks[0] * ks[1])
 
 
-def _adaptive_pool2d(x, output_size, pooling_type):
+def _adaptive_pool2d(x, output_size, pooling_type, data_format="NCHW"):
     oh, ow = _pair(output_size)
+    if data_format == "NHWC":
+        # delegate: XLA folds the transposes into the reductions
+        y = _adaptive_pool2d(jnp.moveaxis(x, 3, 1), output_size,
+                             pooling_type)
+        return jnp.moveaxis(y, 1, 3)
     n, c, h, w = x.shape
     if h % oh == 0 and w % ow == 0:
         xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
@@ -815,8 +832,60 @@ def _adaptive_pool2d(x, output_size, pooling_type):
 
 
 @register_op("adaptive_pool2d")
-def adaptive_pool2d(x, *, output_size, pooling_type="avg"):
-    return _adaptive_pool2d(x, output_size, pooling_type)
+def adaptive_pool2d(x, *, output_size, pooling_type="avg", data_format="NCHW"):
+    return _adaptive_pool2d(x, output_size, pooling_type, data_format)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bn_train_core(x, scale, bias, epsilon, axes, shape):
+    """Training-mode BN with a memory-lean VJP: the backward recomputes
+    x-hat from the ORIGINAL (bf16) input instead of letting autodiff save
+    the f32-upcast intermediates — on an HBM-bound conv net that halves
+    the BN-related backward traffic (cudnn's bn kernels do the same:
+    /root/reference/paddle/fluid/operators/batch_norm_op.cu saved_mean/
+    saved_inv_var + raw x)."""
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=axes)
+    varb = jnp.var(xf, axis=axes)
+    inv = lax.rsqrt(varb + epsilon)
+    y = (
+        (xf - mu.reshape(shape)) * inv.reshape(shape) * scale.reshape(shape)
+        + bias.reshape(shape)
+    ).astype(x.dtype)
+    return y, mu, varb
+
+
+def _bn_train_fwd(x, scale, bias, epsilon, axes, shape):
+    out = _bn_train_core(x, scale, bias, epsilon, axes, shape)
+    _, mu, varb = out
+    inv = lax.rsqrt(varb + epsilon)
+    return out, (x, mu, inv, scale)
+
+
+def _bn_train_bwd(epsilon, axes, shape, res, cts):
+    dy = cts[0]  # cotangents of (mu, varb) — running-stat paths — dropped,
+    # matching the reference (saved stats are not differentiated through)
+    x, mu, inv, scale = res
+    xf = x.astype(jnp.float32)
+    dyf = dy.astype(jnp.float32)
+    n = 1
+    for a in axes:
+        n *= x.shape[a]
+    xhat = (xf - mu.reshape(shape)) * inv.reshape(shape)
+    dbias = jnp.sum(dyf, axis=axes)
+    dscale = jnp.sum(dyf * xhat, axis=axes)
+    dx = (
+        inv.reshape(shape) * scale.reshape(shape).astype(jnp.float32)
+        * (dyf - (dbias / n).reshape(shape) - xhat * (dscale / n).reshape(shape))
+    )
+    return (dx.astype(x.dtype), dscale.astype(scale.dtype),
+            dbias.astype(scale.dtype))
+
+
+_bn_train_core.defvjp(_bn_train_fwd, _bn_train_bwd)
 
 
 @register_op("batch_norm", num_outputs=3)
@@ -826,6 +895,13 @@ def batch_norm(x, scale, bias, mean, var, *, momentum=0.9, epsilon=1e-5,
 
     operators/batch_norm_op.cc — running stats follow paddle's
     running = momentum*running + (1-momentum)*batch.
+
+    TPU dtype discipline: statistics accumulate in float32 regardless of
+    the carrier dtype (bf16 mean/var would lose ~3 decimal digits), but
+    the OUTPUT keeps x.dtype — under bf16 AMP the activation never
+    round-trips through an f32 HBM buffer. ResNet-50 at batch 128 is
+    HBM-bound; carrying f32 activations around every BN costs ~2x the
+    step time (see COVERAGE.md ResNet-50 section).
     """
     axes = tuple(i for i in range(x.ndim) if i != (1 if data_format == "NCHW" else x.ndim - 1))
     shape = [1] * x.ndim
@@ -833,34 +909,36 @@ def batch_norm(x, scale, bias, mean, var, *, momentum=0.9, epsilon=1e-5,
     shape[caxis] = x.shape[caxis]
 
     if training:
-        batch_mean = jnp.mean(x, axis=axes)
-        batch_var = jnp.var(x, axis=axes)
-        use_mean, use_var = batch_mean, batch_var
+        y, batch_mean, batch_var = _bn_train_core(
+            x, scale, bias, epsilon, tuple(axes), tuple(shape)
+        )
         new_mean = momentum * mean + (1 - momentum) * batch_mean
         new_var = momentum * var + (1 - momentum) * batch_var
-    else:
-        use_mean, use_var = mean, var
-        new_mean, new_var = mean, var
+        return y, new_mean, new_var
 
-    inv = lax.rsqrt(use_var + epsilon)
-    y = (x - use_mean.reshape(shape)) * inv.reshape(shape) * scale.reshape(shape) + bias.reshape(shape)
-    return y, new_mean, new_var
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+    inv = lax.rsqrt(var + epsilon)
+    y = (xf - mean.reshape(shape)) * inv.reshape(shape) * scale.reshape(shape) + bias.reshape(shape)
+    return y.astype(x.dtype), mean, var
 
 
 @register_op("layer_norm")
 def layer_norm(x, scale=None, bias=None, *, epsilon=1e-5, begin_norm_axis=-1):
-    # operators/layer_norm_op.cc — normalize over trailing dims
+    # operators/layer_norm_op.cc — normalize over trailing dims.
+    # Statistics in f32, output in x.dtype (same bf16-carrier discipline
+    # as batch_norm: no f32 activation round-trips under AMP).
     if begin_norm_axis < 0:
         begin_norm_axis = x.ndim + begin_norm_axis
     axes = tuple(range(begin_norm_axis, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    y = (x - mean) * lax.rsqrt(var + epsilon)
+    xf = x.astype(jnp.float32) if x.dtype != jnp.float32 else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    y = (xf - mean) * lax.rsqrt(var + epsilon)
     if scale is not None:
         y = y * scale
     if bias is not None:
         y = y + bias
-    return y
+    return y.astype(x.dtype)
 
 
 @register_op("group_norm")

@@ -68,11 +68,19 @@ class GPipe(Layer):
             self.add_parameter(
                 _flat(name), Parameter.from_array(stacked, name=_flat(name))
             )
-        if states[0]["buffers"]:
-            raise NotImplementedError(
-                "pipeline stages with buffers (batchnorm) are unsupported; "
-                "use buffer-free blocks (layernorm)"
+        # buffers (batchnorm running stats) stack on the same [n_stages]
+        # leading axis and ride the pipeline as per-stage state: each stage
+        # updates its own slice per microbatch tick, the final slices are
+        # written back after the schedule (mirroring the reference's
+        # per-section scopes carrying persistables, pipeline_trainer.cc:122)
+        self._buffer_names = list(states[0]["buffers"].keys())
+        for st in states[1:]:
+            assert list(st["buffers"].keys()) == self._buffer_names, (
+                "pipeline stages must have identical buffer structure"
             )
+        for name in self._buffer_names:
+            stackedb = jnp.stack([st["buffers"][name] for st in states])
+            self.register_buffer(_bflat(name), Tensor._from_array(stackedb))
 
     def sharding_rules(self):
         """Rules shard the stacked leading axis over pp; within-stage dims
@@ -88,12 +96,13 @@ class GPipe(Layer):
         (e.g. an attention mask); only ``x`` flows through the pipeline."""
         mesh = get_mesh()
         param_tensors = [self._parameters[_flat(n)] for n in self._param_names]
+        buf_tensors = [self._buffers[_bflat(n)] for n in self._buffer_names]
         if mesh is not None and int(mesh.shape.get(self.axis, 1)) > 1:
             # eager edge: settle operands onto the mesh once; params stay
             # resident in the pp-sharded layout across calls
             from jax.sharding import NamedSharding
 
-            for p in param_tensors:
+            for p in (*param_tensors, *buf_tensors):
                 if not isinstance(p._array, jax.core.Tracer):
                     p._array = jax.device_put(
                         p._array, NamedSharding(mesh, P(self.axis))
@@ -115,6 +124,7 @@ class GPipe(Layer):
             _gpipe_pure,
             stage0=self._stage0,
             names=self._param_names,
+            buf_names=self._buffer_names,
             n_stages=self.n_stages,
             n_micro=self.n_micro,
             axis=self.axis,
@@ -123,38 +133,69 @@ class GPipe(Layer):
         )
         # jit so the shard_map island always lowers under a trace (also
         # makes eager-mode vjp run compiled); inlines under an outer jit
-        return autograd.apply_op(
-            "gpipe_forward", jax.jit(fn), [*param_tensors, x, *extras], {}
+        outs = autograd.apply_op(
+            "gpipe_forward", jax.jit(fn),
+            [*param_tensors, *buf_tensors, x, *extras], {},
         )
+        if not self._buffer_names:
+            return outs
+        y, *new_bufs = outs
+        if self.training:
+            with autograd.no_grad():
+                for n, nb in zip(self._buffer_names, new_bufs):
+                    self._buffers[_bflat(n)].set_value(nb.detach())
+        return y
 
 
 def _flat(name):
     return "stacked__" + name.replace(".", "__")
 
 
-def _gpipe_pure(*args, stage0, names, n_stages, n_micro, axis, mesh,
-                n_extras=0):
-    """Pure fn: (stacked params..., x, extras...) -> y over the pp axis."""
-    n_params = len(names)
-    stacked = dict(zip(names, args[:n_params]))
-    x = args[n_params]
-    extras = args[n_params + 1 :]
+def _bflat(name):
+    return "stackedbuf__" + name.replace(".", "__")
 
-    def stage_fn(local_params, act, *ex):
+
+def _gpipe_pure(*args, stage0, names, buf_names=(), n_stages, n_micro, axis,
+                mesh, n_extras=0):
+    """Pure fn: (stacked params..., stacked bufs..., x, extras...) ->
+    y (+ updated stacked bufs) over the pp axis."""
+    n_params = len(names)
+    n_bufs = len(buf_names)
+    stacked = dict(zip(names, args[:n_params]))
+    bufs = dict(zip(buf_names, args[n_params:n_params + n_bufs]))
+    x = args[n_params + n_bufs]
+    extras = args[n_params + n_bufs + 1:]
+
+    from collections import OrderedDict
+
+    def stage_fn(local_params, local_bufs, act, *ex):
         state = {
             "params": local_params,
             "frozen": {},
-            "buffers": {},
+            "buffers": OrderedDict(
+                (n, local_bufs[n]) for n in buf_names
+            ),
         }
-        out, _ = fjit.functional_call(stage0, state, act, *ex)
-        return out
+        out, new_state = fjit.functional_call(stage0, state, act, *ex)
+        return out, tuple(new_state["buffers"][n] for n in buf_names)
 
     if mesh is None or int(mesh.shape.get(axis, 1)) == 1:
         # no pp axis: run stages sequentially (single-device semantics)
         y = x
+        per_stage_bufs = []
         for s in range(n_stages):
-            y = stage_fn({n: stacked[n][s] for n in names}, y, *extras)
-        return y
+            y, nb = stage_fn(
+                {n: stacked[n][s] for n in names},
+                {n: bufs[n][s] for n in buf_names}, y, *extras,
+            )
+            per_stage_bufs.append(nb)
+        if not buf_names:
+            return y
+        new_stacked = tuple(
+            jnp.stack([per_stage_bufs[s][i] for s in range(n_stages)])
+            for i in range(n_bufs)
+        )
+        return (y, *new_stacked)
 
     b = x.shape[0]
     assert b % n_micro == 0, (b, n_micro)
@@ -170,7 +211,8 @@ def _gpipe_pure(*args, stage0, names, n_stages, n_micro, axis, mesh,
         for e, per_sample in zip(extras, ex_kinds)
     )
 
-    # keep the stacked params pinned to the pp layout inside the program
+    # keep the stacked params/buffers pinned to the pp layout inside the
+    # program
     from jax.sharding import NamedSharding
 
     stacked = {
@@ -179,26 +221,37 @@ def _gpipe_pure(*args, stage0, names, n_stages, n_micro, axis, mesh,
         )
         for n in names
     }
+    bufs = {
+        n: lax.with_sharding_constraint(
+            bufs[n], NamedSharding(mesh, P(axis))
+        )
+        for n in buf_names
+    }
 
     body = partial(
-        _gpipe_body, stage_fn=stage_fn, names=names,
+        _gpipe_body, stage_fn=stage_fn, names=names, buf_names=buf_names,
         n_stages=n_stages, n_micro=n_micro, axis=axis, ex_kinds=ex_kinds,
     )
     in_specs = (
         {n: P(axis) for n in names},
+        {n: P(axis) for n in buf_names},
         P(),
         *([P()] * len(extras)),
     )
+    out_specs = (P(), {n: P(axis) for n in buf_names})
     # partial-manual shard_map: only pp is manual; dp/tp/sp stay under
     # GSPMD (auto) so the pipeline composes with the other parallelisms
     sm = jax.shard_map(
-        body, mesh=mesh, in_specs=in_specs, out_specs=P(),
+        body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         axis_names={axis}, check_vma=False,
     )
     # partial-manual shard_map only lowers under jit; jit inlines when
     # already inside an outer trace
-    y_mb = jax.jit(sm)(stacked, x_mb, *extras)
-    return y_mb.reshape((b,) + y_mb.shape[2:])
+    y_mb, new_bufs = jax.jit(sm)(stacked, bufs, x_mb, *extras)
+    y = y_mb.reshape((b,) + y_mb.shape[2:])
+    if not buf_names:
+        return y
+    return (y, *(new_bufs[n] for n in buf_names))
 
 
 def pipeline_schedule(n_stages: int, n_micro: int, kind: str = "1f1b"):
@@ -578,10 +631,11 @@ def default_generator_key():
     return default_generator().split()
 
 
-def _gpipe_body(stacked, x_mb, *extras, stage_fn, names, n_stages, n_micro,
-                axis, ex_kinds=()):
+def _gpipe_body(stacked, bufs, x_mb, *extras, stage_fn, names, buf_names=(),
+                n_stages, n_micro, axis, ex_kinds=()):
     """Runs per-stage under shard_map. stacked leaves: [1, *shape] local."""
     local = {n: stacked[n][0] for n in names}
+    local_b = {n: bufs[n][0] for n in buf_names}
     stage = lax.axis_index(axis)
     n = n_stages
 
@@ -607,7 +661,13 @@ def _gpipe_body(stacked, x_mb, *extras, stage_fn, names, n_stages, n_micro,
              if per_sample else e)
             for e, per_sample in zip(extras, ex_kinds)
         )
-        y = stage_fn(local, cur, *cur_extras)
+        y, new_b = stage_fn(local, local_b, cur, *cur_extras)
+        # buffer updates (bn stats) only commit on ticks where this stage
+        # actually processed a microbatch
+        local_b = {
+            n: jnp.where(run, nb, local_b[n])
+            for n, nb in zip(buf_names, new_b)
+        }
         # keep activations defined on idle stages (they compute garbage
         # that is masked out here; XLA's schedule overlaps it with comms)
         y = jnp.where(run, y, jnp.zeros_like(y))
@@ -619,4 +679,4 @@ def _gpipe_body(stacked, x_mb, *extras, stage_fn, names, n_stages, n_micro,
         recv = lax.ppermute(y, axis, fwd_perm)
 
     # outputs live on the last stage only; broadcast via psum
-    return lax.psum(out, axis)
+    return lax.psum(out, axis), {n: local_b[n][None] for n in buf_names}

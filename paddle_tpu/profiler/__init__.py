@@ -96,8 +96,14 @@ def start_profiler(state="All", tracer_option="Default", trace_dir=None):
             _device_trace_dir[0] = None  # already tracing / unsupported
 
 
-def stop_profiler(sorted_key=None, profile_path=None):
-    """DisableProfiler equivalent; writes chrome trace to profile_path."""
+def stop_profiler(sorted_key=None, profile_path=None, file=None):
+    """DisableProfiler equivalent; writes chrome trace to profile_path.
+
+    When ``sorted_key`` is given, prints the per-event aggregate table the
+    reference's DisableProfiler emits (platform/profiler.h:208 /
+    python/paddle/fluid/profiler.py) — Calls / Total / Min / Max / Ave /
+    Ratio per event name, sorted by the requested key.
+    """
     _enabled[0] = False
     if _device_trace_dir[0] is not None:
         import jax
@@ -109,6 +115,76 @@ def stop_profiler(sorted_key=None, profile_path=None):
         _device_trace_dir[0] = None
     if profile_path:
         export_chrome_tracing(profile_path)
+    if sorted_key is not None:
+        print_summary(sorted_key=sorted_key, file=file)
+
+
+def summary_records():
+    """Aggregate collected events: name -> dict(calls,total,min,max,ave) in ms."""
+    with _events_lock:
+        evs = list(_events)
+    agg = {}
+    for ev in evs:
+        rec = agg.setdefault(
+            ev["name"], {"calls": 0, "total": 0.0, "min": float("inf"), "max": 0.0}
+        )
+        dur_ms = ev["dur"] / 1e3
+        rec["calls"] += 1
+        rec["total"] += dur_ms
+        rec["min"] = min(rec["min"], dur_ms)
+        rec["max"] = max(rec["max"], dur_ms)
+    for rec in agg.values():
+        rec["ave"] = rec["total"] / rec["calls"]
+    return agg
+
+
+_SORT_KEYS = {
+    "default": None,
+    "calls": "calls",
+    "total": "total",
+    "max": "max",
+    "min": "min",
+    "ave": "ave",
+}
+
+
+def print_summary(sorted_key="total", file=None):
+    """Reference-style event summary table (profiler.py print_profiler)."""
+    if sorted_key not in _SORT_KEYS:
+        raise ValueError(
+            f"sorted_key must be one of {sorted(_SORT_KEYS)}, got {sorted_key!r}"
+        )
+    agg = summary_records()
+    if not agg:
+        print("No profiler events recorded.", file=file)
+        return
+    grand_total = sum(r["total"] for r in agg.values()) or 1.0
+    key = _SORT_KEYS[sorted_key]
+    items = sorted(
+        agg.items(), key=(lambda kv: kv[1][key]) if key else (lambda kv: kv[0]),
+        reverse=key is not None,
+    )
+    name_w = max(10, min(50, max(len(n) for n in agg)))
+    header = (
+        f"{'Event':<{name_w}}  {'Calls':>8}  {'Total(ms)':>12}  "
+        f"{'Min(ms)':>10}  {'Max(ms)':>10}  {'Ave(ms)':>10}  {'Ratio':>7}"
+    )
+    bar = "-" * len(header)
+    print("\n------------------------->     Profiling Report     "
+          "<-------------------------\n", file=file)
+    print(f"Sorted by {sorted_key} in descending order"
+          if key else "Sorted by event name", file=file)
+    print(bar, file=file)
+    print(header, file=file)
+    print(bar, file=file)
+    for name, r in items:
+        print(
+            f"{name[:name_w]:<{name_w}}  {r['calls']:>8}  {r['total']:>12.4f}  "
+            f"{r['min']:>10.4f}  {r['max']:>10.4f}  {r['ave']:>10.4f}  "
+            f"{r['total'] / grand_total:>7.4f}",
+            file=file,
+        )
+    print(bar, file=file)
 
 
 def reset_profiler():

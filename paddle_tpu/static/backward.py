@@ -61,8 +61,10 @@ def append_backward(loss, parameter_list=None, no_grad_set=None):
         raise RuntimeError(
             f"loss {loss.name!r} depends on the output of a while op, which "
             "is not reverse-differentiable in static autodiff "
-            "(lax.while_loop has no VJP rule). Rewrite the loop with "
-            "static.nn.scan, or detach the while outputs from the loss."
+            "(lax.while_loop has no VJP rule). Pass max_iters=N to "
+            "while_loop for the differentiable masked-scan lowering, "
+            "rewrite the loop with static.nn.scan, or detach the while "
+            "outputs from the loss."
         )
     if loss.name not in requires:
         raise RuntimeError(

@@ -124,13 +124,26 @@ def _defined_names(program, block_idxs):
     return names
 
 
-def while_loop(cond, body, loop_vars, is_test=False, name=None):
+def while_loop(cond, body, loop_vars, is_test=False, name=None,
+               max_iters=None):
     """paddle.static.nn.while_loop (fluid control_flow.py while_loop).
 
     ``cond(*loop_vars) -> bool scalar``, ``body(*loop_vars) -> loop_vars'``.
     Lowers to ``lax.while_loop``; loop-carried shapes/dtypes must be
-    invariant. Not differentiable — train bounded loops with :func:`scan`.
+    invariant.
+
+    Differentiability: an unbounded while has no reverse mode on XLA
+    (lax.while_loop has no VJP). Pass ``max_iters=N`` to lower the loop to
+    a masked :func:`scan` of exactly N steps — each step runs the body
+    under ``cond(*vars)`` and passes the carry through unchanged once the
+    condition turns false — which IS reverse-differentiable, matching the
+    reference's trainable while
+    (/root/reference/paddle/fluid/operators/controlflow/while_op.cc grad
+    maker). The masked form always runs N steps, so pick the tightest
+    bound you can.
     """
+    if max_iters is not None:
+        return _bounded_while(cond, body, loop_vars, int(max_iters))
     loop_vars = _as_variables(loop_vars)
     if not loop_vars:
         raise ValueError("while_loop needs at least one loop variable")
@@ -183,6 +196,25 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
         },
     )
     return out_vars
+
+
+def _bounded_while(cond_fn, body_fn, loop_vars, max_iters):
+    """while(cond) with a trip-count bound: a scan of ``max_iters`` steps
+    whose body is ``cond(vars) ? body(vars) : vars`` — the differentiable
+    lowering behind ``while_loop(max_iters=...)``."""
+    loop_vars = _as_variables(loop_vars)
+
+    def sbody(*carries):
+        pred = cond_fn(*carries)
+        outs = cond(
+            pred,
+            lambda: _as_list(body_fn(*carries)),
+            lambda: list(carries),
+        )
+        return _as_list(outs), []
+
+    finals, _ = scan(sbody, list(loop_vars), None, length=max_iters)
+    return finals
 
 
 def cond(pred, true_fn, false_fn, name=None):

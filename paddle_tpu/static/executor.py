@@ -34,6 +34,7 @@ from ..framework import random as _random
 from ..framework.place import Place, _default_place
 from ..framework.tensor import Tensor
 from ..ops.registry import kernel
+from ..profiler import RecordEvent
 from .program import Program, default_main_program, default_startup_program
 
 
@@ -123,6 +124,7 @@ class _BlockRunner:
 
     def __init__(self, program):
         self.program = program
+        self._pw_cache = {}
 
     # -- control-flow lowering ---------------------------------------------
 
@@ -130,50 +132,96 @@ class _BlockRunner:
     # (scan-in-scan, dropout-under-cond-in-while) never reuse a key path
     _LOOP_SALT = 0x6F09
 
-    def _run_while(self, op, env, base_key, outer_it=None):
+    def _persist_writes(self, blk):
+        """Persistable vars written by the block's ops (recursing into
+        nested control-flow blocks, whose writes propagate out the same
+        way) — the scope-threading set for executor.cc:428-style scope
+        semantics: these become extra block outputs so the update reaches
+        the top-level Scope instead of dying with the sub-block."""
+        if blk.idx in self._pw_cache:
+            return self._pw_cache[blk.idx]
+        names = []
+        for op in blk.ops:
+            if op.type in _BLOCK_OPS:
+                for key in ("__body_block__", "__true_block__",
+                            "__false_block__", "__cond_block__"):
+                    bidx = op.attrs.get(key)
+                    if bidx is not None:
+                        names.extend(
+                            self._persist_writes(self.program.blocks[bidx])
+                        )
+                continue
+            for n in op_out_names(op):
+                if n and blk.has_var(n) and blk.var(n).persistable:
+                    names.append(n)
+        out = sorted(set(names))
+        self._pw_cache[blk.idx] = out
+        return out
+
+    def _record_pw(self, pw, values, env, written_persist):
+        for n, v in zip(pw, values):
+            env[n] = v
+            if written_persist is not None:
+                written_persist[n] = v
+
+    def _run_while(self, op, env, base_key, outer_it=None,
+                   written_persist=None):
         attrs = op.attrs
         n_loop = attrs["__n_loop__"]
         in_names = op.inputs["X"]
         loop_in = in_names[:n_loop]
         cond_blk = self.program.blocks[attrs["__cond_block__"]]
         body_blk = self.program.blocks[attrs["__body_block__"]]
+        pw = self._persist_writes(body_blk)
 
         if outer_it is not None:
             base_key = jax.random.fold_in(base_key, outer_it)
         loop_key = jax.random.fold_in(base_key, self._LOOP_SALT)
         init = tuple(env[n] for n in loop_in)
+        pw_init = tuple(env[n] for n in pw)
 
         def cond_f(carry_it):
-            it, carry = carry_it
+            it, carry, pw_vals = carry_it
             sub = dict(env)
+            sub.update(zip(pw, pw_vals))
             sub.update(zip(attrs["__cond_formals__"], carry))
+            # None: a persistable write in a while's *condition* block has
+            # no carry slot — it still fails loudly
             self.exec_ops(cond_blk.ops, sub,
-                          jax.random.fold_in(loop_key, it), {},
+                          jax.random.fold_in(loop_key, it), None,
                           block=cond_blk)
             pred = sub[attrs["__cond_out__"]]
             return jnp.reshape(pred, ()).astype(bool)
 
         def body_f(carry_it):
-            it, carry = carry_it
+            it, carry, pw_vals = carry_it
             sub = dict(env)
+            sub.update(zip(pw, pw_vals))
             sub.update(zip(attrs["__body_formals__"], carry))
             # per-iteration key: stochastic ops (sampling decoders) draw
             # fresh randomness each step, including in nested blocks
             self.exec_ops(body_blk.ops, sub,
                           jax.random.fold_in(loop_key, it), {},
                           block=body_blk)
-            return it + 1, tuple(sub[n] for n in attrs["__body_outs__"])
+            return (it + 1, tuple(sub[n] for n in attrs["__body_outs__"]),
+                    tuple(sub[n] for n in pw))
 
-        _, final = lax.while_loop(
-            cond_f, body_f, (jnp.asarray(0, jnp.int32), init)
+        _, final, pw_final = lax.while_loop(
+            cond_f, body_f, (jnp.asarray(0, jnp.int32), init, pw_init)
         )
+        self._record_pw(pw, pw_final, env, written_persist)
         return list(final)
 
-    def _run_cond(self, op, env, base_key, outer_it=None):
+    def _run_cond(self, op, env, base_key, outer_it=None,
+                  written_persist=None):
         attrs = op.attrs
         pred = env[op.inputs["X"][0]]
         true_blk = self.program.blocks[attrs["__true_block__"]]
         false_blk = self.program.blocks[attrs["__false_block__"]]
+        # union: a branch that does not write a stat passes it through, so
+        # both lax.cond branches emit the same structure
+        pw = sorted(set(self._persist_writes(true_blk))
+                    | set(self._persist_writes(false_blk)))
 
         def branch(blk, out_names):
             def f():
@@ -181,7 +229,8 @@ class _BlockRunner:
                 # iteration context passes straight through a branch
                 self.exec_ops(blk.ops, sub, base_key, {}, block=blk,
                               iter_idx=outer_it)
-                return tuple(sub[n] for n in out_names)
+                return (tuple(sub[n] for n in out_names)
+                        + tuple(sub[n] for n in pw))
             return f
 
         outs = lax.cond(
@@ -189,23 +238,29 @@ class _BlockRunner:
             branch(true_blk, attrs["__true_outs__"]),
             branch(false_blk, attrs["__false_outs__"]),
         )
-        return list(outs)
+        n_reg = len(outs) - len(pw)
+        self._record_pw(pw, outs[n_reg:], env, written_persist)
+        return list(outs[:n_reg])
 
-    def _run_scan(self, op, env, base_key, outer_it=None):
+    def _run_scan(self, op, env, base_key, outer_it=None,
+                  written_persist=None):
         attrs = op.attrs
         n_c, n_s = attrs["__n_carry__"], attrs["__n_seq__"]
         in_names = op.inputs["X"]
         body_blk = self.program.blocks[attrs["__body_block__"]]
+        pw = self._persist_writes(body_blk)
 
         if outer_it is not None:
             base_key = jax.random.fold_in(base_key, outer_it)
         loop_key = jax.random.fold_in(base_key, self._LOOP_SALT)
         init = tuple(env[n] for n in in_names[:n_c])
         seqs = tuple(env[n] for n in in_names[n_c:n_c + n_s])
+        pw_init = tuple(env[n] for n in pw)
 
         def body_f(carry_it, xs):
-            it, carry = carry_it
+            it, carry, pw_vals = carry_it
             sub = dict(env)
+            sub.update(zip(pw, pw_vals))
             sub.update(zip(attrs["__carry_formals__"], carry))
             sub.update(zip(attrs["__seq_formals__"], xs or ()))
             self.exec_ops(body_blk.ops, sub,
@@ -213,12 +268,13 @@ class _BlockRunner:
                           block=body_blk)
             new_carry = tuple(sub[n] for n in attrs["__carry_outs__"])
             y = tuple(sub[n] for n in attrs["__y_outs__"])
-            return (it + 1, new_carry), y
+            return (it + 1, new_carry, tuple(sub[n] for n in pw)), y
 
-        (_, final), ys = lax.scan(
-            body_f, (jnp.asarray(0, jnp.int32), init),
+        (_, final, pw_final), ys = lax.scan(
+            body_f, (jnp.asarray(0, jnp.int32), init, pw_init),
             seqs if seqs else None, length=attrs.get("__length__"),
         )
+        self._record_pw(pw, pw_final, env, written_persist)
         return list(final) + list(ys)
 
     def _block_op_closure(self, op, env, base_key, outer_it=None):
@@ -271,7 +327,8 @@ class _BlockRunner:
 
             if op.type in _BLOCK_OPS:
                 results = getattr(self, f"_run_{op.type}")(
-                    op, env, base_key, iter_idx
+                    op, env, base_key, iter_idx,
+                    written_persist=written_persist,
                 )
             elif op.type.startswith("grad::"):
                 fwd_type = op.type[len("grad::"):]
@@ -331,7 +388,12 @@ class _BlockRunner:
                     f_attrs["key"] = _op_key(base_key, op, iter_idx)
                 fn_k = kernel(op.type)
                 arrays = [env[n] for n in in_names]
-                out = fn_k(*arrays, **f_attrs)
+                # named_scope → HLO metadata, so device profiles attribute
+                # fused kernels back to the framework op; the RecordEvent
+                # costs only at trace time (once per compile) and gives the
+                # reference-style per-op host table (profiler.h:126)
+                with RecordEvent(f"op::{op.type}"), jax.named_scope(op.type):
+                    out = fn_k(*arrays, **f_attrs)
                 results = list(out) if isinstance(out, (tuple, list)) else [out]
 
             for name, value in zip(out_names, results):
@@ -341,17 +403,19 @@ class _BlockRunner:
                 if block is None:
                     continue
                 if block.has_var(name) and block.var(name).persistable:
-                    if block.idx != 0:
-                        # sub-block writes to persistables cannot reach the
-                        # Scope (only top-block writes are threaded out);
-                        # fail loudly instead of silently dropping the
-                        # update (e.g. batch_norm stats under cond)
+                    if written_persist is None:
+                        # a context with no write-back path (a while's
+                        # condition block): fail loudly instead of
+                        # silently dropping the update
                         raise NotImplementedError(
                             f"op {op.type!r} writes persistable var "
-                            f"{name!r} inside a control-flow sub-block; "
-                            "move the stateful update out of the "
-                            "while/cond/scan body"
+                            f"{name!r} inside a while-condition block; "
+                            "stateful updates belong in the loop body"
                         )
+                    # sub-block writes reach the Scope via the enclosing
+                    # cond/scan/while op's persist-thread outputs
+                    # (_persist_writes), matching the reference executor's
+                    # scope write-through (executor.cc:428)
                     written_persist[name] = value
 
 
@@ -437,6 +501,7 @@ class Executor:
             tuple(persist_in),
         )
         entry = self._cache.get(sig)
+        first_run = entry is None
         if entry is None:
             traced = _trace_block(program, block, list(op_list), feed_names,
                                   fetch_names, persist_in)
@@ -454,7 +519,11 @@ class Executor:
 
         persist_arrays = [scope.get(n) for n in persist_in]
         base_key = _random.split_key()
-        fetches, written = jitted(feed_arrays, persist_arrays, base_key)
+        # first run per signature traces + compiles (the per-op events fire
+        # inside the trace); later runs are pure dispatch
+        phase = "executor::compile_and_run" if first_run else "executor::run"
+        with RecordEvent(phase):
+            fetches, written = jitted(feed_arrays, persist_arrays, base_key)
 
         from ..flags import flag
 
@@ -471,6 +540,54 @@ class Executor:
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return [Tensor._from_array(f) for f in fetches]
+
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Drive the compiled step over a Dataset's batch stream
+        (fluid/executor.py:1597 train_from_dataset).
+
+        Where the reference hands the whole Dataset to C++ trainer threads
+        (MultiTrainer), here the Dataset's parse workers stream fixed-shape
+        batches (io/feed.py) and each batch runs through the jitted
+        whole-block step — one compile, N dispatches. Returns the number
+        of batches consumed.
+        """
+        if dataset is None:
+            raise ValueError("train_from_dataset needs a dataset")
+        program = program or default_main_program()
+        scope = scope or global_scope()
+        if thread:
+            dataset.set_thread(thread)
+        fetch_list = fetch_list or []
+        fetch_names = [v if isinstance(v, str) else v.name
+                       for v in fetch_list]
+        labels = fetch_info or fetch_names
+        feed_names = dataset._feed_names()
+        n = 0
+        for batch in dataset._iter_batches():
+            feed = dict(zip(feed_names, batch))
+            fetches = self.run(program, feed=feed, fetch_list=fetch_list,
+                               scope=scope)
+            n += 1
+            if fetch_list and (debug or n % print_period == 0):
+                msg = ", ".join(
+                    f"{lbl}={np.asarray(v).ravel()[:4]}"
+                    for lbl, v in zip(labels, fetches)
+                )
+                print(f"[train_from_dataset] batch {n}: {msg}")
+        return n
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        """Inference twin of train_from_dataset (fluid/executor.py:1658);
+        identical driving loop — the program simply contains no optimizer
+        ops."""
+        return self.train_from_dataset(
+            program, dataset, scope, thread, debug, fetch_list, fetch_info,
+            print_period,
+        )
 
     @staticmethod
     def _scan_nan_inf(program, fetch_names, fetches, written):

@@ -253,3 +253,128 @@ def test_dropout_grad_mask_matches_forward():
     assert 0 < kept.sum() < 64  # nondegenerate draw
     np.testing.assert_allclose(gv[kept], 2.0)   # 1/(1-p)
     np.testing.assert_allclose(gv[~kept], 0.0)
+
+
+def test_bn_under_cond():
+    """Persistable writes inside a cond branch reach the Scope via the
+    persist-thread outputs (reference scope semantics, executor.cc:428):
+    batch_norm running stats update when the branch runs, stay put when
+    the other branch runs."""
+    x = static.data("x", [4, 3], "float32")
+    pred = static.data("pred", [], "bool")
+    y = static.nn.cond(
+        pred,
+        lambda: static.nn.batch_norm(x, momentum=0.5),
+        lambda: ops.scale(x, 1.0),
+    )
+    exe = static.Executor()
+    exe.run_startup()
+
+    rng = np.random.RandomState(0)
+    xv = rng.randn(4, 3).astype(np.float32)
+    scope = static.global_scope()
+
+    exe.run(feed={"x": xv, "pred": np.asarray(True)}, fetch_list=[y])
+    expected_mean = 0.5 * xv.mean(0)  # 0.5*old(0) + (1-0.5)*batch
+    stats = [n for n in scope.var_names()
+             if np.asarray(scope.get(n)).shape == (3,)
+             and np.allclose(np.asarray(scope.get(n)), expected_mean,
+                             atol=1e-5)]
+    assert stats, "running mean not written back from the cond branch"
+    mean_name = stats[0]
+
+    # false branch: stats unchanged
+    exe.run(feed={"x": xv, "pred": np.asarray(False)}, fetch_list=[y])
+    np.testing.assert_allclose(
+        np.asarray(scope.get(mean_name)), expected_mean, atol=1e-5)
+
+    # true branch again: second update compounds
+    exe.run(feed={"x": xv, "pred": np.asarray(True)}, fetch_list=[y])
+    expected2 = 0.5 * expected_mean + 0.5 * xv.mean(0)
+    np.testing.assert_allclose(
+        np.asarray(scope.get(mean_name)), expected2, atol=1e-5)
+
+
+def test_bn_under_scan():
+    """Running stats accumulate across scan iterations (the stats ride the
+    carry) and the final value lands in the Scope."""
+    seq = static.data("seq", [5, 4, 3], "float32")
+    c0 = static.data("c0", [4, 3], "float32")
+
+    def body(c, x):
+        h = static.nn.batch_norm(x, momentum=0.9)
+        return [ops.add(c, h)], [h]
+
+    finals, _ = static.nn.scan(body, [c0], [seq])
+    out = ops.sum(finals[0])
+    exe = static.Executor()
+    exe.run_startup()
+
+    rng = np.random.RandomState(1)
+    sv = rng.randn(5, 4, 3).astype(np.float32)
+    scope = static.global_scope()
+    exe.run(feed={"seq": sv, "c0": np.zeros((4, 3), np.float32)},
+            fetch_list=[out])
+
+    m = np.zeros(3)
+    for t in range(5):
+        m = 0.9 * m + 0.1 * sv[t].mean(0)
+    stats = [n for n in scope.var_names()
+             if np.asarray(scope.get(n)).shape == (3,)
+             and np.allclose(np.asarray(scope.get(n)), m, atol=1e-5)]
+    assert stats, "running mean after scan should equal 5 chained updates"
+
+
+def test_bounded_while_forward_matches_unbounded():
+    """while_loop(max_iters=N) lowers to a masked scan with identical
+    forward semantics (early termination included)."""
+    i = static.data("i", [], "int64")
+    x = static.data("x", [3], "float32")
+
+    def c(i_, x_):
+        return ops.less_than(i_, ops.full([], 4, "int64"))
+
+    def b(i_, x_):
+        return [ops.add(i_, ops.full([], 1, "int64")), ops.scale(x_, 2.0)]
+
+    outs_u = static.nn.while_loop(c, b, [i, x])
+    outs_b = static.nn.while_loop(c, b, [i, x], max_iters=10)
+
+    res = _run({"i": np.asarray(0), "x": np.ones(3, np.float32)},
+               [outs_u[1], outs_b[1], outs_b[0]])
+    np.testing.assert_allclose(res[0], res[1])  # same final x (16.0)
+    np.testing.assert_allclose(res[1], 16.0 * np.ones(3))
+    assert int(res[2]) == 4  # loop stopped at the condition, not the bound
+
+
+def test_bounded_while_gradient_decode_loop():
+    """The VERDICT item: a trainable decode-style loop differentiates
+    (while_op.cc grad-maker parity via the masked-scan lowering)."""
+    w = static.nn.create_parameter([3], "float32")
+    i0 = static.data("i0", [], "int64")
+    h0 = static.data("h0", [3], "float32")
+    h0.stop_gradient = False
+
+    def c(i_, h_):
+        return ops.less_than(i_, ops.full([], 3, "int64"))
+
+    def b(i_, h_):
+        return [ops.add(i_, ops.full([], 1, "int64")),
+                ops.multiply(h_, w)]
+
+    outs = static.nn.while_loop(c, b, [i0, h0], max_iters=5)
+    loss = ops.sum(outs[1])
+    grads = static.gradients(loss, [h0, w])
+
+    exe = static.Executor()
+    exe.run_startup()
+    scope = static.global_scope()
+    wv = np.array([1.5, 2.0, 0.5], np.float32)
+    scope.set(w.name, wv)
+    h = np.array([1.0, 2.0, 3.0], np.float32)
+    res = exe.run(feed={"i0": np.asarray(0), "h0": h},
+                  fetch_list=[loss, grads[0], grads[1]])
+    # 3 iterations: loss = sum(h * w^3)
+    np.testing.assert_allclose(res[0], (h * wv ** 3).sum(), rtol=1e-5)
+    np.testing.assert_allclose(res[1], wv ** 3, rtol=1e-5)  # dloss/dh
+    np.testing.assert_allclose(res[2], 3 * h * wv ** 2, rtol=1e-5)  # dloss/dw

@@ -1,0 +1,164 @@
+"""Dataset/DataFeed ingestion + train_from_dataset tests.
+
+Reference test pattern: tests/unittests/test_dataset.py (InMemoryDataset/
+QueueDataset over MultiSlot text files) and the dist_ctr fixture's
+file-fed training (test_dist_ctr.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+from paddle_tpu import ops
+from paddle_tpu.io import DatasetFactory, InMemoryDataset, QueueDataset
+
+
+def setup_function(_):
+    static.reset_default_programs()
+    static.enable_static()
+
+
+def teardown_function(_):
+    static.disable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+
+
+def _write_ctr_files(tmp_path, n_files=2, lines_per_file=8, seed=0):
+    """dist_ctr-style MultiSlot files: label(1 int), ids(3 sparse int),
+    dense(2 float)."""
+    rng = np.random.RandomState(seed)
+    paths = []
+    rows = []
+    for fi in range(n_files):
+        p = tmp_path / f"part-{fi:03d}.txt"
+        with open(p, "w") as f:
+            for _ in range(lines_per_file):
+                label = int(rng.randint(0, 2))
+                n_ids = int(rng.randint(1, 4))
+                ids = rng.randint(1, 50, n_ids).tolist()
+                dense = rng.rand(2).round(3).tolist()
+                f.write(
+                    f"1 {label} {n_ids} " + " ".join(map(str, ids))
+                    + " 2 " + " ".join(map(str, dense)) + "\n"
+                )
+                rows.append((label, ids, dense))
+        paths.append(str(p))
+    return paths, rows
+
+
+def _build_vars():
+    label = static.data("click", [-1, 1], "int64")
+    ids = static.data("slot_ids", [-1, 3], "int64")
+    dense = static.data("dense_f", [-1, 2], "float32")
+    return label, ids, dense
+
+
+def test_inmemory_load_and_batches(tmp_path):
+    paths, rows = _write_ctr_files(tmp_path)
+    label, ids, dense = _build_vars()
+    ds = InMemoryDataset()
+    ds.set_batch_size(4)
+    ds.set_thread(2)
+    ds.set_filelist(paths)
+    ds.set_use_var([label, ids, dense])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 16
+    batches = list(ds._iter_batches())
+    assert len(batches) == 4
+    lb, ib, db = batches[0]
+    assert lb.shape == (4, 1) and lb.dtype == np.int64
+    assert ib.shape == (4, 3) and ib.dtype == np.int64  # padded to width 3
+    assert db.shape == (4, 2) and db.dtype == np.float32
+    # order matches multiprocess-arbitrary file order; check CONTENT via
+    # the union of labels
+    all_labels = sorted(
+        int(v) for b in batches for v in b[0].ravel()
+    )
+    assert all_labels == sorted(r[0] for r in rows)
+
+
+def test_inmemory_shuffles(tmp_path):
+    paths, _ = _write_ctr_files(tmp_path, n_files=1, lines_per_file=12)
+    label, ids, dense = _build_vars()
+    ds = InMemoryDataset()
+    ds.set_batch_size(3)
+    ds.set_filelist(paths)
+    ds.set_use_var([label, ids, dense])
+    ds.load_into_memory()
+    before = [b[2].copy() for b in ds._iter_batches()]
+    ds.set_shuffle_seed(7)
+    ds.local_shuffle()
+    after = [b[2] for b in ds._iter_batches()]
+    assert ds.get_shuffle_data_size() == 12
+    assert not all(np.array_equal(a, b) for a, b in zip(before, after))
+    # global shuffle with no fleet == seeded local shuffle
+    ds.global_shuffle()
+    assert ds.get_shuffle_data_size() == 12
+
+
+def test_queue_dataset_streams_and_rejects_shuffle(tmp_path):
+    paths, _ = _write_ctr_files(tmp_path)
+    label, ids, dense = _build_vars()
+    ds = DatasetFactory().create_dataset("QueueDataset")
+    ds.set_batch_size(4)
+    ds.set_filelist(paths)
+    ds.set_use_var([label, ids, dense])
+    assert len(list(ds._iter_batches())) == 4
+    with pytest.raises(NotImplementedError):
+        ds.local_shuffle()
+    with pytest.raises(NotImplementedError):
+        ds.global_shuffle()
+
+
+def test_train_from_dataset_ctr_end_to_end(tmp_path):
+    """dist_ctr-style LR model trains from files end-to-end; loss drops."""
+    paths, _ = _write_ctr_files(tmp_path, n_files=2, lines_per_file=32,
+                                seed=3)
+    label, ids, dense = _build_vars()
+    emb = static.nn.embedding(ids, size=[50, 4])
+    emb_sum = ops.sum(emb, axis=1)          # [B, 4]
+    feat = ops.concat([emb_sum, dense], axis=1)    # [B, 6]
+    fc = static.nn.fc(feat, size=2)
+    loss = ops.mean(ops.softmax_with_cross_entropy(fc, label))
+    optimizer = static.optimizer.SGD(learning_rate=0.5)
+    optimizer.minimize(loss)
+
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(8)
+    ds.set_thread(2)
+    ds.set_filelist(paths)
+    ds.set_use_var([label, ids, dense])
+    ds.load_into_memory()
+    ds.set_shuffle_seed(0)
+    ds.local_shuffle()
+
+    exe = static.Executor()
+    exe.run_startup()
+    losses = []
+    for _ in range(6):  # epochs over the in-memory data
+        exe.train_from_dataset(
+            static.default_main_program(), ds,
+            fetch_list=[loss], print_period=10**9,
+        )
+        res = exe.run(feed={
+            "click": np.zeros((8, 1), np.int64),
+            "slot_ids": np.zeros((8, 3), np.int64),
+            "dense_f": np.zeros((8, 2), np.float32),
+        }, fetch_list=[loss])
+        losses.append(float(res[0]))
+    # training happened: parameters moved -> loss on fixed probe changed
+    assert losses[0] != losses[-1]
+
+
+def test_malformed_file_raises(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("1 0 2 17\n")  # ids slot claims 2 values, has 1
+    label, ids, dense = _build_vars()
+    ds = InMemoryDataset()
+    ds.set_filelist([str(p)])
+    ds.set_use_var([label, ids, dense])
+    with pytest.raises(ValueError):
+        ds.load_into_memory()

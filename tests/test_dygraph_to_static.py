@@ -322,3 +322,268 @@ def test_for_range_python_edge_semantics():
         return i
 
     assert convert_to_static(fn2)() == 10
+
+
+# -- break/continue (break_continue_transformer.py parity) -------------------
+
+
+def _jit_scalar(tfn):
+    @jax.jit
+    def jf(a):
+        out = tfn(Tensor._from_array(a))
+        return out._array if isinstance(out, Tensor) else out
+    return lambda v: np.asarray(jf(jnp.asarray(v)))
+
+
+def test_break_in_while():
+    """mirrors tests/unittests/dygraph_to_static/test_break_continue.py
+    test_optim_break_in_while"""
+    def fn(x):
+        i = paddle.to_tensor(np.asarray(0, np.int32))
+        s = x * 0
+        while i < 10:
+            if i > 4:
+                break
+            s = s + x
+            i = i + 1
+        return s
+
+    tfn = convert_to_static(fn)
+    # eager: breaks after 5 additions
+    out = tfn(paddle.to_tensor(np.array([2.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [10.0])
+    # traced: the whole loop+break lowers into ONE compiled function
+    np.testing.assert_allclose(_jit_scalar(tfn)([2.0]), [10.0])
+
+
+def test_continue_in_for():
+    """test_continue_in_for parity: skip odd i."""
+    def fn(x):
+        s = x * 0
+        for i in range(6):
+            if i % 2 == 1:
+                continue
+            s = s + i
+        return s
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])  # 0+2+4
+    np.testing.assert_allclose(_jit_scalar(tfn)([0.0]), [6.0])
+
+
+def test_break_in_for_traced_bound():
+    """break composes with the for->while lowering under tracing."""
+    def fn(x):
+        s = x * 0
+        for i in range(8):
+            if (s > 5).sum() > 0:
+                break
+            s = s + x
+        return s
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.array([3.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [6.0])  # 3,6 stop
+    np.testing.assert_allclose(_jit_scalar(tfn)([3.0]), [6.0])
+
+
+def test_break_continue_both():
+    def fn(x):
+        s = x * 0
+        i = paddle.to_tensor(np.asarray(0, np.int32))
+        while i < 20:
+            i = i + 1
+            if i % 2 == 0:
+                continue
+            if i > 9:
+                break
+            s = s + i
+        return s  # 1+3+5+7+9? no: break at i=11 -> 1+3+5+7+9=25
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [25.0])
+    np.testing.assert_allclose(_jit_scalar(tfn)([0.0]), [25.0])
+
+
+# -- early return (return_transformer.py parity) -----------------------------
+
+
+def test_early_return_in_if():
+    """mirrors test_return.py test_return_if: a mid-function return."""
+    def fn(x):
+        if x.sum() > 0:
+            return x * 10
+        y = x - 5
+        return y
+
+    tfn = convert_to_static(fn)
+    np.testing.assert_allclose(
+        np.asarray(tfn(paddle.to_tensor(np.array([2.0], np.float32))).numpy()),
+        [20.0])
+    np.testing.assert_allclose(
+        np.asarray(tfn(paddle.to_tensor(np.array([-2.0], np.float32))).numpy()),
+        [-7.0])
+    jf = _jit_scalar(tfn)
+    np.testing.assert_allclose(jf([2.0]), [20.0])
+    np.testing.assert_allclose(jf([-2.0]), [-7.0])
+
+
+def test_return_in_while():
+    """return inside a loop exits the loop AND the function."""
+    def fn(x):
+        i = paddle.to_tensor(np.asarray(0, np.int32))
+        while i < 10:
+            x = x + 1
+            if (x > 3).sum() > 0:
+                return x * 100
+            i = i + 1
+        return x
+
+    tfn = convert_to_static(fn)
+    np.testing.assert_allclose(
+        np.asarray(tfn(paddle.to_tensor(np.array([2.0], np.float32))).numpy()),
+        [400.0])
+    np.testing.assert_allclose(_jit_scalar(tfn)([2.0]), [400.0])
+
+
+def test_return_nested_if():
+    def fn(x):
+        if x.sum() > 0:
+            if x.sum() > 10:
+                return x * 2
+            return x * 3
+        return x * 4
+
+    tfn = convert_to_static(fn)
+    jf = _jit_scalar(tfn)
+    np.testing.assert_allclose(jf([20.0]), [40.0])
+    np.testing.assert_allclose(jf([1.0]), [3.0])
+    np.testing.assert_allclose(jf([-1.0]), [-4.0])
+
+
+# -- print / assert / cast ---------------------------------------------------
+
+
+def test_print_transform(capsys):
+    def fn(x):
+        print("value:", 42)
+        return x
+
+    tfn = convert_to_static(fn)
+    tfn(paddle.to_tensor(np.array([1.0], np.float32)))
+    assert "value: 42" in capsys.readouterr().out
+
+
+def test_print_traced_does_not_crash():
+    def fn(x):
+        print(x)
+        return x + 1
+
+    tfn = convert_to_static(fn)
+    out = _jit_scalar(tfn)([1.0])
+    np.testing.assert_allclose(out, [2.0])
+
+
+def test_assert_transform_eager():
+    def fn(x):
+        assert x.sum() > 0, "must be positive"
+        return x
+
+    tfn = convert_to_static(fn)
+    tfn(paddle.to_tensor(np.array([1.0], np.float32)))  # passes
+    import pytest
+    with pytest.raises(AssertionError, match="must be positive"):
+        tfn(paddle.to_tensor(np.array([-1.0], np.float32)))
+
+
+def test_assert_traced_raises_at_runtime():
+    def fn(x):
+        assert x.sum() > 0
+        return x * 2
+
+    tfn = convert_to_static(fn)
+    jf = _jit_scalar(tfn)
+    np.testing.assert_allclose(jf([1.0]), [2.0])  # ok path compiles+runs
+    import pytest
+    with pytest.raises(Exception):  # XLA surfaces the callback error
+        _ = jf([-1.0])
+
+
+def test_cast_transform():
+    def fn(x):
+        n = int(x.sum())        # traced -> dtype cast, eager -> python int
+        f = float(n)
+        return x * f
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.array([3.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [9.0])
+    np.testing.assert_allclose(_jit_scalar(tfn)([3.0]), [9.0])
+
+
+def test_len_transform():
+    def fn(x):
+        n = len(x)  # static shape read under tracing
+        return x * n
+
+    tfn = convert_to_static(fn)
+    np.testing.assert_allclose(
+        np.asarray(tfn(paddle.to_tensor(np.array([1.0, 2.0], np.float32))).numpy()),
+        [2.0, 4.0])
+    jf = _jit_scalar(tfn)
+    np.testing.assert_allclose(jf([1.0, 2.0]), [2.0, 4.0])
+
+
+def test_list_append_python_loop():
+    """list_transformer absorption: python-bound loops unroll during
+    tracing, so list.append works natively (the dynamic-length case needs
+    the scan construct and raises from the while lowering)."""
+    def fn(x):
+        outs = []
+        for i in range(3):
+            outs.append(x * (i + 1))
+        return outs[0] + outs[1] + outs[2]
+
+    tfn = convert_to_static(fn)
+    np.testing.assert_allclose(
+        np.asarray(tfn(paddle.to_tensor(np.array([1.0], np.float32))).numpy()),
+        [6.0])
+    np.testing.assert_allclose(_jit_scalar(tfn)([1.0]), [6.0])
+
+
+def test_break_in_for_leaves_loop_var_at_break_value():
+    """Regression: `for i in range(10): if i == 3: break` must end with
+    i == 3 (python semantics), not the range's final value."""
+    def fn(x):
+        j = 0
+        for i in range(10):
+            j = i
+            if i == 3:
+                break
+        return x * 0 + j
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [3.0])
+    np.testing.assert_allclose(_jit_scalar(tfn)([1.0]), [3.0])
+
+
+def test_continue_in_for_still_advances():
+    """Regression: continue must not skip the loop-variable bump (an
+    infinite loop / wrong trip count otherwise)."""
+    def fn(x):
+        s = x * 0
+        n = 0
+        for i in range(5):
+            n = n + 1
+            if i % 2 == 0:
+                continue
+            s = s + i
+        return s + n * 100  # n==5 proves all iterations ran
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.array([0.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [504.0])  # 1+3
+    np.testing.assert_allclose(_jit_scalar(tfn)([0.0]), [504.0])

@@ -89,3 +89,57 @@ def test_fleet_metrics_single_process():
     # random: identical histograms -> auc 0.5
     same = np.ones(100)
     assert abs(fm.auc(same, same) - 0.5) < 1e-3
+
+
+def test_profiler_summary_table(capsys):
+    """sorted_key aggregation prints the reference-style table
+    (platform/profiler.h:208 print path)."""
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    for _ in range(3):
+        with profiler.RecordEvent("matmul"):
+            pass
+    with profiler.RecordEvent("softmax"):
+        pass
+    profiler.stop_profiler(sorted_key="calls")
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out
+    assert "matmul" in out and "softmax" in out
+    # matmul (3 calls) sorts above softmax (1 call)
+    assert out.index("matmul") < out.index("softmax")
+    for col in ("Calls", "Total(ms)", "Min(ms)", "Max(ms)", "Ave(ms)", "Ratio"):
+        assert col in out
+    recs = profiler.summary_records()
+    assert recs["matmul"]["calls"] == 3 and recs["softmax"]["calls"] == 1
+
+
+def test_profiler_summary_bad_key():
+    import pytest
+
+    with pytest.raises(ValueError):
+        profiler.print_summary(sorted_key="bogus")
+
+
+def test_executor_emits_op_events():
+    """The static executor emits per-op trace events + run-phase events."""
+    import paddle_tpu.static as static
+
+    profiler.reset_profiler()
+    static.reset_default_programs()
+    static.enable_static()
+    try:
+        x = static.data("x", [2, 3], "float32")
+        y = paddle.multiply(x, x)
+        exe = static.Executor()
+        profiler.start_profiler(state="CPU")
+        exe.run(feed={"x": np.ones((2, 3), np.float32)}, fetch_list=[y])
+        exe.run(feed={"x": np.ones((2, 3), np.float32)}, fetch_list=[y])
+        profiler.stop_profiler()
+        recs = profiler.summary_records()
+        assert any(k.startswith("op::") for k in recs), recs
+        assert "executor::compile_and_run" in recs
+        assert "executor::run" in recs  # second run hits the cache
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        profiler.reset_profiler()

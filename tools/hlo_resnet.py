@@ -1,0 +1,69 @@
+"""Dump compiled-HLO statistics for the ResNet-50 train step (gap evidence)."""
+from __future__ import annotations
+
+import collections
+import json
+import re
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    sys.path.insert(0, ".")
+    from tools.sweep_resnet import run  # noqa: F401 (reuse build pieces)
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import amp
+    from paddle_tpu.framework import jit as fjit
+    from paddle_tpu.models import resnet50
+
+    data_format = sys.argv[1] if len(sys.argv) > 1 else "NCHW"
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000, data_format=data_format)
+    optimizer = opt.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+
+    def loss_fn(m, x, y):
+        with amp.auto_cast():
+            logits = m(x)
+        return F.cross_entropy(logits.astype("float32"), y).mean()
+
+    step = fjit.train_step(model, optimizer, loss_fn)
+    rng = np.random.RandomState(0)
+    shape = (batch, 3, 224, 224) if data_format == "NCHW" else (batch, 224, 224, 3)
+    x = jax.device_put(rng.randn(*shape).astype("float32"))
+    y = jax.device_put(rng.randint(0, 1000, (batch,)).astype("int64"))
+
+    lr = jax.numpy.asarray(0.1, jax.numpy.float32)
+    rng = jax.random.PRNGKey(0)
+    lowered = jax.jit(step.pure).lower(step.state, (x._array if hasattr(x, "_array") else x,
+                                                    y._array if hasattr(y, "_array") else y), lr, rng)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    convs = collections.Counter()
+    for m in re.finditer(r"(\S+) = (\S+) convolution\(", txt):
+        convs[m.group(2).split("[")[0]] += 1
+    dots = collections.Counter()
+    for m in re.finditer(r"(\S+) = (\S+) dot\(", txt):
+        dots[m.group(2).split("[")[0]] += 1
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    flops = ca.get("flops", 0)
+    bytes_ = ca.get("bytes accessed", 0)
+    print(json.dumps({
+        "conv_out_dtypes": dict(convs),
+        "dot_out_dtypes": dict(dots),
+        "flops_G": round(flops / 1e9, 1),
+        "bytes_GB": round(bytes_ / 1e9, 2),
+        "flops_per_image_G": round(flops / 1e9 / batch, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

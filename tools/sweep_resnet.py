@@ -1,0 +1,79 @@
+"""ResNet-50 single-chip layout/batch sweep (VERDICT r3 item 1 evidence).
+
+Runs the exact bench.py train-step recipe over a grid of
+(data_format, batch, amp) and prints one JSON line per config.
+Usage: python tools/sweep_resnet.py [--configs NCHW:128 NHWC:128 ...]
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run(data_format: str, batch: int, iters: int = 20, size: int = 224,
+        use_amp: bool = True):
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import amp
+    from paddle_tpu.framework import jit as fjit
+    from paddle_tpu.models import resnet50
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000, data_format=data_format)
+    optimizer = opt.Momentum(
+        learning_rate=0.1, momentum=0.9, parameters=model.parameters()
+    )
+
+    def loss_fn(m, x, y):
+        if use_amp:
+            with amp.auto_cast():
+                logits = m(x)
+        else:
+            logits = m(x)
+        return F.cross_entropy(logits.astype("float32"), y).mean()
+
+    step = fjit.train_step(model, optimizer, loss_fn)
+    rng = np.random.RandomState(0)
+    shape = (batch, 3, size, size) if data_format == "NCHW" else (batch, size, size, 3)
+    x = jax.device_put(rng.randn(*shape).astype("float32"))
+    y = jax.device_put(rng.randint(0, 1000, (batch,)).astype("int64"))
+
+    t_c0 = time.perf_counter()
+    l0 = float(np.asarray(step(x, y)["loss"]))
+    compile_s = time.perf_counter() - t_c0
+    float(np.asarray(step(x, y)["loss"]))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m = step(x, y)
+    l1 = float(np.asarray(m["loss"]))
+    dt = time.perf_counter() - t0
+    ips = batch * iters / dt
+    return {
+        "data_format": data_format, "batch": batch, "amp": use_amp,
+        "images_per_sec": round(ips, 1), "compile_s": round(compile_s, 1),
+        "loss_start": round(l0, 4), "loss_end": round(l1, 4),
+        "vs_2500": round(ips / 2500.0, 3),
+    }
+
+
+def main():
+    configs = sys.argv[1:] or ["NCHW:128", "NHWC:128", "NHWC:256", "NCHW:256"]
+    for c in configs:
+        parts = c.split(":")
+        df, b = parts[0], int(parts[1])
+        use_amp = len(parts) < 3 or parts[2] != "noamp"
+        try:
+            r = run(df, b, use_amp=use_amp)
+        except Exception as e:  # keep sweeping on OOM etc.
+            r = {"data_format": df, "batch": b, "error": str(e)[:200]}
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    main()
