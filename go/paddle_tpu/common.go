@@ -1,0 +1,44 @@
+// Go inference client for paddle_tpu over the C ABI.
+//
+// Reference parity: /root/reference/go/paddle/common.go — the cgo
+// preamble and shared helpers of the reference's Go client, retargeted
+// at libpaddle_tpu_capi.so (paddle_tpu/_native/capi.cpp), which embeds
+// CPython and drives the XLA-compiled predictor.
+//
+// Build:
+//
+//	CAPI=$(python -c "from paddle_tpu._native.capi import build_capi; print(build_capi())")
+//	export CGO_LDFLAGS="-L$(dirname $CAPI) -lpaddle_tpu_capi"
+//	export LD_LIBRARY_PATH=$(dirname $CAPI):$LD_LIBRARY_PATH
+//	go build ./...
+//
+// PYTHONPATH must reach paddle_tpu at runtime (PD_Init imports it).
+package paddle_tpu
+
+// #cgo LDFLAGS: -lpaddle_tpu_capi
+// #include <stdlib.h>
+// extern int PD_Init();
+// extern void PD_Finalize();
+// extern const char* PD_GetLastError();
+import "C"
+
+import "errors"
+
+// Init boots the embedded interpreter; idempotent, call before anything.
+func Init() error {
+	if C.PD_Init() != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// Finalize tears the interpreter down (optional; process exit suffices).
+func Finalize() { C.PD_Finalize() }
+
+func lastError() error {
+	msg := C.GoString(C.PD_GetLastError())
+	if msg == "" {
+		msg = "unknown paddle_tpu capi error"
+	}
+	return errors.New(msg)
+}

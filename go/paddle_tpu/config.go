@@ -1,0 +1,35 @@
+// Reference parity: /root/reference/go/paddle/config.go AnalysisConfig.
+// The TPU predictor needs only the model directory (save_inference_model
+// output); the cudnn/TensorRT/MKLDNN toggles of the reference are
+// absorbed by XLA compilation, and the setters are accepted as no-ops so
+// reference call sites port unchanged.
+package paddle_tpu
+
+// AnalysisConfig mirrors the reference's config surface.
+type AnalysisConfig struct {
+	modelDir     string
+	irOptim      bool
+	cpuMathNum   int
+	switchBlobs  bool
+}
+
+func NewAnalysisConfig() *AnalysisConfig {
+	return &AnalysisConfig{irOptim: true}
+}
+
+// SetModel points at a save_inference_model directory.
+func (c *AnalysisConfig) SetModel(model string, params ...string) {
+	c.modelDir = model
+}
+
+func (c *AnalysisConfig) ModelDir() string { return c.modelDir }
+
+func (c *AnalysisConfig) SwitchIrOptim(x bool)    { c.irOptim = x }
+func (c *AnalysisConfig) IrOptim() bool           { return c.irOptim }
+func (c *AnalysisConfig) EnableUseGpu(mb, id int) {} // XLA owns devices
+func (c *AnalysisConfig) DisableGpu()             {}
+func (c *AnalysisConfig) SetCpuMathLibraryNumThreads(n int) {
+	c.cpuMathNum = n
+}
+func (c *AnalysisConfig) SwitchSpecifyInputNames(bool) {} // always named
+func (c *AnalysisConfig) EnableMemoryOptim()           {}

@@ -1,0 +1,166 @@
+// Reference parity: /root/reference/go/paddle/predictor.go — the Go
+// Predictor over the C ABI (NewPredictor / input-output introspection /
+// SetZeroCopyInput / ZeroCopyRun / GetZeroCopyOutput), retargeted at
+// libpaddle_tpu_capi.so. One XLA compile per (model, input shapes); later
+// Run() calls dispatch the cached executable.
+package paddle_tpu
+
+// #include <stdlib.h>
+// extern void* PD_CreatePredictor(const char* model_dir);
+// extern void PD_DeletePredictor(void* pred);
+// extern int PD_GetInputNum(void* pred);
+// extern int PD_GetOutputNum(void* pred);
+// extern const char* PD_GetInputName(void* pred, int i);
+// extern const char* PD_GetOutputName(void* pred, int i);
+// extern int PD_SetInputFloat(void* pred, const char* name,
+//                             const float* data, const long long* shape,
+//                             int ndim);
+// extern int PD_SetInputInt64(void* pred, const char* name,
+//                             const long long* data,
+//                             const long long* shape, int ndim);
+// extern int PD_Run(void* pred);
+// extern int PD_GetOutputNdim(void* pred, const char* name);
+// extern int PD_GetOutputShape(void* pred, const char* name,
+//                              long long* shape_out);
+// extern int PD_CopyOutputFloat(void* pred, const char* name, float* buf,
+//                               long long numel);
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"unsafe"
+)
+
+type Predictor struct {
+	c unsafe.Pointer
+}
+
+// NewPredictor loads a save_inference_model directory and compiles the
+// program for the first Run's shapes.
+func NewPredictor(config *AnalysisConfig) (*Predictor, error) {
+	if err := Init(); err != nil {
+		return nil, err
+	}
+	dir := C.CString(config.ModelDir())
+	defer C.free(unsafe.Pointer(dir))
+	h := C.PD_CreatePredictor(dir)
+	if h == nil {
+		return nil, lastError()
+	}
+	p := &Predictor{c: h}
+	runtime.SetFinalizer(p, func(q *Predictor) { q.Delete() })
+	return p, nil
+}
+
+func DeletePredictor(p *Predictor) { p.Delete() }
+
+func (p *Predictor) Delete() {
+	if p.c != nil {
+		C.PD_DeletePredictor(p.c)
+		p.c = nil
+	}
+}
+
+func (p *Predictor) GetInputNum() int  { return int(C.PD_GetInputNum(p.c)) }
+func (p *Predictor) GetOutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+
+func (p *Predictor) GetInputName(i int) string {
+	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) GetOutputName(i int) string {
+	return C.GoString(C.PD_GetOutputName(p.c, C.int(i)))
+}
+
+func (p *Predictor) GetInputNames() []string {
+	names := make([]string, p.GetInputNum())
+	for i := range names {
+		names[i] = p.GetInputName(i)
+	}
+	return names
+}
+
+func (p *Predictor) GetOutputNames() []string {
+	names := make([]string, p.GetOutputNum())
+	for i := range names {
+		names[i] = p.GetOutputName(i)
+	}
+	return names
+}
+
+// SetZeroCopyInput stages one named input for the next Run.
+func (p *Predictor) SetZeroCopyInput(t *ZeroCopyTensor) error {
+	name := C.CString(t.Name)
+	defer C.free(unsafe.Pointer(name))
+	var shapePtr *C.longlong
+	if len(t.Shape) > 0 {
+		shapePtr = (*C.longlong)(unsafe.Pointer(&t.Shape[0]))
+	}
+	var rc C.int
+	switch t.Dtype {
+	case Float32:
+		if int64(len(t.FloatData)) != t.numel() {
+			return fmt.Errorf("input %q: %d values for shape %v",
+				t.Name, len(t.FloatData), t.Shape)
+		}
+		var data *C.float
+		if len(t.FloatData) > 0 { // zero-numel: valid shape, nil payload
+			data = (*C.float)(unsafe.Pointer(&t.FloatData[0]))
+		}
+		rc = C.PD_SetInputFloat(p.c, name, data, shapePtr,
+			C.int(len(t.Shape)))
+	case Int64:
+		if int64(len(t.Int64Data)) != t.numel() {
+			return fmt.Errorf("input %q: %d values for shape %v",
+				t.Name, len(t.Int64Data), t.Shape)
+		}
+		var data *C.longlong
+		if len(t.Int64Data) > 0 {
+			data = (*C.longlong)(unsafe.Pointer(&t.Int64Data[0]))
+		}
+		rc = C.PD_SetInputInt64(p.c, name, data, shapePtr,
+			C.int(len(t.Shape)))
+	default:
+		return fmt.Errorf("input %q: unsupported dtype", t.Name)
+	}
+	if rc != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// ZeroCopyRun executes the compiled program on the staged inputs.
+func (p *Predictor) ZeroCopyRun() error {
+	if C.PD_Run(p.c) != 0 {
+		return lastError()
+	}
+	return nil
+}
+
+// GetZeroCopyOutput fetches a named output (float32) after a Run.
+func (p *Predictor) GetZeroCopyOutput(t *ZeroCopyTensor) error {
+	name := C.CString(t.Name)
+	defer C.free(unsafe.Pointer(name))
+	ndim := int(C.PD_GetOutputNdim(p.c, name))
+	if ndim < 0 {
+		return lastError()
+	}
+	t.Shape = make([]int64, ndim)
+	if ndim > 0 {
+		if C.PD_GetOutputShape(p.c, name,
+			(*C.longlong)(unsafe.Pointer(&t.Shape[0]))) != 0 {
+			return lastError()
+		}
+	}
+	t.Dtype = Float32
+	t.FloatData = make([]float32, t.numel())
+	var buf *C.float
+	if len(t.FloatData) > 0 {
+		buf = (*C.float)(unsafe.Pointer(&t.FloatData[0]))
+	}
+	if C.PD_CopyOutputFloat(p.c, name, buf, C.longlong(t.numel())) != 0 {
+		return lastError()
+	}
+	return nil
+}

@@ -1,0 +1,53 @@
+//go:build capi
+
+// End-to-end Go client test (reference: go/paddle/*_test patterns).
+// Gated behind the `capi` build tag because CI images may lack a Go
+// toolchain and the built C library; run it with:
+//
+//	# export any model via paddle_tpu.static.save_inference_model first
+//	CAPI=$(python -c "from paddle_tpu._native.capi import build_capi; print(build_capi())")
+//	export CGO_LDFLAGS="-L$(dirname $CAPI) -lpaddle_tpu_capi \
+//	  -L$(python3-config --prefix)/lib -lpython3.12"
+//	export LD_LIBRARY_PATH=$(dirname $CAPI):$(python3-config --prefix)/lib
+//	PADDLE_TPU_GO_MODEL=/tmp/go_model go test -tags capi ./...
+package paddle_tpu
+
+import (
+	"os"
+	"testing"
+)
+
+func TestPredictorEndToEnd(t *testing.T) {
+	dir := os.Getenv("PADDLE_TPU_GO_MODEL")
+	if dir == "" {
+		t.Skip("set PADDLE_TPU_GO_MODEL to a save_inference_model dir")
+	}
+	cfg := NewAnalysisConfig()
+	cfg.SetModel(dir)
+	pred, err := NewPredictor(cfg)
+	if err != nil {
+		t.Fatal(err)
+	}
+	defer pred.Delete()
+
+	if pred.GetInputNum() < 1 || pred.GetOutputNum() < 1 {
+		t.Fatalf("io: %d in, %d out", pred.GetInputNum(),
+			pred.GetOutputNum())
+	}
+	in := &ZeroCopyTensor{Name: pred.GetInputName(0)}
+	in.Reshape([]int64{2, 4})
+	in.SetValue(make([]float32, 8))
+	if err := pred.SetZeroCopyInput(in); err != nil {
+		t.Fatal(err)
+	}
+	if err := pred.ZeroCopyRun(); err != nil {
+		t.Fatal(err)
+	}
+	out := &ZeroCopyTensor{Name: pred.GetOutputName(0)}
+	if err := pred.GetZeroCopyOutput(out); err != nil {
+		t.Fatal(err)
+	}
+	if len(out.FloatData) == 0 {
+		t.Fatal("empty output")
+	}
+}

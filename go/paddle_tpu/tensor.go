@@ -1,0 +1,47 @@
+// Reference parity: /root/reference/go/paddle/tensor.go ZeroCopyTensor.
+// The TPU C ABI copies at the boundary (host<->device staging makes true
+// zero-copy meaningless), so this Tensor is a plain (name, shape, data)
+// record with float32/int64 payloads — the two dtypes the reference
+// client marshals most.
+package paddle_tpu
+
+type DataType int
+
+const (
+	Float32 DataType = iota
+	Int64
+)
+
+// ZeroCopyTensor keeps the reference's type name so call sites port.
+type ZeroCopyTensor struct {
+	Name      string
+	Shape     []int64
+	Dtype     DataType
+	FloatData []float32
+	Int64Data []int64
+}
+
+// Reshape sets the tensor shape (reference method).
+func (t *ZeroCopyTensor) Reshape(shape []int64) { t.Shape = shape }
+
+// SetValue populates the payload from a typed slice.
+func (t *ZeroCopyTensor) SetValue(v interface{}) {
+	switch x := v.(type) {
+	case []float32:
+		t.Dtype = Float32
+		t.FloatData = x
+	case []int64:
+		t.Dtype = Int64
+		t.Int64Data = x
+	default:
+		panic("ZeroCopyTensor.SetValue: want []float32 or []int64")
+	}
+}
+
+func (t *ZeroCopyTensor) numel() int64 {
+	n := int64(1)
+	for _, d := range t.Shape {
+		n *= d
+	}
+	return n
+}

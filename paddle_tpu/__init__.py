@@ -202,6 +202,7 @@ from . import jit_api as jit  # noqa: E402  (paddle.jit.to_static/save/load)
 from .hapi import Model  # noqa: E402
 from .hapi.model import summary  # noqa: E402  (hapi/model_summary.py)
 from . import vision  # noqa: E402
+from . import text  # noqa: E402  (text datasets: imdb/imikolov/wmt/conll05)
 from . import profiler  # noqa: E402
 from . import distribution  # noqa: E402
 from . import errors  # noqa: E402  (platform/enforce.h error taxonomy)

@@ -33,9 +33,12 @@ long long pt_multislot_parse(const char* buf, long long len,
     while (pos < len && (buf[pos] == '\n' || buf[pos] == '\r')) pos++;
     if (pos >= len) break;
     for (int s = 0; s < n_slots; s++) {
-      // parse the count token ('\r' = truncated CRLF line, also an error)
+      // parse the count token; '\r' = truncated CRLF line, and '\f'/'\v'
+      // would be silently eaten by strtoll's own isspace() skip (possibly
+      // across the newline) — all are malformed here
       while (pos < len && (buf[pos] == ' ' || buf[pos] == '\t')) pos++;
-      if (pos >= len || buf[pos] == '\n' || buf[pos] == '\r')
+      if (pos >= len || buf[pos] == '\n' || buf[pos] == '\r' ||
+          buf[pos] == '\f' || buf[pos] == '\v')
         return -(1 + pos);
       char* end = nullptr;
       long long cnt = strtoll(buf + pos, &end, 10);
@@ -47,7 +50,8 @@ long long pt_multislot_parse(const char* buf, long long len,
       }
       for (long long v = 0; v < cnt; v++) {
         while (pos < len && (buf[pos] == ' ' || buf[pos] == '\t')) pos++;
-        if (pos >= len || buf[pos] == '\n' || buf[pos] == '\r')
+        if (pos >= len || buf[pos] == '\n' || buf[pos] == '\r' ||
+            buf[pos] == '\f' || buf[pos] == '\v')
           return -(1 + pos);
         if (slot_is_float[s]) {
           float val = strtof(buf + pos, &end);

@@ -10,6 +10,7 @@ TPU-native: strategies configure mesh geometry + step transformations
 rewriting a program IR with meta-optimizers.
 """
 from .base import (  # noqa: F401
+    Role,
     DistributedStrategy,
     Fleet,
     PaddleCloudRoleMaker,
@@ -40,3 +41,9 @@ distributed_optimizer = fleet.distributed_optimizer
 distributed_model = fleet.distributed_model
 state_dict = fleet.state_dict
 minimize = fleet.minimize
+shutdown_server = fleet.shutdown_server
+embedding_table = fleet.embedding_table
+
+
+def __getattr__(name):  # live singleton state (e.g. _ps_clients)
+    return getattr(fleet, name)

@@ -81,6 +81,14 @@ class ShardingConfig:
     stage: int = 1
 
 
+class ASyncConfig:
+    """a_sync_configs: k_steps==0 → async push per step; k_steps>0 → geo
+    mode, deltas pushed every k trainer steps (geo_sgd_transpiler.py)."""
+
+    def __init__(self):
+        self.k_steps = 0
+
+
 class DistributedStrategy:
     """Mutable strategy bag, field names matching the reference proto."""
 
@@ -103,6 +111,7 @@ class DistributedStrategy:
         self.lamb = False
         self.lamb_configs = LambConfig()
         self.a_sync = False
+        self.a_sync_configs = ASyncConfig()
         self.elastic = False
         self.auto = False
         self.nccl_comm_num = 1  # accepted, meaningless on TPU
@@ -170,12 +179,21 @@ class PaddleCloudRoleMaker(RoleMakerBase):
         return self._env.trainer_endpoints
 
 
+class Role:
+    """role_maker.py Role enum parity."""
+
+    WORKER = 1
+    SERVER = 2
+
+
 class UserDefinedRoleMaker(RoleMakerBase):
     def __init__(self, current_id=0, role=None, worker_num=1,
                  server_endpoints=None, is_collective=True, **kwargs):
         super().__init__()
         self._current_id = current_id
+        self._role = role
         self._worker_num = worker_num
+        self._server_endpoints = list(server_endpoints or [])
         self._is_collective = is_collective
 
     def worker_index(self):
@@ -183,6 +201,18 @@ class UserDefinedRoleMaker(RoleMakerBase):
 
     def worker_num(self):
         return self._worker_num
+
+    def is_worker(self):
+        return self._role in (None, Role.WORKER, "WORKER", "worker")
+
+    def is_server(self):
+        return self._role in (Role.SERVER, "SERVER", "server")
+
+    def server_index(self):
+        return self._current_id
+
+    def get_pserver_endpoints(self):
+        return self._server_endpoints
 
 
 class Fleet:
@@ -241,7 +271,8 @@ class Fleet:
         return len(self._role_maker.get_pserver_endpoints())
 
     def server_index(self):
-        return 0
+        idx = getattr(self._role_maker, "server_index", None)
+        return idx() if callable(idx) else 0
 
     def server_endpoints(self, to_string=False):
         eps = self._role_maker.get_pserver_endpoints()
@@ -251,24 +282,97 @@ class Fleet:
         return self._role_maker.is_server()
 
     def barrier_worker(self):
+        # PS mode: the table server hosts the n-party fence
+        # (distribute_transpiler sync-mode barrier); collective otherwise
+        if getattr(self, "_ps_clients", None):
+            self._ps_barrier_seq = getattr(self, "_ps_barrier_seq", 0) + 1
+            self._ps_clients[0].barrier(
+                f"fleet_worker_{self._ps_barrier_seq}", self.worker_num()
+            )
+            return
         from .. import collective
 
         collective.barrier()
 
+    # -- parameter-server lifecycle (fleet_base.py PS surface) --------------
     def init_worker(self):
-        pass
+        """Connect to every table server (communicator startup)."""
+        eps = self.server_endpoints()
+        if eps:
+            from ..ps import PSClient
+
+            self._ps_clients = [PSClient(ep) for ep in eps]
+        return getattr(self, "_ps_clients", None)
 
     def init_server(self, *args, **kwargs):
-        pass
+        pass  # tables are created lazily on first client create_table
 
     def run_server(self):
-        raise NotImplementedError(
-            "parameter-server mode is not part of the TPU runtime "
-            "(SURVEY.md §7: PS/grpc path deferred — orthogonal to TPU)"
-        )
+        """Serve the sparse tables on this role's endpoint — blocking
+        (listen_and_serv_op loop). Call from a SERVER-role process."""
+        from ..ps import TableServer
+
+        eps = self.server_endpoints()
+        if not eps:
+            raise RuntimeError(
+                "run_server needs server_endpoints on the role maker "
+                "(UserDefinedRoleMaker(role=Role.SERVER, "
+                "server_endpoints=[...]))"
+            )
+        ep = eps[self.server_index()]
+        host, port = ep.rsplit(":", 1)
+        self._ps_server = TableServer(port=int(port), host=host).start()
+        self._ps_server.join()
 
     def stop_worker(self):
-        pass
+        for c in getattr(self, "_ps_clients", None) or []:
+            c.close()
+        self._ps_clients = None
+
+    def shutdown_server(self):
+        """First worker tears the servers down after training."""
+        eps = self.server_endpoints()
+        if eps:
+            from ..ps import PSClient
+
+            for ep in eps:
+                try:
+                    PSClient(ep, timeout=5.0).shutdown_server()
+                except (ConnectionError, OSError):
+                    pass
+
+    def _all_gather(self, value):
+        """Gather one scalar from every worker (PS-mode collective used
+        by InMemoryDataset.global_shuffle's same-corpus check). Each
+        worker writes its value into a reserved blackboard table row,
+        everyone fences on the PS barrier, then reads all rows."""
+        clients = getattr(self, "_ps_clients", None)
+        if not clients:
+            if self.worker_num() <= 1:
+                return [value]
+            return None  # no PS channel: caller treats as unknown
+        from ..ps import ShardedTable
+
+        self._ps_ag_seq = getattr(self, "_ps_ag_seq", 0) + 1
+        seq, n = self._ps_ag_seq, self.worker_num()
+        table = ShardedTable("__fleet_allgather", 1, clients, init_std=0.0)
+        row = seq * n + self.worker_index()
+        table.push_delta([row], [[float(value)]])
+        clients[0].barrier(f"__allgather_{seq}", n)
+        rows = table.pull([seq * n + i for i in range(n)])
+        return [float(r[0]) for r in rows]
+
+    def embedding_table(self, name, dim, init_std=0.01, optimizer="sgd"):
+        """Create/attach the sharded sparse table view
+        (distributed_lookup_table surface; shards stripe id % n_servers)."""
+        if not getattr(self, "_ps_clients", None):
+            raise RuntimeError("call fleet.init_worker() first")
+        from ..ps import ShardedTable
+
+        return ShardedTable(
+            name, dim, self._ps_clients, init_std=init_std,
+            optimizer=optimizer,
+        )
 
     def save_inference_model(self, executor, dirname, feeded_var_names,
                              target_vars, main_program=None, **kwargs):
@@ -317,8 +421,10 @@ class DistributedOptimizer:
     StrategyCompiler composes meta-optimizers, base/strategy_compiler.py):
 
     - validation happens eagerly at construction — unimplementable flags
-      (dgc, a_sync) raise here, never silently no-op
-      (parallel.train.consume_strategy);
+      (dgc) raise here, never silently no-op
+      (parallel.train.consume_strategy); a_sync selects parameter-server
+      mode (distributed/ps) — independent dense steps per trainer, sparse
+      tables synced through the table servers;
     - ``lars``/``lamb`` swap the update rule the way
       meta_optimizers/lars_optimizer.py replaces Momentum→LarsMomentum;
     - ``gradient_merge`` works in eager ``step()``/``minimize()`` too:
@@ -335,7 +441,7 @@ class DistributedOptimizer:
 
         self._fleet = fleet_obj
         self.user_defined_strategy = strategy
-        self._opts = consume_strategy(strategy)  # raises on dgc/a_sync
+        self._opts = consume_strategy(strategy)  # raises on dgc
         self.inner_opt = self._maybe_swap_update_rule(inner, strategy)
         self._gm_k = self._opts.get("grad_accum_steps", 1) or 1
         self._gm_avg = self._opts.get("grad_accum_avg", True)

@@ -1,0 +1,23 @@
+"""Parameter-server training mode (sync/async + geo).
+
+Reference parity map:
+- table server + lazy sparse rows  → `server.py`
+  (rpc_server.h, large_scale_kv.h, listen_and_serv_op.cc)
+- trainer client + id-hash shards  → `client.py`
+  (communicator.cc, distribute_transpiler.py sparse splits)
+- lookup + grad push / geo deltas  → `embedding.py`
+  (distributed_lookup_table_op.cc, geo_sgd_transpiler.py)
+- fleet wiring (run_server/init_worker/a_sync strategy)
+  → distributed/fleet/base.py
+
+See tests/test_ps.py for the 1-server/2-trainer subprocess proof
+(test_dist_base.py:506 pattern).
+"""
+from .client import PSClient, ShardedTable  # noqa: F401
+from .embedding import GeoPSEmbedding, PSEmbedding  # noqa: F401
+from .server import TableServer, serve_forever  # noqa: F401
+
+__all__ = [
+    "TableServer", "serve_forever", "PSClient", "ShardedTable",
+    "PSEmbedding", "GeoPSEmbedding",
+]

@@ -1,0 +1,133 @@
+"""Parameter-server client + sharded table view.
+
+Reference parity: operators/distributed/communicator.cc (the trainer-side
+send/recv machinery) + distributed_lookup_table_op.cc (pull rows by id
+from the server holding each shard). Multiple servers shard a table by
+``id % n_servers`` exactly like the reference's hash distribution
+(distribute_transpiler.py _get_splited_vars for sparse tables).
+"""
+from __future__ import annotations
+
+import socket
+import threading
+
+import numpy as np
+
+from .server import _recv_msg, _send_msg
+
+__all__ = ["PSClient", "ShardedTable"]
+
+
+class PSClient:
+    """One TCP connection to one table server; thread-safe."""
+
+    def __init__(self, endpoint, timeout=60.0):
+        host, port = endpoint.rsplit(":", 1)
+        self.endpoint = endpoint
+        self._sock = socket.create_connection(
+            (host, int(port)), timeout=timeout
+        )
+        self._lock = threading.Lock()
+
+    def request(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply is None:
+            raise ConnectionError(f"PS {self.endpoint} closed connection")
+        status, payload = reply
+        if status != "ok":
+            raise RuntimeError(f"PS {self.endpoint}: {payload}")
+        return payload
+
+    def create_table(self, name, dim, init_std=0.01, optimizer="sgd"):
+        return self.request("create_table", name, dim, init_std, optimizer)
+
+    def pull(self, name, ids):
+        return self.request("pull", name, np.asarray(ids, np.int64))
+
+    def push_grad(self, name, ids, grads, lr):
+        return self.request(
+            "push_grad", name, np.asarray(ids, np.int64),
+            np.asarray(grads, np.float32), float(lr),
+        )
+
+    def push_delta(self, name, ids, deltas):
+        return self.request(
+            "push_delta", name, np.asarray(ids, np.int64),
+            np.asarray(deltas, np.float32),
+        )
+
+    def dump(self, name):
+        return self.request("dump", name)
+
+    def barrier(self, token, n):
+        return self.request("barrier", token, n)
+
+    def stats(self):
+        return self.request("stats")
+
+    def shutdown_server(self):
+        try:
+            return self.request("shutdown")
+        except (ConnectionError, OSError):
+            return None
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class ShardedTable:
+    """A table striped over n servers by ``id % n`` (the transpiler's
+    sparse split). All PSEmbedding traffic goes through this view."""
+
+    def __init__(self, name, dim, clients, init_std=0.01, optimizer="sgd"):
+        self.name = name
+        self.dim = int(dim)
+        self.clients = list(clients)
+        for c in self.clients:
+            c.create_table(name, dim, init_std, optimizer)
+
+    def _shard(self, ids):
+        ids = np.asarray(ids, np.int64)
+        n = len(self.clients)
+        return [(s, np.nonzero(ids % n == s)[0]) for s in range(n)]
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64)
+        out = np.zeros((len(ids), self.dim), np.float32)
+        for s, idx in self._shard(ids):
+            if len(idx):
+                out[idx] = self.clients[s].pull(self.name, ids[idx])
+        return out
+
+    def push_grad(self, ids, grads, lr):
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        for s, idx in self._shard(ids):
+            if len(idx):
+                self.clients[s].push_grad(
+                    self.name, ids[idx], grads[idx], lr
+                )
+
+    def push_delta(self, ids, deltas):
+        ids = np.asarray(ids, np.int64)
+        deltas = np.asarray(deltas, np.float32)
+        for s, idx in self._shard(ids):
+            if len(idx):
+                self.clients[s].push_delta(self.name, ids[idx], deltas[idx])
+
+    def dump(self):
+        all_ids, all_rows = [], []
+        for c in self.clients:
+            ids, rows = c.dump(self.name)
+            all_ids.append(ids)
+            all_rows.append(rows)
+        ids = np.concatenate(all_ids)
+        rows = (np.concatenate(all_rows) if len(ids)
+                else np.zeros((0, self.dim), np.float32))
+        order = np.argsort(ids)
+        return ids[order], rows[order]

@@ -1,0 +1,125 @@
+"""Trainer-side PS embedding — the distributed_lookup_table equivalent.
+
+Reference parity: operators/distributed_ops/distributed_lookup_table_op.cc
+(forward pulls rows by id) + the transpiler-inserted send ops that ship
+the sparse gradient back after backward (distribute_transpiler.py:256),
+and geo_sgd_transpiler.py for geo mode.
+
+TPU-native split: the DENSE math of the step stays on the TPU (eager or
+compiled); the sparse pull/push is host-side numpy against the table
+shards. The pulled rows enter autograd as a leaf tensor, so the row
+gradient falls out of loss.backward() with no extra machinery; push_step
+ships it. This keeps the giant table off the chip — the point of PS mode
+— while the per-batch working set rides the normal device path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...nn.layer_base import Layer
+from ... import ops
+from .client import ShardedTable
+
+__all__ = ["PSEmbedding", "GeoPSEmbedding"]
+
+
+class PSEmbedding(Layer):
+    """Sync/async-mode PS embedding.
+
+    forward(ids) pulls the batch's unique rows from the table shards and
+    gathers on device; after loss.backward(), ``push_step(lr)`` ships the
+    accumulated row gradients (one server-side update per unique id).
+    Sync mode is obtained by calling ``table-server barrier`` between
+    steps via fleet (the trainer loop in tests shows the pattern).
+    """
+
+    def __init__(self, table: ShardedTable):
+        super().__init__()
+        self.table = table
+        self._pending = []  # (unique_ids, rows_tensor)
+
+    def forward(self, ids):
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, np.int64
+        )
+        uniq, inverse = np.unique(ids_np, return_inverse=True)
+        rows = self.table.pull(uniq)  # [U, dim] host pull
+        rows_t = Tensor(rows, stop_gradient=False)
+        self._pending.append((uniq, rows_t))
+        idx_t = Tensor(inverse.reshape(ids_np.shape).astype(np.int64))
+        return ops.embedding(idx_t, rows_t)
+
+    def push_step(self, lr):
+        """Ship row grads from the last backward; clears the pull cache."""
+        for uniq, rows_t in self._pending:
+            g = rows_t.grad
+            if g is not None:
+                self.table.push_grad(uniq, np.asarray(g.numpy()), lr)
+        self._pending.clear()
+
+
+class GeoPSEmbedding(Layer):
+    """Geo-SGD-mode PS embedding (geo_sgd_transpiler.py semantics).
+
+    The trainer keeps a LOCAL replica of the rows it touches and applies
+    SGD locally every step (fast, no network on the hot path). Every
+    ``k_steps`` trainer steps, the accumulated delta (local - base) is
+    pushed to the server (which ADDS it — deltas from different trainers
+    merge additively) and fresh rows are pulled back.
+    """
+
+    def __init__(self, table: ShardedTable, k_steps=4):
+        super().__init__()
+        self.table = table
+        self.k_steps = int(k_steps)
+        self._local = {}   # id -> current local row
+        self._base = {}    # id -> row value at last sync
+        self._pending = []
+        self._step = 0
+
+    def _local_rows(self, uniq):
+        missing = [i for i in uniq if int(i) not in self._local]
+        if missing:
+            pulled = self.table.pull(np.asarray(missing, np.int64))
+            for i, r in zip(missing, pulled):
+                self._local[int(i)] = r.copy()
+                self._base[int(i)] = r.copy()
+        return np.stack([self._local[int(i)] for i in uniq])
+
+    def forward(self, ids):
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids, np.int64
+        )
+        uniq, inverse = np.unique(ids_np, return_inverse=True)
+        rows_t = Tensor(self._local_rows(uniq), stop_gradient=False)
+        self._pending.append((uniq, rows_t))
+        idx_t = Tensor(inverse.reshape(ids_np.shape).astype(np.int64))
+        return ops.embedding(idx_t, rows_t)
+
+    def push_step(self, lr):
+        """Local SGD update; every k-th call syncs deltas with the PS."""
+        for uniq, rows_t in self._pending:
+            g = rows_t.grad
+            if g is None:
+                continue
+            g = np.asarray(g.numpy())
+            for j, i in enumerate(uniq):
+                self._local[int(i)] = self._local[int(i)] - lr * g[j]
+        self._pending.clear()
+        self._step += 1
+        if self._step % self.k_steps == 0:
+            self._sync()
+
+    def _sync(self):
+        if not self._local:
+            return
+        ids = np.asarray(sorted(self._local), np.int64)
+        delta = np.stack(
+            [self._local[int(i)] - self._base[int(i)] for i in ids]
+        )
+        self.table.push_delta(ids, delta)
+        fresh = self.table.pull(ids)
+        for i, r in zip(ids, fresh):
+            self._local[int(i)] = r.copy()
+            self._base[int(i)] = r.copy()

@@ -1,0 +1,253 @@
+"""Parameter-server runtime: the table server.
+
+Reference parity: paddle/fluid/operators/distributed/ — rpc_server.h
+(request_handler loop), large_scale_kv.h (lazily-initialized sparse
+rows + per-row optimizer state), listen_and_serv_op.cc (the server op),
+and the sync barrier of the sync-mode transpiler
+(distribute_transpiler.py:256).
+
+TPU-native redesign: the PS holds what does NOT belong on a TPU chip —
+huge, sparsely-touched embedding tables living in host RAM. The transport
+is a plain length-prefixed-pickle TCP loop (python threads; the grpc/brpc
+machinery of the reference collapses because there are no zero-copy GPU
+buffers to negotiate — rows are small numpy slabs). Dense parameters stay
+on the TPU path (collectives over ICI); ONLY the sparse half goes through
+the PS, which is also the reference's recommended large-scale layout.
+
+Row updates:
+- sync/async ("sgd"/"adagrad"): trainers push per-row gradients, the
+  server applies the update rule under the table lock; sync mode adds a
+  per-step named barrier so all trainers' pushes land before the next
+  pull (the Barrier monitor of distribute_transpiler sync mode).
+- geo ("delta"): trainers train a local replica and push accumulated
+  deltas; the server adds them (geo_sgd_transpiler.py semantics).
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["TableServer", "serve_forever"]
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<q", len(payload)) + payload)
+
+
+class _Table:
+    """One sparse table: id -> (row, opt_state), lazily initialized
+    (large_scale_kv.h's init-on-first-touch)."""
+
+    def __init__(self, dim, init_std=0.01, optimizer="sgd", seed=0):
+        self.dim = int(dim)
+        self.init_std = float(init_std)
+        self.optimizer = optimizer
+        self.rows = {}
+        self.accum = {}  # adagrad state
+        self.lock = threading.RLock()
+        self._rng = np.random.RandomState(seed)
+
+    def _row(self, i):
+        r = self.rows.get(i)
+        if r is None:
+            r = (self._rng.randn(self.dim) * self.init_std).astype(
+                np.float32
+            )
+            self.rows[i] = r
+        return r
+
+    def pull(self, ids):
+        with self.lock:
+            return np.stack([self._row(int(i)) for i in ids])
+
+    def push_grad(self, ids, grads, lr):
+        with self.lock:
+            # duplicate ids in one push: accumulate (reference
+            # MergeAdd semantics for SelectedRows)
+            uniq = {}
+            for i, g in zip(ids, grads):
+                i = int(i)
+                uniq[i] = uniq.get(i, 0.0) + g
+            for i, g in uniq.items():
+                row = self._row(i)
+                if self.optimizer == "adagrad":
+                    a = self.accum.setdefault(
+                        i, np.zeros(self.dim, np.float32)
+                    )
+                    a += g * g
+                    row -= lr * g / (np.sqrt(a) + 1e-6)
+                else:  # sgd
+                    row -= lr * g
+
+    def push_delta(self, ids, deltas):
+        with self.lock:
+            for i, d in zip(ids, deltas):
+                self._row(int(i))
+                self.rows[int(i)] = self.rows[int(i)] + d
+
+    def dump(self):
+        with self.lock:
+            if not self.rows:
+                return np.zeros(0, np.int64), np.zeros(
+                    (0, self.dim), np.float32
+                )
+            ids = np.asarray(sorted(self.rows), np.int64)
+            return ids, np.stack([self.rows[int(i)] for i in ids])
+
+
+class TableServer:
+    """listen_and_serv_op equivalent: a threaded TCP table service."""
+
+    def __init__(self, port=0, host="127.0.0.1"):
+        self._tables = {}
+        self._tables_lock = threading.RLock()
+        self._barriers = {}  # token -> [count, threading.Condition]
+        self._barrier_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.endpoint = "%s:%d" % self._sock.getsockname()[:2]
+        self._threads = []
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self):
+        t = threading.Thread(target=self._accept_loop, daemon=True)
+        t.start()
+        self._threads.append(t)
+        return self
+
+    def join(self):
+        """Block until shutdown (Fleet.run_server's serve loop)."""
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    # -- serving -------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                break
+            t = threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _serve_conn(self, conn):
+        try:
+            while not self._stop.is_set():
+                msg = _recv_msg(conn)
+                if msg is None:
+                    return
+                try:
+                    reply = self._handle(msg)
+                except Exception as e:  # structured error back to client
+                    reply = ("err", f"{type(e).__name__}: {e}")
+                _send_msg(conn, reply)
+                if msg[0] == "shutdown":
+                    return
+        finally:
+            conn.close()
+
+    def _handle(self, msg):
+        op = msg[0]
+        if op == "create_table":
+            _, name, dim, init_std, optimizer = msg
+            with self._tables_lock:
+                if name not in self._tables:
+                    self._tables[name] = _Table(dim, init_std, optimizer)
+                t = self._tables[name]
+                if t.dim != int(dim):
+                    raise ValueError(
+                        f"table {name!r} exists with dim {t.dim}"
+                    )
+            return ("ok", None)
+        if op == "pull":
+            _, name, ids = msg
+            return ("ok", self._tables[name].pull(ids))
+        if op == "push_grad":
+            _, name, ids, grads, lr = msg
+            self._tables[name].push_grad(ids, grads, lr)
+            return ("ok", None)
+        if op == "push_delta":
+            _, name, ids, deltas = msg
+            self._tables[name].push_delta(ids, deltas)
+            return ("ok", None)
+        if op == "dump":
+            _, name = msg
+            return ("ok", self._tables[name].dump())
+        if op == "barrier":
+            _, token, n = msg
+            self._barrier(token, int(n))
+            return ("ok", None)
+        if op == "stats":
+            with self._tables_lock:
+                return ("ok", {
+                    name: len(t.rows) for name, t in self._tables.items()
+                })
+        if op == "shutdown":
+            self.stop()
+            return ("ok", None)
+        raise ValueError(f"unknown PS op {op!r}")
+
+    def _barrier(self, token, n):
+        """Named n-party barrier (sync-mode per-step fence). A shutdown
+        while parties are parked ABORTS the fence with an error — a
+        success reply would silently void the sync-mode guarantee."""
+        with self._barrier_lock:
+            ent = self._barriers.setdefault(
+                token, [0, threading.Condition(self._barrier_lock)]
+            )
+            ent[0] += 1
+            if ent[0] >= n:
+                self._barriers.pop(token, None)
+                ent[1].notify_all()
+                return
+            cond = ent[1]
+            while token in self._barriers and not self._stop.is_set():
+                cond.wait(timeout=0.5)
+            if self._stop.is_set() and token in self._barriers:
+                raise RuntimeError(
+                    f"barrier {token!r} aborted: server shutting down "
+                    f"with {ent[0]}/{n} parties arrived"
+                )
+
+
+def serve_forever(port=0, host="127.0.0.1", ready_cb=None):
+    """Blocking entry for a dedicated server process."""
+    srv = TableServer(port=port, host=host).start()
+    if ready_cb is not None:
+        ready_cb(srv.endpoint)
+    srv.join()
+    return srv

@@ -353,12 +353,62 @@ def convert_logical_not(x):
     ))
 
 
+_CALLBACK_SUPPORT = {}
+_CALLBACK_WARNED = set()
+
+
+def _callbacks_supported():
+    """Whether the default backend can run host callbacks
+    (jax.debug.print/callback). The axon-tunneled TPU backend rejects
+    host send/recv with UNIMPLEMENTED at run time, so probe once with a
+    tiny jitted program and cache per platform."""
+    platform = jax.default_backend()
+    if platform not in _CALLBACK_SUPPORT:
+        try:
+            v = jax.jit(
+                lambda x: jax.debug.callback(lambda _: None, x) or x
+            )(jnp.zeros(()))
+            jax.block_until_ready(v)
+            _CALLBACK_SUPPORT[platform] = True
+        except Exception:
+            _CALLBACK_SUPPORT[platform] = False
+    return _CALLBACK_SUPPORT[platform]
+
+
+def _warn_no_callbacks(what):
+    import warnings
+
+    platform = jax.default_backend()
+    key = (platform, what)
+    if key not in _CALLBACK_WARNED:
+        _CALLBACK_WARNED.add(key)
+        warnings.warn(
+            f"traced {what} skipped: backend {platform!r} does not "
+            "support host callbacks (jax.debug.*); values are not "
+            "observable from compiled code on this backend",
+            RuntimeWarning, stacklevel=3,
+        )
+
+
 def convert_print(*args, **kwargs):
     """print_transformer target (dygraph_to_static/print_transformer.py):
     a print over traced values becomes a device-side debug print (the
-    reference lowers to the Print op); plain python print otherwise."""
+    reference lowers to the Print op); plain python print otherwise.
+    The traced path honors sep/end (jax.debug.print emits one line per
+    call, so a non-default end is appended into the payload); the file
+    kwarg only applies on the python path. On backends without host
+    callbacks (the axon TPU tunnel) a traced print degrades to a
+    one-time trace-time warning instead of an UNIMPLEMENTED crash — the
+    reference's Print op is best-effort logging too."""
     if any(_is_traced(a) for a in args):
-        fmt = " ".join(["{}"] * len(args))
+        if not _callbacks_supported():
+            _warn_no_callbacks("print")
+            return
+        sep = kwargs.get("sep", " ")
+        end = kwargs.get("end", "\n")
+        fmt = sep.join(["{}"] * len(args))
+        if end != "\n":
+            fmt += end
         jax.debug.print(fmt, *[_arr(a) for a in args])
     else:
         print(*args, **kwargs)
@@ -367,12 +417,21 @@ def convert_print(*args, **kwargs):
 def convert_assert(cond, msg=None):
     """assert_transformer target: a traced assert becomes a host callback
     that raises when the condition is false at run time (the reference's
-    Assert op PADDLE_ENFORCEs in-kernel); eager asserts stay python."""
+    Assert op PADDLE_ENFORCEs in-kernel); eager asserts stay python.
+
+    On backends without host callbacks (the axon TPU tunnel) the runtime
+    check cannot exist inside the compiled program; the assert degrades
+    to a one-time warning (use FLAGS_check_nan_inf's checkify path for
+    in-program numeric guards there)."""
     if not _is_traced(cond):
         c = _arr(cond)
         ok = bool(np.asarray(c)) if hasattr(c, "dtype") else bool(c)
         if not ok:
             raise AssertionError(msg if msg is not None else "assert failed")
+        return
+
+    if not _callbacks_supported():
+        _warn_no_callbacks("assert")
         return
 
     def _check(ok):
@@ -594,6 +653,14 @@ class _ReturnTransformer(ast.NodeTransformer):
             if isinstance(s, (ast.While, ast.For)) and self._contains_return(s):
                 s.body = self._rewrite(s.body, flag, val, in_loop=True)[0]
                 out.append(s)
+                if in_loop:
+                    # the return exited the INNER loop via break; the
+                    # enclosing loop must stop too, or later outer
+                    # iterations would overwrite the return value
+                    out.append(ast.If(
+                        test=ast.Name(id=flag, ctx=ast.Load()),
+                        body=[ast.Break()], orelse=[],
+                    ))
                 if rest:
                     out.append(_flag_guard(
                         [flag], self._rewrite(rest, flag, val, in_loop)[0]

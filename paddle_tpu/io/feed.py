@@ -70,10 +70,11 @@ def _parse_bytes(buf, slot_is_float):
 
 def _read_file(path, pipe_command=None):
     if pipe_command and pipe_command not in ("cat", "cat ", ""):
-        out = subprocess.run(
-            pipe_command, shell=True, stdin=open(path, "rb"),
-            capture_output=True, check=True,
-        )
+        with open(path, "rb") as f:
+            out = subprocess.run(
+                pipe_command, shell=True, stdin=f,
+                capture_output=True, check=True,
+            )
         return out.stdout
     with open(path, "rb") as f:
         return f.read()

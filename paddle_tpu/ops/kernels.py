@@ -836,10 +836,7 @@ def adaptive_pool2d(x, *, output_size, pooling_type="avg", data_format="NCHW"):
     return _adaptive_pool2d(x, output_size, pooling_type, data_format)
 
 
-from functools import partial as _partial
-
-
-@_partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _bn_train_core(x, scale, bias, epsilon, axes, shape):
     """Training-mode BN with a memory-lean VJP: the backward recomputes
     x-hat from the ORIGINAL (bf16) input instead of letting autodiff save

@@ -1,0 +1,315 @@
+"""The last registry tail: five niche reference ops.
+
+Reference parity (the 100%-coverage set of tools/check_op_coverage.py):
+- bilateral_slice  — operators/bilateral_slice_op.cc (HDRNet grid slice)
+- rank_attention   — operators/rank_attention_op.cc (+ rank_attention.cu.h
+  expand/gemm scheme)
+- var_conv_2d      — operators/var_conv_2d_op.cc (per-sample-size conv)
+- tree_conv        — operators/tree_conv_op.cc + math/tree2col.cc (TBCNN
+  continuous binary tree patches)
+- pyramid_hash     — operators/pyramid_hash_op.cc (n-gram hash embedding)
+
+TPU notes: bilateral_slice / rank_attention / var_conv_2d are pure jnp
+(jit-friendly — gathers + dots on static shapes). tree_conv's patch
+construction is data-dependent graph traversal (the reference runs it on
+CPU, tree2col.cc); the traversal runs host-side on concrete edge sets and
+only the final patch x filter contraction is jnp — under a trace the op
+raises with that explanation. pyramid_hash replaces XXH32 with a
+vectorized FNV-1a over token windows (no xxhash in-image; same
+bucket-spreading role, recorded divergence).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+__all__ = [
+    "bilateral_slice", "rank_attention", "var_conv_2d", "tree_conv",
+    "pyramid_hash",
+]
+
+
+@register_op("bilateral_slice")
+def bilateral_slice(x, grid, guide, *, has_offset=True):
+    """HDRNet bilateral-grid apply (bilateral_slice_op.cc).
+
+    x      [N, Ci, H, W]   input image
+    grid   [N, Cg, D, Gh, Gw]  affine-coeff grid; Cg = Co*(Ci+1) with
+                           offset, Co*Ci without
+    guide  [N, H, W] in [0, 1]  per-pixel grid depth
+    out    [N, Co, H, W]
+    Trilinear-samples the grid at (gx, gy, guide) and applies the sampled
+    per-pixel affine transform.
+    """
+    n, ci, h, w = x.shape
+    _, cg, d, gh, gw = grid.shape
+    co = cg // (ci + 1) if has_offset else cg // ci
+
+    # sample positions in grid space (align like the reference kernel:
+    # gx = (x+0.5)*gw/W - 0.5)
+    gx = (jnp.arange(w) + 0.5) * gw / w - 0.5
+    gy = (jnp.arange(h) + 0.5) * gh / h - 0.5
+    gz = guide * d - 0.5  # [N, H, W]
+
+    fx = jnp.clip(jnp.floor(gx), 0, gw - 2).astype(jnp.int32)  # [W]
+    fy = jnp.clip(jnp.floor(gy), 0, gh - 2).astype(jnp.int32)  # [H]
+    fz = jnp.clip(jnp.floor(gz), 0, d - 2).astype(jnp.int32)   # [N,H,W]
+    wx = jnp.clip(gx - fx, 0.0, 1.0)
+    wy = jnp.clip(gy - fy, 0.0, 1.0)
+    wz = jnp.clip(gz - fz, 0.0, 1.0)
+
+    # 8-corner trilinear gather via advanced indexing
+    out_acc = 0.0
+    nn = jnp.arange(n)[:, None, None]
+    for dz in (0, 1):
+        for dy in (0, 1):
+            for dx in (0, 1):
+                zz = fz + dz                                  # [N,H,W]
+                yy = jnp.broadcast_to(
+                    (fy + dy)[None, :, None], (n, h, w))
+                xx = jnp.broadcast_to(
+                    (fx + dx)[None, None, :], (n, h, w))
+                g = grid[nn, :, zz, yy, xx]                   # [N,H,W,Cg]
+                wgt = (
+                    (wz if dz else (1 - wz))
+                    * (wy if dy else (1 - wy))[None, :, None]
+                    * (wx if dx else (1 - wx))[None, None, :]
+                )
+                out_acc = out_acc + g * wgt[..., None]
+    coeff = out_acc  # [N, H, W, Cg]
+
+    xs = jnp.moveaxis(x, 1, -1)  # [N,H,W,Ci]
+    per_in = ci + 1 if has_offset else ci
+    coeff = coeff.reshape(n, h, w, co, per_in)
+    out = jnp.einsum("nhwoc,nhwc->nhwo", coeff[..., :ci], xs)
+    if has_offset:
+        out = out + coeff[..., ci]
+    return jnp.moveaxis(out, -1, 1)
+
+
+@register_op("rank_attention", num_outputs=3)
+def rank_attention(x, rank_offset, rank_param, *, max_rank=3,
+                   rank_param_shape=None):
+    """rank_attention_op.cc: per-instance parameter selection by rank
+    pairs + matmul (the expand-input/expand-param/batched-gemm scheme of
+    rank_attention.cu.h, as one einsum).
+
+    x           [ins, fea]
+    rank_offset int [ins, 1+2*max_rank]: col0 = own rank (1-based; <=0
+                invalid); col(2k+1) = k-th other's rank; col(2k+2) = that
+                instance's row in x
+    rank_param  [n_ranks*max_rank*fea, para_col]
+    returns (out [ins, para_col], input_help, ins_rank)
+    """
+    ins, fea = x.shape
+    para_col = rank_param.shape[1]
+    lower = rank_offset[:, 0] - 1                       # [ins]
+    ks = jnp.arange(max_rank)
+    faster = rank_offset[:, 2 * ks + 1] - 1             # [ins, K]
+    index = rank_offset[:, 2 * ks + 2]                  # [ins, K]
+    valid = (lower[:, None] >= 0) & (faster >= 0)       # [ins, K]
+
+    # expanded input: slot k = x[index_k] (zeros when invalid)
+    xin = x[jnp.clip(index, 0, ins - 1)]                # [ins, K, fea]
+    xin = jnp.where(valid[..., None], xin, 0.0)
+
+    # expanded param: block (lower*max_rank + faster) of shape [fea, col]
+    blocks = rank_param.reshape(-1, fea, para_col)      # [n_blocks, fea, col]
+    bidx = jnp.clip(lower[:, None] * max_rank + faster, 0,
+                    blocks.shape[0] - 1)                # [ins, K]
+    par = jnp.where(valid[..., None, None], blocks[bidx], 0.0)
+
+    out = jnp.einsum("ikf,ikfc->ic", xin, par)
+    ins_rank = jnp.where(
+        rank_offset[:, 0] > 0, rank_offset[:, 0], -1
+    ).astype(x.dtype)[:, None]
+    return out, xin.reshape(ins, max_rank * fea), ins_rank
+
+
+@register_op("var_conv_2d")
+def var_conv_2d(x, w, rows, cols, *, output_channel, input_channel,
+                kernel_h, kernel_w, stride_h=1, stride_w=1):
+    """var_conv_2d_op.cc: conv over per-sample-sized images.
+
+    The reference consumes a LoD-packed batch with per-sample (row, col)
+    lods; the XLA form takes the PADDED batch x [N, Cin, H, W] plus
+    per-sample extents rows/cols [N] and masks both input and output so
+    positions beyond each sample's true size are exactly zero — same
+    math, static shapes.
+    """
+    from . import kernels as _k
+
+    n, cin, hmax, wmax = x.shape
+    rows = jnp.asarray(rows).astype(jnp.int32)
+    cols = jnp.asarray(cols).astype(jnp.int32)
+    hh = jnp.arange(hmax)[None, :]
+    ww = jnp.arange(wmax)[None, :]
+    in_mask = ((hh < rows[:, None])[:, None, :, None]
+               & (ww < cols[:, None])[:, None, None, :])
+    xm = jnp.where(in_mask, x, 0.0)
+    weight = w.reshape(output_channel, input_channel, kernel_h, kernel_w)
+    out = _k.conv2d(
+        xm, weight, stride=(stride_h, stride_w),
+        padding=(kernel_h // 2, kernel_w // 2),
+    )
+    oh = (rows + stride_h - 1) // stride_h
+    ow = (cols + stride_w - 1) // stride_w
+    ho = jnp.arange(out.shape[2])[None, :]
+    wo = jnp.arange(out.shape[3])[None, :]
+    out_mask = ((ho < oh[:, None])[:, None, :, None]
+                & (wo < ow[:, None])[:, None, None, :])
+    return jnp.where(out_mask, out, 0.0)
+
+
+def _tree_patches(edges, n_nodes, max_depth):
+    """tree2col.cc construct_tree + construct_patch on the host: for each
+    root, DFS to max_depth collecting (node, eta_t/l/r) coefficients of
+    the continuous binary tree."""
+    adj = [[] for _ in range(n_nodes + 1)]
+    for a, b in edges:
+        a, b = int(a), int(b)
+        if a <= 0 or b <= 0:
+            continue
+        adj[a].append(b)  # parent -> child, 1-based (tree2col.cc:60)
+
+    def eta(index, pclen, depth, fd):
+        et = (fd - depth) / fd
+        el = (1.0 - et) * (0.5 if pclen == 1
+                           else (index - 1.0) / (pclen - 1.0))
+        er = (1.0 - et) * (1.0 - (0.5 if pclen == 1
+                                  else (index - 1.0) / (pclen - 1.0)))
+        return et, el, er
+
+    patches = []
+    for root in range(1, n_nodes + 1):
+        patch = []
+        stack = [(root, 1, 1, 0)]
+        visited = {root}
+        patch.append((root, 1, 1, 0))
+        while stack:
+            node, idx, pclen, depth = stack[-1]
+            advanced = False
+            children = adj[node]
+            for i, v in enumerate(children):
+                if v not in visited and depth + 1 < max_depth:
+                    visited.add(v)
+                    stack.append((v, i, len(children), depth + 1))
+                    patch.append((v, i + 1, len(children), depth + 1))
+                    advanced = True
+            if not advanced:
+                stack.pop()
+        if patch:
+            rows = []
+            fd = float(max_depth)
+            for node, idx, pclen, depth in patch:
+                et, el, er = eta(idx, pclen, depth, fd)
+                rows.append((node - 1, el, er, et))  # tree2col order l,r,t
+            patches.append(rows)
+    return patches
+
+
+@register_op("tree_conv")
+def tree_conv(nodes_vector, edge_set, filter, *, max_depth=2):
+    """tree_conv_op.cc (TBCNN): per-tree patches → filter contraction.
+
+    nodes_vector [N, n, fea]; edge_set int [N, e, 2] (1-based parent,
+    child; zero rows = padding); filter [fea, 3, out_c, num_filters] or
+    [fea, 3, out_c]; out [N, patches, out_c(, num_filters)].
+
+    The patch construction is data-dependent tree traversal — host-side
+    on concrete arrays (the reference computes it on CPU too,
+    math/tree2col.cc); inside jit this op raises.
+    """
+    if isinstance(nodes_vector, jax.core.Tracer) or isinstance(
+        edge_set, jax.core.Tracer
+    ):
+        raise NotImplementedError(
+            "tree_conv patch construction is data-dependent tree "
+            "traversal; run it eagerly (the reference's kernel is "
+            "CPU-only as well, math/tree2col.cc)"
+        )
+    nv = np.asarray(nodes_vector)
+    es = np.asarray(edge_set)
+    filt = jnp.asarray(filter)
+    squeeze = filt.ndim == 3
+    if squeeze:
+        filt = filt[..., None]
+    fea = nv.shape[2]
+    outs = []
+    max_patches = 0
+    per_batch = []
+    for b in range(nv.shape[0]):
+        patches = _tree_patches(es[b], nv.shape[1], max_depth)
+        # patch matrix [n_patches, fea, 3] with (l, r, t) coefficient sums
+        pm = np.zeros((max(1, len(patches)), fea, 3), np.float32)
+        for pi, rows in enumerate(patches):
+            for node_id, el, er, et in rows:
+                pm[pi] += nv[b, node_id][:, None] * np.asarray(
+                    [el, er, et], np.float32
+                )
+        per_batch.append(pm)
+        max_patches = max(max_patches, pm.shape[0])
+    for pm in per_batch:
+        if pm.shape[0] < max_patches:
+            pm = np.concatenate([
+                pm, np.zeros((max_patches - pm.shape[0], fea, 3),
+                             np.float32)
+            ])
+        outs.append(pm)
+    patch = jnp.asarray(np.stack(outs))  # [N, P, fea, 3]
+    out = jnp.einsum("npft,ftcm->npcm", patch, filt)
+    return out[..., 0] if squeeze else out
+
+
+def _fnv1a(tokens, seed):
+    """Vectorized FNV-1a over int32 token windows [..., L] → uint32.
+    Stands in for the reference's XXH32 (pyramid_hash_op.cc:229)."""
+    h = jnp.uint32(2166136261) ^ jnp.uint32(seed)
+    prime = jnp.uint32(16777619)
+    toks = tokens.astype(jnp.uint32)
+    for k in range(tokens.shape[-1]):
+        for shift in (0, 8, 16, 24):  # byte-wise like the reference hash
+            byte = (toks[..., k] >> shift) & jnp.uint32(0xFF)
+            h = (h ^ byte) * prime
+    return h
+
+
+@register_op("pyramid_hash", num_outputs=2)
+def pyramid_hash(x, w, *, num_emb, space_len, pyramid_layer, rand_len,
+                 white_list_len=0, black_list_len=0, seed=0,
+                 drop_out_percent=0.0, is_training=0, use_filter=False,
+                 lr=0.0, key=None):
+    """pyramid_hash_op.cc: n-gram hash embeddings summed over pyramid
+    levels.
+
+    x [N, L] int token ids (0 = pad); w [space_len + rand_len, 1] the
+    hash-embedding parameter space. For each n-gram length 2..
+    pyramid_layer and window, num_emb/rand_len hash buckets are drawn
+    (FNV-1a here vs the reference's XXH32) and rand_len-wide fragments of
+    w concatenated → [num_emb] per window, summed per sequence.
+    Returns (out [N, num_emb], drop_pos [N, 1] — kept for surface parity,
+    all-ones without dropout).
+    """
+    n, L = x.shape
+    n_frag = num_emb // rand_len
+    acc = jnp.zeros((n, num_emb), jnp.float32)
+    w_flat = w.reshape(-1)
+    for gram in range(2, pyramid_layer + 1):
+        if gram > L:
+            break
+        for start in range(L - gram + 1):
+            window = x[:, start:start + gram]          # [N, gram]
+            valid = jnp.all(window > 0, axis=1)        # pads break grams
+            frags = []
+            for j in range(n_frag):
+                pos = _fnv1a(window, seed + j) % jnp.uint32(space_len)
+                idx = pos[:, None].astype(jnp.int32) + jnp.arange(rand_len)
+                frags.append(w_flat[idx])              # [N, rand_len]
+            emb = jnp.concatenate(frags, axis=1)       # [N, num_emb]
+            acc = acc + jnp.where(valid[:, None], emb, 0.0)
+    drop_pos = jnp.ones((n, 1), jnp.int32)
+    return acc, drop_pos

@@ -62,10 +62,24 @@ def consume_strategy(strategy):
             "communication instead."
         )
     if getattr(strategy, "a_sync", False):
-        raise NotImplementedError(
-            "DistributedStrategy.a_sync requires the parameter-server "
-            "runtime, which is deferred (SURVEY.md §7)."
-        )
+        # parameter-server mode (distributed/ps): trainers run
+        # independent dense steps (no dp collective), sparse tables sync
+        # through the table servers via PSEmbedding/GeoPSEmbedding.
+        # k_steps > 0 in a_sync_configs selects geo mode — the reference's
+        # sync/async/geo triple (distribute_transpiler.py:256,
+        # geo_sgd_transpiler.py).
+        cfg = getattr(strategy, "a_sync_configs", None)
+        # the reference documents both the attr form and plain dict
+        # assignment (strategy.a_sync_configs = {"k_steps": N})
+        k = (cfg.get("k_steps", 0) if isinstance(cfg, dict)
+             else getattr(cfg, "k_steps", 0))
+        return {
+            "a_sync": True,
+            "geo_k_steps": int(k or 0),
+            "recompute": False, "amp": False, "grad_accum_steps": 1,
+            "grad_accum_avg": True, "zero1": False, "localsgd": False,
+            "localsgd_k": 1, "rules": None,
+        }
     if getattr(strategy, "pipeline", False):
         raise NotImplementedError(
             "DistributedStrategy.pipeline cannot split an arbitrary eager "

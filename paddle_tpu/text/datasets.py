@@ -1,0 +1,368 @@
+"""Text dataset implementations. See package docstring for the offline
+synthesis contract.
+
+Reference formats honored when real files are present:
+- Imdb: aclImdb tar.gz with {train,test}/{pos,neg}/*.txt
+  (/root/reference/python/paddle/dataset/imdb.py:1)
+- Imikolov: simple-examples tar.gz ptb.{train,valid}.txt
+  (dataset/imikolov.py)
+- WMT14/WMT16: token-id parallel corpora are synthesized only (the
+  reference downloads preprocessed dicts; no egress here)
+  (dataset/wmt14.py, wmt16.py)
+- Conll05st: SRL tuples, synthesized (dataset/conll05.py)
+- Movielens: ml-1m ratings triples (dataset/movielens.py)
+- UCIHousing: 13-feature regression rows (dataset/uci_housing.py)
+"""
+from __future__ import annotations
+
+import os
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+# shared deterministic word inventory for synthetic corpora
+_POS_WORDS = ["good", "great", "excellent", "wonderful", "best", "love"]
+_NEG_WORDS = ["bad", "awful", "terrible", "boring", "worst", "hate"]
+_NEUTRAL = ["the", "a", "movie", "film", "plot", "actor", "scene", "story",
+            "it", "was", "and", "of", "in", "to"]
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (dataset/imdb.py): samples are (word-id sequence,
+    label 0/1). ``word_idx`` maps token → id (0 reserved for OOV/pad)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, seed=None):
+        self.mode = mode
+        self.synthetic = False
+        data_file = data_file or os.path.join(DATA_HOME, "imdb",
+                                              "aclImdb_v1.tar.gz")
+        if os.path.exists(data_file):
+            self._load_archive(data_file, mode, cutoff)
+        else:
+            self._synthesize(
+                n=512 if mode == "train" else 128,
+                seed=7 if mode == "train" else 8,
+            )
+
+    def _load_archive(self, path, mode, cutoff):
+        import collections
+        import re
+
+        pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq = collections.Counter()
+        docs = []
+        with tarfile.open(path) as tf:
+            for m in tf.getmembers():
+                g = pat.match(m.name)
+                if not g:
+                    continue
+                words = tf.extractfile(m).read().decode(
+                    "latin-1").lower().split()
+                docs.append((words, 0 if g.group(1) == "neg" else 1))
+                freq.update(words)
+        vocab = [w for w, c in freq.most_common() if c >= cutoff]
+        self.word_idx = {w: i + 1 for i, w in enumerate(vocab)}
+        self.docs = [
+            (np.asarray([self.word_idx.get(w, 0) for w in ws], np.int64), y)
+            for ws, y in docs
+        ]
+
+    def _synthesize(self, n, seed):
+        rng = np.random.RandomState(seed)
+        vocab = _NEUTRAL + _POS_WORDS + _NEG_WORDS
+        self.word_idx = {w: i + 1 for i, w in enumerate(vocab)}
+        self.docs = []
+        for k in range(n):
+            y = int(rng.randint(0, 2))
+            senti = _POS_WORDS if y else _NEG_WORDS
+            length = int(rng.randint(8, 24))
+            words = [
+                (rng.choice(senti) if rng.rand() < 0.35
+                 else rng.choice(_NEUTRAL))
+                for _ in range(length)
+            ]
+            self.docs.append((
+                np.asarray([self.word_idx[w] for w in words], np.int64), y,
+            ))
+        self.synthetic = True
+
+    @property
+    def vocab_size(self):
+        return len(self.word_idx) + 1
+
+    def __getitem__(self, i):
+        return self.docs[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB n-gram LM dataset (dataset/imikolov.py): samples are n-tuples
+    of word ids (first n-1 = context, last = target)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=2):
+        self.synthetic = False
+        self.window_size = int(window_size)
+        data_file = data_file or os.path.join(
+            DATA_HOME, "imikolov", "simple-examples.tgz"
+        )
+        split = "train" if mode == "train" else "valid"
+        if os.path.exists(data_file):
+            self._load_archive(data_file, split, min_word_freq)
+        else:
+            self._synthesize(
+                n_sent=256 if mode == "train" else 64,
+                seed=11 if mode == "train" else 12,
+            )
+        self._build(data_type)
+
+    def _load_archive(self, path, split, min_freq):
+        import collections
+
+        with tarfile.open(path) as tf:
+            name = f"./simple-examples/data/ptb.{split}.txt"
+            for cand in (name, name[2:]):
+                try:
+                    raw = tf.extractfile(cand).read().decode()
+                    break
+                except KeyError:
+                    continue
+            else:
+                raise FileNotFoundError(f"ptb.{split}.txt not in {path}")
+        self.sents = [line.split() for line in raw.splitlines() if line]
+        freq = collections.Counter(w for s in self.sents for w in s)
+        vocab = sorted(w for w, c in freq.items() if c >= min_freq)
+        self.word_idx = {w: i + 1 for i, w in enumerate(vocab)}
+
+    def _synthesize(self, n_sent, seed):
+        # markov-ish chains over a small vocab: n-gram prediction is
+        # genuinely learnable (each word prefers a fixed successor)
+        rng = np.random.RandomState(seed)
+        vocab = _NEUTRAL + _POS_WORDS
+        self.word_idx = {w: i + 1 for i, w in enumerate(vocab)}
+        succ = {w: vocab[(i * 7 + 3) % len(vocab)]
+                for i, w in enumerate(vocab)}
+        self.sents = []
+        for _ in range(n_sent):
+            w = vocab[int(rng.randint(len(vocab)))]
+            sent = [w]
+            for _ in range(int(rng.randint(6, 14))):
+                w = succ[w] if rng.rand() < 0.8 else vocab[
+                    int(rng.randint(len(vocab)))]
+                sent.append(w)
+            self.sents.append(sent)
+        self.synthetic = True
+
+    def _build(self, data_type):
+        n = self.window_size
+        self.samples = []
+        for s in self.sents:
+            ids = [self.word_idx.get(w, 0) for w in s]
+            if data_type.upper() == "SEQ":
+                self.samples.append(np.asarray(ids, np.int64))
+                continue
+            for k in range(len(ids) - n + 1):
+                self.samples.append(np.asarray(ids[k:k + n], np.int64))
+
+    @property
+    def vocab_size(self):
+        return len(self.word_idx) + 1
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _ParallelCorpus(Dataset):
+    """Shared machinery for WMT14/WMT16: (src_ids, trg_in, trg_next)
+    triples with <s>=1, <e>=2, OOV/pad=0 (dataset/wmt14.py id layout)."""
+
+    BOS, EOS, PAD = 1, 2, 0
+
+    def __init__(self, dict_size, mode, seed, n_train=384, n_test=96,
+                 max_len=12):
+        self.synthetic = True
+        self.dict_size = int(dict_size)
+        rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
+        n = n_train if mode == "train" else n_test
+        lo = 3  # ids below 3 are specials
+        hi = max(lo + 1, self.dict_size)
+        self.pairs = []
+        for _ in range(n):
+            length = int(rng.randint(3, max_len))
+            src = rng.randint(lo, hi, length).astype(np.int64)
+            trg = self._translate(src, hi, lo)
+            trg_in = np.concatenate([[self.BOS], trg]).astype(np.int64)
+            trg_next = np.concatenate([trg, [self.EOS]]).astype(np.int64)
+            self.pairs.append((src, trg_in, trg_next))
+
+    @staticmethod
+    def _translate(src, hi, lo):
+        # deterministic "language": reverse + fixed vocab permutation —
+        # a seq2seq model can actually learn it (book-test requirement)
+        return ((src[::-1] - lo) * 3 + 1) % (hi - lo) + lo
+
+    def get_dict(self, reverse=False):
+        d = {i: f"w{i}" for i in range(self.dict_size)}
+        d[self.BOS], d[self.EOS], d[self.PAD] = "<s>", "<e>", "<unk>"
+        if reverse:
+            return {v: k for k, v in d.items()}
+        return d
+
+    def __getitem__(self, i):
+        return self.pairs[i]
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def padded_arrays(self, max_len=None):
+        """Batch the whole split into padded [N, L] arrays (book tests)."""
+        L = max_len or max(len(s) for s, _, _ in self.pairs)
+        Lt = (max_len or max(len(t) for _, t, _ in self.pairs))
+        n = len(self.pairs)
+        src = np.zeros((n, L), np.int64)
+        tin = np.zeros((n, Lt), np.int64)
+        tnx = np.zeros((n, Lt), np.int64)
+        for i, (s, ti, tn) in enumerate(self.pairs):
+            src[i, :min(L, len(s))] = s[:L]
+            tin[i, :min(Lt, len(ti))] = ti[:Lt]
+            tnx[i, :min(Lt, len(tn))] = tn[:Lt]
+        return src, tin, tnx
+
+
+class WMT14(_ParallelCorpus):
+    """dataset/wmt14.py (dict_size-truncated en→fr)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=64):
+        super().__init__(dict_size, mode, seed=21)
+
+
+class WMT16(_ParallelCorpus):
+    """dataset/wmt16.py (BPE en↔de); same id layout, different seed."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=64,
+                 trg_dict_size=64, lang="en"):
+        super().__init__(max(src_dict_size, trg_dict_size), mode, seed=22)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (dataset/conll05.py): samples are
+    (word_ids, predicate_id, mark, label_ids) with BIO label space."""
+
+    LABELS = ["O", "B-A0", "I-A0", "B-A1", "I-A1", "B-V"]
+
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(31 if mode == "train" else 32)
+        self.synthetic = True
+        vocab = _NEUTRAL + _POS_WORDS + _NEG_WORDS
+        self.word_idx = {w: i + 1 for i, w in enumerate(vocab)}
+        self.label_idx = {l: i for i, l in enumerate(self.LABELS)}
+        self.samples = []
+        for _ in range(192 if mode == "train" else 48):
+            length = int(rng.randint(5, 12))
+            words = rng.randint(1, len(vocab) + 1, length).astype(np.int64)
+            pred_pos = int(rng.randint(1, length - 1))
+            mark = np.zeros(length, np.int64)
+            mark[pred_pos] = 1
+            labels = np.zeros(length, np.int64)  # O
+            labels[pred_pos] = self.label_idx["B-V"]
+            if pred_pos > 0:
+                labels[0] = self.label_idx["B-A0"]
+                labels[1:pred_pos] = self.label_idx["I-A0"]
+            if pred_pos < length - 1:
+                labels[pred_pos + 1] = self.label_idx["B-A1"]
+                labels[pred_pos + 2:] = self.label_idx["I-A1"]
+            self.samples.append((words, np.int64(words[pred_pos]), mark,
+                                 labels))
+
+    @property
+    def vocab_size(self):
+        return len(self.word_idx) + 1
+
+    @property
+    def num_labels(self):
+        return len(self.LABELS)
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """MovieLens ratings (dataset/movielens.py): samples are
+    (user_id, gender, age, occupation, movie_id, category, rating)."""
+
+    NUM_USERS = 400
+    NUM_MOVIES = 200
+    NUM_CATEGORIES = 8
+    NUM_OCCUPATIONS = 10
+
+    def __init__(self, data_file=None, mode="train"):
+        rng = np.random.RandomState(41 if mode == "train" else 42)
+        self.synthetic = True
+        n = 2048 if mode == "train" else 512
+        users = rng.randint(1, self.NUM_USERS + 1, n)
+        movies = rng.randint(1, self.NUM_MOVIES + 1, n)
+        # learnable signal: rating ~ affinity(user bucket, movie category)
+        cat = movies % self.NUM_CATEGORIES
+        affinity = (users % 5)[:, None] == (cat % 5)[:, None]
+        rating = np.clip(
+            3 + affinity[:, 0].astype(int) * 1.5
+            + rng.randn(n) * 0.5, 1, 5,
+        )
+        self.samples = [
+            (np.int64(u), np.int64(u % 2), np.int64(u % 7),
+             np.int64(u % self.NUM_OCCUPATIONS), np.int64(m),
+             np.int64(m % self.NUM_CATEGORIES), np.float32(r))
+            for u, m, r in zip(users, movies, rating)
+        ]
+
+    def __getitem__(self, i):
+        return self.samples[i]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (dataset/uci_housing.py): 13 features →
+    price. Synthetic: price = linear(features) + noise."""
+
+    FEATURE_DIM = 13
+
+    def __init__(self, data_file=None, mode="train"):
+        data_file = data_file or os.path.join(DATA_HOME, "uci_housing",
+                                              "housing.data")
+        self.synthetic = False
+        if os.path.exists(data_file):
+            raw = np.loadtxt(data_file).astype(np.float32)
+            feats, prices = raw[:, :-1], raw[:, -1]
+        else:
+            rng = np.random.RandomState(51 if mode == "train" else 52)
+            n = 404 if mode == "train" else 102
+            feats = rng.randn(n, self.FEATURE_DIM).astype(np.float32)
+            w = np.linspace(-1.0, 1.0, self.FEATURE_DIM).astype(np.float32)
+            prices = feats @ w + 22.5 + rng.randn(n).astype(np.float32) * 0.5
+            self.synthetic = True
+        # normalize like the reference loader (feature_range scaling)
+        mu, sd = feats.mean(0), feats.std(0) + 1e-6
+        self.features = (feats - mu) / sd
+        self.prices = prices.astype(np.float32)
+
+    def __getitem__(self, i):
+        return self.features[i], np.asarray([self.prices[i]], np.float32)
+
+    def __len__(self):
+        return len(self.features)

@@ -1,0 +1,104 @@
+"""Parameter-server fixture: one process, role from env.
+
+Roles (PS_ROLE): "server" blocks in fleet.run_server(); "trainer" runs a
+small embedding-regression, pushing sparse grads (async), geo deltas
+(PS_MODE=geo), with a PS-hosted worker barrier each step (sync fence).
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (
+    DistributedStrategy,
+    Role,
+    UserDefinedRoleMaker,
+)
+from paddle_tpu.distributed.ps import GeoPSEmbedding, PSEmbedding
+
+
+def main():
+    role = os.environ["PS_ROLE"]
+    endpoint = os.environ["PS_ENDPOINT"]
+    mode = os.environ.get("PS_MODE", "async")
+
+    if role == "server":
+        rm = UserDefinedRoleMaker(
+            current_id=0, role=Role.SERVER, server_endpoints=[endpoint],
+            is_collective=False,
+        )
+        fleet.init(rm, is_collective=False)
+        fleet.run_server()  # returns after a client sends shutdown
+        print(json.dumps({"role": "server", "ok": True}))
+        return
+
+    tid = int(os.environ["PS_TRAINER_ID"])
+    tnum = int(os.environ["PS_TRAINER_NUM"])
+    strategy = DistributedStrategy()
+    strategy.a_sync = True
+    if mode == "geo":
+        strategy.a_sync_configs.k_steps = 2
+    rm = UserDefinedRoleMaker(
+        current_id=tid, role=Role.WORKER, worker_num=tnum,
+        server_endpoints=[endpoint], is_collective=False,
+    )
+    fleet.init(rm, is_collective=False, strategy=strategy)
+    fleet.init_worker()
+    table = fleet.embedding_table("emb", 8, init_std=0.1)
+    emb = (GeoPSEmbedding(table, k_steps=2) if mode == "geo"
+           else PSEmbedding(table))
+
+    paddle.seed(100 + tid)
+    head = nn.Linear(8, 1)
+    sgd = opt.SGD(learning_rate=0.1, parameters=head.parameters())
+
+    # disjoint id ranges per trainer; fixed targets per id
+    rng = np.random.RandomState(tid)
+    ids_pool = np.arange(tid * 50, tid * 50 + 20, dtype=np.int64)
+    targets = {int(i): float(np.sin(i)) for i in ids_pool}
+
+    def probe_loss():
+        y = np.asarray([targets[int(i)] for i in ids_pool], np.float32)
+        e = emb(paddle.to_tensor(ids_pool.reshape(-1, 1)))
+        pred = head(e[:, 0, :])
+        l = F.mse_loss(pred, paddle.to_tensor(y.reshape(-1, 1)))
+        emb._pending.clear()  # probe is read-only
+        return float(l.numpy())
+
+    loss0 = probe_loss()
+    losses = []
+    for step in range(20):
+        ids = rng.choice(ids_pool, 16)
+        y = np.asarray([targets[int(i)] for i in ids], np.float32)
+        e = emb(paddle.to_tensor(ids.reshape(-1, 1)))
+        pred = head(e[:, 0, :])
+        loss = F.mse_loss(pred, paddle.to_tensor(y.reshape(-1, 1)))
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        emb.push_step(lr=0.3)
+        losses.append(float(loss.numpy()))
+        # PS-hosted n-party fence: the sync-mode per-step barrier
+        fleet.barrier_worker()
+    loss1 = probe_loss()
+
+    stats = fleet._ps_clients[0].stats()
+    fleet.barrier_worker()  # all trainers done before any teardown
+    if tid == 0:
+        fleet.shutdown_server()
+    fleet.stop_worker()
+    print(json.dumps({
+        "role": "trainer", "id": tid, "losses": [round(l, 5) for l in losses],
+        "loss0": round(loss0, 5), "loss1": round(loss1, 5),
+        "rows": stats.get("emb", 0),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

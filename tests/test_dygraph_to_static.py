@@ -587,3 +587,22 @@ def test_continue_in_for_still_advances():
     out = tfn(paddle.to_tensor(np.array([0.0], np.float32)))
     np.testing.assert_allclose(np.asarray(out.numpy()), [504.0])  # 1+3
     np.testing.assert_allclose(_jit_scalar(tfn)([0.0]), [504.0])
+
+
+def test_return_in_nested_loop_exits_outer():
+    """Regression: a return inside a nested loop must stop the OUTER loop
+    too (python returns at the first hit, not the last iteration)."""
+    def fn(x):
+        i = 0
+        while i < 5:
+            i = i + 1
+            j = 0
+            while j < 1:
+                j = j + 1
+                if i >= 2:
+                    return x * 0 + i
+        return x * 0 - 1
+
+    tfn = convert_to_static(fn)
+    out = tfn(paddle.to_tensor(np.array([1.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(out.numpy()), [2.0])

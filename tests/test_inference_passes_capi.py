@@ -221,3 +221,31 @@ def test_c_api_end_to_end(tmp_path):
     pred.run()
     want = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_go_client_symbols_match_c_abi():
+    """The Go client (go/paddle_tpu/, reference go/paddle parity) is
+    build-tag-gated because no Go toolchain ships in CI — but its cgo
+    extern declarations must stay in sync with capi.cpp. Parse both and
+    compare symbol sets."""
+    import re
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    go_dir = os.path.join(repo, "go", "paddle_tpu")
+    go_decl = set()
+    for fn in os.listdir(go_dir):
+        if not fn.endswith(".go"):
+            continue
+        src = open(os.path.join(go_dir, fn)).read()
+        go_decl |= set(re.findall(r"extern [^;]*?(PD_\w+)\s*\(", src))
+    capi = open(os.path.join(
+        repo, "paddle_tpu", "_native", "capi.cpp")).read()
+    c_syms = set(re.findall(r"^(?:\w[\w* ]*?)(PD_\w+)\s*\(", capi,
+                            re.MULTILINE))
+    missing = go_decl - c_syms
+    assert not missing, f"Go client references absent C symbols: {missing}"
+    # the Go client must cover the whole documented fetch surface
+    for required in ["PD_CreatePredictor", "PD_Run", "PD_CopyOutputFloat",
+                     "PD_SetInputFloat", "PD_SetInputInt64",
+                     "PD_GetOutputShape"]:
+        assert required in go_decl, f"Go client missing {required}"

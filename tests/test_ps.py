@@ -1,0 +1,200 @@
+"""Parameter-server runtime tests.
+
+Reference test pattern: tests/unittests/test_dist_base.py:506 (spawn a
+real server + trainers on localhost) over the transpiler's sync/async/geo
+modes; here against the TPU-native PS (distributed/ps/).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import PSClient, ShardedTable, TableServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "ps_trainer.py")
+
+
+# -- in-process unit coverage -------------------------------------------------
+
+
+def test_table_server_pull_push_roundtrip():
+    srv = TableServer().start()
+    try:
+        c = PSClient(srv.endpoint)
+        t = ShardedTable("t", 4, [c], init_std=0.1)
+        r0 = t.pull([3, 9]).copy()
+        # duplicate-id grads accumulate (SelectedRows MergeAdd semantics)
+        t.push_grad([3, 3], np.ones((2, 4), np.float32), lr=0.25)
+        r1 = t.pull([3, 9])
+        np.testing.assert_allclose(r1[0], r0[0] - 0.5, atol=1e-6)
+        np.testing.assert_allclose(r1[1], r0[1], atol=1e-6)
+        ids, rows = t.dump()
+        assert ids.tolist() == [3, 9] and rows.shape == (2, 4)
+        c.shutdown_server()
+    finally:
+        srv.stop()
+
+
+def test_sharded_table_stripes_ids():
+    s1, s2 = TableServer().start(), TableServer().start()
+    try:
+        t = ShardedTable(
+            "t", 2, [PSClient(s1.endpoint), PSClient(s2.endpoint)]
+        )
+        t.pull([0, 1, 2, 3, 4])  # even ids -> shard 0, odd -> shard 1
+        st1 = PSClient(s1.endpoint).stats()["t"]
+        st2 = PSClient(s2.endpoint).stats()["t"]
+        assert st1 == 3 and st2 == 2
+        ids, _ = t.dump()
+        assert ids.tolist() == [0, 1, 2, 3, 4]  # merged + sorted
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+def test_adagrad_table_update():
+    srv = TableServer().start()
+    try:
+        c = PSClient(srv.endpoint)
+        t = ShardedTable("a", 2, [c], init_std=0.0, optimizer="adagrad")
+        g = np.array([[1.0, 2.0]], np.float32)
+        t.push_grad([7], g, lr=1.0)
+        r = t.pull([7])
+        # adagrad: accum=g^2 -> update = lr*g/(sqrt(g^2)+eps) ~= sign(g)
+        np.testing.assert_allclose(r[0], [-1.0, -1.0], atol=1e-4)
+        c.shutdown_server()
+    finally:
+        srv.stop()
+
+
+# -- subprocess end-to-end (1 server, 2 trainers) -----------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_ps_world(mode, timeout=420):
+    endpoint = f"127.0.0.1:{_free_port()}"
+    base = dict(os.environ)
+    base.pop("PYTEST_CURRENT_TEST", None)
+    base["JAX_PLATFORMS"] = "cpu"
+    base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
+    base["PS_ENDPOINT"] = endpoint
+    base["PS_MODE"] = mode
+
+    def spawn(extra):
+        env = dict(base)
+        env.update(extra)
+        return subprocess.Popen(
+            [sys.executable, FIXTURE], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+
+    server = spawn({"PS_ROLE": "server"})
+    # wait for the server socket
+    host, port = endpoint.rsplit(":", 1)
+    for _ in range(100):
+        try:
+            socket.create_connection((host, int(port)), timeout=1.0).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    trainers = [
+        spawn({"PS_ROLE": "trainer", "PS_TRAINER_ID": str(i),
+               "PS_TRAINER_NUM": "2"})
+        for i in range(2)
+    ]
+    outs = []
+    try:
+        for p in trainers + [server]:
+            out, err = p.communicate(timeout=timeout)
+            assert p.returncode == 0, f"ps process failed:\n{err[-4000:]}"
+            line = [l for l in out.strip().splitlines()
+                    if l.startswith("{")][-1]
+            outs.append(json.loads(line))
+    except subprocess.TimeoutExpired:
+        for p in trainers + [server]:
+            p.kill()
+        raise
+    return outs
+
+
+@pytest.mark.slow
+def test_ps_async_one_server_two_trainers():
+    outs = _run_ps_world("async")
+    trainers = [o for o in outs if o["role"] == "trainer"]
+    server = [o for o in outs if o["role"] == "server"]
+    assert len(trainers) == 2 and server and server[0]["ok"]
+    for t in trainers:
+        assert t["loss1"] < t["loss0"] * 0.7, t  # training progressed
+        # both trainers' disjoint id ranges landed in the shared table
+        assert t["rows"] == 40, t
+
+
+@pytest.mark.slow
+def test_ps_geo_mode():
+    outs = _run_ps_world("geo")
+    trainers = [o for o in outs if o["role"] == "trainer"]
+    assert len(trainers) == 2
+    for t in trainers:
+        assert t["loss1"] < t["loss0"] * 0.7, t
+        assert t["rows"] == 40, t  # geo deltas reached the server
+
+
+def test_all_gather_and_global_shuffle_guard():
+    """fleet._all_gather over the PS blackboard feeds the
+    InMemoryDataset.global_shuffle same-corpus check: mismatched
+    per-trainer sizes must fail loudly instead of silently dropping
+    (n-1)/n of the corpus."""
+    from paddle_tpu.distributed.fleet.base import Fleet, UserDefinedRoleMaker
+    from paddle_tpu.io import InMemoryDataset
+
+    srv = TableServer().start()
+    try:
+        def mk_fleet(rank):
+            f = Fleet()
+            f._role_maker = UserDefinedRoleMaker(
+                current_id=rank, worker_num=2,
+                server_endpoints=[srv.endpoint], is_collective=False)
+            f._ps_clients = [PSClient(srv.endpoint)]
+            return f
+
+        f0, f1 = mk_fleet(0), mk_fleet(1)
+        # _all_gather: run both parties concurrently (barrier inside)
+        import threading
+        res = {}
+        t = threading.Thread(target=lambda: res.update(
+            a=f0._all_gather(10)))
+        t.start()
+        res["b"] = f1._all_gather(20)
+        t.join(timeout=30)
+        assert sorted(res["a"]) == [10.0, 20.0] == sorted(res["b"])
+
+        # global_shuffle guard: one trainer holds 4 instances, other 2
+        ds0, ds1 = InMemoryDataset(), InMemoryDataset()
+        ds0._memory = [object()] * 4
+        ds1._memory = [object()] * 2
+        errs = []
+
+        def shuffle(ds, f):
+            try:
+                ds.global_shuffle(fleet=f)
+            except RuntimeError as e:
+                errs.append(str(e))
+
+        t2 = threading.Thread(target=shuffle, args=(ds0, f0))
+        t2.start()
+        shuffle(ds1, f1)
+        t2.join(timeout=30)
+        assert len(errs) == 2 and "same full filelist" in errs[0]
+    finally:
+        srv.stop()

@@ -343,11 +343,15 @@ def test_dgc_raises_not_silent():
         fleet.fleet.init().distributed_optimizer(o, strategy)
 
 
-def test_a_sync_raises():
+def test_a_sync_selects_ps_mode():
+    """a_sync no longer raises: it selects the parameter-server runtime
+    (distributed/ps); k_steps in a_sync_configs picks geo mode."""
     strategy = fleet.DistributedStrategy()
     strategy.a_sync = True
-    with pytest.raises(NotImplementedError, match="a_sync"):
-        parallel.consume_strategy(strategy)
+    opts = parallel.consume_strategy(strategy)
+    assert opts["a_sync"] is True and opts["geo_k_steps"] == 0
+    strategy.a_sync_configs.k_steps = 4
+    assert parallel.consume_strategy(strategy)["geo_k_steps"] == 4
 
 
 def test_localsgd_plus_sharding_rejected():

@@ -1,0 +1,70 @@
+"""Text dataset tests (dataset/{imdb,imikolov,wmt14,conll05,movielens}.py
+parity surface; offline synthesis contract)."""
+import numpy as np
+
+from paddle_tpu.text import (
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16,
+)
+
+
+def test_imdb_shapes_and_signal():
+    ds = Imdb(mode="train")
+    assert ds.synthetic and len(ds) == 512
+    ids, y = ds[0]
+    assert ids.dtype == np.int64 and y in (0, 1)
+    assert ds.vocab_size > 10
+    # learnable: positive docs use positive words more than negative docs
+    pos_ids = {ds.word_idx[w] for w in ["good", "great", "love"]}
+    def frac(label):
+        docs = [d for d, l in ds.docs if l == label]
+        hits = sum(np.isin(d, list(pos_ids)).sum() for d in docs)
+        return hits / max(1, sum(len(d) for d in docs))
+    assert frac(1) > frac(0) * 2
+
+
+def test_imikolov_ngrams():
+    ds = Imikolov(mode="train", window_size=5)
+    assert ds.synthetic
+    assert all(len(s) == 5 for s in ds.samples[:10])
+    seq = Imikolov(mode="train", data_type="SEQ")
+    assert seq.samples[0].ndim == 1
+    assert ds.vocab_size > 5
+
+
+def test_wmt_parallel_corpus():
+    tr = WMT14(mode="train", dict_size=50)
+    te = WMT14(mode="test", dict_size=50)
+    assert len(tr) == 384 and len(te) == 96
+    src, tin, tnx = tr[0]
+    assert tin[0] == 1 and tnx[-1] == 2  # <s> prefix, <e> suffix
+    assert (tin[1:] == tnx[:-1]).all()   # teacher-forcing alignment
+    d = tr.get_dict()
+    assert d[1] == "<s>" and d[2] == "<e>"
+    s, ti, tn = tr.padded_arrays()
+    assert s.shape[0] == 384 and ti.shape == tn.shape
+    w16 = WMT16(mode="train")
+    assert len(w16) == 384
+
+
+def test_conll05_srl_structure():
+    ds = Conll05st(mode="train")
+    words, pred, mark, labels = ds[0]
+    assert len(words) == len(mark) == len(labels)
+    assert mark.sum() == 1                      # one predicate
+    assert labels[mark.argmax()] == ds.label_idx["B-V"]
+    assert ds.num_labels == 6
+
+
+def test_movielens_rating_signal():
+    ds = Movielens(mode="train")
+    rows = [ds[i] for i in range(len(ds))]
+    aff = [r[-1] for r in rows if (r[0] % 5) == (r[5] % 5)]
+    rest = [r[-1] for r in rows if (r[0] % 5) != (r[5] % 5)]
+    assert np.mean(aff) > np.mean(rest) + 0.5   # learnable affinity
+
+
+def test_uci_housing_regression():
+    tr = UCIHousing(mode="train")
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert abs(float(np.mean([tr[i][0] for i in range(50)]))) < 1.0

@@ -40,6 +40,8 @@ MODULES = [
     "paddle_tpu.incubate.auto_checkpoint",
     "paddle_tpu.crypto",
     "paddle_tpu.distributed.elastic",
+    "paddle_tpu.distributed.ps",
+    "paddle_tpu.text",
 ]
 
 
