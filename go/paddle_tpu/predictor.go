@@ -32,6 +32,10 @@ import (
 	"unsafe"
 )
 
+// keepAlive pins p for the duration of an in-flight cgo call so the
+// SetFinalizer-driven Delete cannot free the C handle concurrently.
+func (p *Predictor) keepAlive() { runtime.KeepAlive(p) }
+
 type Predictor struct {
 	c unsafe.Pointer
 }
@@ -62,14 +66,23 @@ func (p *Predictor) Delete() {
 	}
 }
 
-func (p *Predictor) GetInputNum() int  { return int(C.PD_GetInputNum(p.c)) }
-func (p *Predictor) GetOutputNum() int { return int(C.PD_GetOutputNum(p.c)) }
+func (p *Predictor) GetInputNum() int {
+	defer p.keepAlive()
+	return int(C.PD_GetInputNum(p.c))
+}
+
+func (p *Predictor) GetOutputNum() int {
+	defer p.keepAlive()
+	return int(C.PD_GetOutputNum(p.c))
+}
 
 func (p *Predictor) GetInputName(i int) string {
+	defer p.keepAlive()
 	return C.GoString(C.PD_GetInputName(p.c, C.int(i)))
 }
 
 func (p *Predictor) GetOutputName(i int) string {
+	defer p.keepAlive()
 	return C.GoString(C.PD_GetOutputName(p.c, C.int(i)))
 }
 
@@ -91,6 +104,7 @@ func (p *Predictor) GetOutputNames() []string {
 
 // SetZeroCopyInput stages one named input for the next Run.
 func (p *Predictor) SetZeroCopyInput(t *ZeroCopyTensor) error {
+	defer p.keepAlive()
 	name := C.CString(t.Name)
 	defer C.free(unsafe.Pointer(name))
 	var shapePtr *C.longlong
@@ -132,6 +146,7 @@ func (p *Predictor) SetZeroCopyInput(t *ZeroCopyTensor) error {
 
 // ZeroCopyRun executes the compiled program on the staged inputs.
 func (p *Predictor) ZeroCopyRun() error {
+	defer p.keepAlive()
 	if C.PD_Run(p.c) != 0 {
 		return lastError()
 	}
@@ -140,6 +155,7 @@ func (p *Predictor) ZeroCopyRun() error {
 
 // GetZeroCopyOutput fetches a named output (float32) after a Run.
 func (p *Predictor) GetZeroCopyOutput(t *ZeroCopyTensor) error {
+	defer p.keepAlive()
 	name := C.CString(t.Name)
 	defer C.free(unsafe.Pointer(name))
 	ndim := int(C.PD_GetOutputNdim(p.c, name))

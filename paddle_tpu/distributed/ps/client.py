@@ -24,15 +24,22 @@ class PSClient:
     def __init__(self, endpoint, timeout=60.0):
         host, port = endpoint.rsplit(":", 1)
         self.endpoint = endpoint
+        self._timeout = timeout
         self._sock = socket.create_connection(
             (host, int(port)), timeout=timeout
         )
         self._lock = threading.Lock()
 
-    def request(self, *msg):
+    def request(self, *msg, timeout="default"):
         with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+            if timeout != "default":
+                self._sock.settimeout(timeout)
+            try:
+                _send_msg(self._sock, msg)
+                reply = _recv_msg(self._sock)
+            finally:
+                if timeout != "default":
+                    self._sock.settimeout(self._timeout)
         if reply is None:
             raise ConnectionError(f"PS {self.endpoint} closed connection")
         status, payload = reply
@@ -61,8 +68,10 @@ class PSClient:
     def dump(self, name):
         return self.request("dump", name)
 
-    def barrier(self, token, n):
-        return self.request("barrier", token, n)
+    def barrier(self, token, n, timeout=None):
+        # a fence legitimately outwaits stragglers (first-step compiles,
+        # preemptions) — never bound it by the ordinary RPC timeout
+        return self.request("barrier", token, n, timeout=timeout)
 
     def stats(self):
         return self.request("stats")

@@ -404,11 +404,12 @@ def convert_print(*args, **kwargs):
         if not _callbacks_supported():
             _warn_no_callbacks("print")
             return
-        sep = kwargs.get("sep", " ")
+        esc = lambda s: s.replace("{", "{{").replace("}", "}}")  # noqa: E731
+        sep = esc(kwargs.get("sep", " "))
         end = kwargs.get("end", "\n")
         fmt = sep.join(["{}"] * len(args))
         if end != "\n":
-            fmt += end
+            fmt += esc(end)
         jax.debug.print(fmt, *[_arr(a) for a in args])
     else:
         print(*args, **kwargs)

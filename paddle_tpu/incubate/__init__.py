@@ -1,2 +1,3 @@
 """Incubating subsystems (reference: python/paddle/fluid/incubate/)."""
 from . import auto_checkpoint  # noqa: F401
+from . import hapi_text  # noqa: F401  (incubate/hapi/text surface)

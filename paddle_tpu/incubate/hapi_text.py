@@ -1,0 +1,240 @@
+"""hapi text layers (incubate/hapi/text/text.py parity).
+
+Most of the reference's surface already exists as first-class nn layers
+here and is re-exported under the reference names (RNN/LSTM/GRU families
+→ nn/rnn.py; MultiHeadAttention/Transformer* → nn/transformer.py). The
+pieces implemented in this module are the ones with no prior equivalent:
+
+- Conv1dPoolLayer / CNNEncoder (text.py:1218, :1287): conv1d+pool text
+  encoders.
+- LinearChainCRF / CRFDecoding (text.py:1344, :1421): the linear-chain
+  CRF log-likelihood (forward algorithm over lax.scan — differentiable,
+  operators/linear_chain_crf_op.cc semantics incl. the [n+2, n]
+  transition layout with start/stop rows) and Viterbi decoding
+  (operators/crf_decoding_op.cc).
+- SequenceTagging (text.py:1583): embedding + GRU + CRF tagging model
+  (pairs with text.Conll05st).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework import autograd
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..nn.layers import Conv1D, Embedding, Linear
+from ..nn.rnn import GRU, LSTM, GRUCell, LSTMCell, SimpleRNN
+from ..nn.transformer import (
+    MultiHeadAttention,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+
+__all__ = [
+    # re-exported equivalents (reference names)
+    "RNN", "LSTM", "GRU", "BasicLSTMCell", "BasicGRUCell",
+    "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+    "TransformerDecoderLayer", "TransformerDecoder",
+    # implemented here
+    "Conv1dPoolLayer", "CNNEncoder",
+    "LinearChainCRF", "CRFDecoding", "SequenceTagging",
+]
+
+RNN = SimpleRNN
+BasicLSTMCell = LSTMCell
+BasicGRUCell = GRUCell
+
+
+class Conv1dPoolLayer(Layer):
+    """conv1d + max-over-time pooling (text.py:1218)."""
+
+    def __init__(self, num_channels, num_filters, filter_size,
+                 pool_type="max"):
+        super().__init__()
+        self.conv = Conv1D(num_channels, num_filters, filter_size,
+                           padding=filter_size // 2)
+        self.pool_type = pool_type
+
+    def forward(self, x):
+        """x [B, C, T] → [B, num_filters] (pooled over time)."""
+        h = F.relu(self.conv(x))
+        arr = h._array if isinstance(h, Tensor) else h
+        pooled = (jnp.max(arr, axis=-1) if self.pool_type == "max"
+                  else jnp.mean(arr, axis=-1))
+        return Tensor._from_array(pooled) if isinstance(h, Tensor) else pooled
+
+
+class CNNEncoder(Layer):
+    """Parallel Conv1dPoolLayers over several filter sizes, concatenated
+    (text.py:1287 — the classic Kim-CNN text encoder)."""
+
+    def __init__(self, num_channels, num_filters, filter_sizes=(2, 3, 4),
+                 pool_type="max"):
+        super().__init__()
+        self.convs = [
+            Conv1dPoolLayer(num_channels, num_filters, fs, pool_type)
+            for fs in filter_sizes
+        ]
+        for i, c in enumerate(self.convs):
+            self.add_sublayer(f"conv_pool_{i}", c)
+
+    def forward(self, x):
+        from .. import ops
+
+        return ops.concat([c(x) for c in self.convs], axis=-1)
+
+
+def _crf_scores(emission, labels, transition, lengths):
+    """Path score of the gold labels (linear_chain_crf_op.cc Forward's
+    gold-score half). transition: [n+2, n], rows 0/1 = start/stop."""
+    b, t, n = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    pos = jnp.arange(t)
+    mask = (pos[None, :] < lengths[:, None]).astype(emission.dtype)
+    emit = jnp.take_along_axis(emission, labels[..., None],
+                               axis=2)[..., 0]          # [B, T]
+    score = (emit * mask).sum(1) + start[labels[:, 0]]
+    pair = trans[labels[:, :-1], labels[:, 1:]]          # [B, T-1]
+    score = score + (pair * mask[:, 1:]).sum(1)
+    last = jnp.clip(lengths - 1, 0, t - 1)
+    last_lab = jnp.take_along_axis(labels, last[:, None], axis=1)[:, 0]
+    return score + stop[last_lab]
+
+
+def _crf_lognorm(emission, transition, lengths):
+    """log Z via the forward algorithm over lax.scan."""
+    b, t, n = emission.shape
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    alpha0 = start + emission[:, 0]                      # [B, n]
+
+    def step(alpha, inp):
+        e_t, valid = inp                                 # [B,n], [B]
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + trans[None], axis=1
+        ) + e_t
+        return jnp.where(valid[:, None], nxt, alpha), None
+
+    pos = jnp.arange(1, t)
+    valid = pos[None, :] < lengths[:, None]              # [B, T-1]
+    alpha, _ = lax.scan(
+        step, alpha0,
+        (jnp.moveaxis(emission[:, 1:], 1, 0), jnp.moveaxis(valid, 1, 0)),
+    )
+    return jax.nn.logsumexp(alpha + stop[None], axis=1)  # [B]
+
+
+class LinearChainCRF(Layer):
+    """CRF negative log-likelihood layer (text.py:1344 over
+    operators/linear_chain_crf_op.cc). forward(emission, labels, lengths)
+    → per-sequence NLL [B]."""
+
+    def __init__(self, size, param_attr=None):
+        super().__init__()
+        self.size = size
+        self.transition = self.create_parameter(
+            [size + 2, size], attr=param_attr,
+            default_initializer=I.Normal(0.0, 0.1),
+        )
+
+    def forward(self, emission, labels, lengths):
+        def fn(e, tr, lab, ln):
+            return _crf_lognorm(e, tr, ln) - _crf_scores(e, lab, tr, ln)
+
+        return autograd.apply_op(
+            "linear_chain_crf", fn,
+            [_t(emission), self.transition, _t(labels, "int64"),
+             _t(lengths, "int64")], {},
+        )
+
+
+class CRFDecoding(Layer):
+    """Viterbi decoding sharing a LinearChainCRF's transition
+    (text.py:1421 over operators/crf_decoding_op.cc)."""
+
+    def __init__(self, crf: LinearChainCRF):
+        super().__init__()
+        self.crf = crf
+
+    def forward(self, emission, lengths):
+        e = _arr(_t(emission))
+        tr = _arr(self.crf.transition)
+        ln = _arr(_t(lengths, "int64"))
+        b, t, n = e.shape
+        start, stop, trans = tr[0], tr[1], tr[2:]
+
+        def step(alpha, inp):
+            e_t, valid = inp
+            cand = alpha[:, :, None] + trans[None]       # [B, n, n]
+            best = jnp.max(cand, axis=1) + e_t
+            ptr = jnp.argmax(cand, axis=1)               # [B, n]
+            alpha_next = jnp.where(valid[:, None], best, alpha)
+            keep = valid[:, None]
+            ptr = jnp.where(
+                keep, ptr, jnp.arange(n)[None, :]        # identity past end
+            )
+            return alpha_next, ptr
+
+        alpha0 = start + e[:, 0]
+        pos = jnp.arange(1, t)
+        valid = pos[None, :] < ln[:, None]
+        alpha, ptrs = lax.scan(
+            step, alpha0,
+            (jnp.moveaxis(e[:, 1:], 1, 0), jnp.moveaxis(valid, 1, 0)),
+        )
+        last = jnp.argmax(alpha + stop[None], axis=1)    # [B]
+
+        def back(lab, ptr_t):
+            prev = jnp.take_along_axis(ptr_t, lab[:, None], axis=1)[:, 0]
+            return prev, lab
+
+        # reverse scan: ys[k] = label at position k+1; the final carry is
+        # the label at position 0
+        first, path = lax.scan(back, last, ptrs, reverse=True)
+        path = jnp.concatenate(
+            [first[:, None], jnp.moveaxis(path, 0, 1)], axis=1
+        )                                                # [B, T]
+        return Tensor._from_array(path)
+
+
+class SequenceTagging(Layer):
+    """embedding → GRU → emission → CRF (text.py:1583), the SRL/NER
+    tagging composite; decode() runs Viterbi."""
+
+    def __init__(self, vocab_size, num_labels, word_emb_dim=64,
+                 hidden_size=64):
+        super().__init__()
+        self.embedding = Embedding(vocab_size, word_emb_dim)
+        self.gru = GRU(word_emb_dim, hidden_size)
+        self.emission_fc = Linear(hidden_size, num_labels)
+        self.crf = LinearChainCRF(num_labels)
+        self.decoder = CRFDecoding(self.crf)
+
+    def _emission(self, word_ids):
+        h, _ = self.gru(self.embedding(word_ids))
+        return self.emission_fc(h)
+
+    def forward(self, word_ids, labels, lengths):
+        """→ mean CRF NLL (training loss)."""
+        nll = self.crf(self._emission(word_ids), labels, lengths)
+        return nll.mean()
+
+    def decode(self, word_ids, lengths):
+        return self.decoder(self._emission(word_ids), lengths)
+
+
+def _t(v, dtype=None):
+    if isinstance(v, Tensor):
+        return v
+    return Tensor(np.asarray(v), dtype=dtype)
+
+
+def _arr(v):
+    return v._array if isinstance(v, Tensor) else jnp.asarray(v)

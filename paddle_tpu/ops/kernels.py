@@ -705,6 +705,11 @@ def conv2d(x, w, *, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW
     stride, dilation = _pair(stride), _pair(dilation)
     if isinstance(padding, str):
         pad = padding.upper()  # "SAME" / "VALID"
+    elif (
+        isinstance(padding, (list, tuple)) and len(padding) == 2
+        and all(isinstance(q, (list, tuple)) for q in padding)
+    ):
+        pad = [tuple(padding[0]), tuple(padding[1])]  # [(t,b),(l,r)]
     else:
         p = _pair(padding) if not (isinstance(padding, (list, tuple)) and len(padding) == 4) else padding
         if len(p) == 2:

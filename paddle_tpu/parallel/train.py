@@ -68,6 +68,18 @@ def consume_strategy(strategy):
         # k_steps > 0 in a_sync_configs selects geo mode — the reference's
         # sync/async/geo triple (distribute_transpiler.py:256,
         # geo_sgd_transpiler.py).
+        conflicting = [
+            f for f in ("recompute", "amp", "sharding", "localsgd",
+                        "gradient_merge", "pipeline", "lars", "lamb")
+            if getattr(strategy, f, False)
+        ]
+        if conflicting:
+            raise NotImplementedError(
+                f"DistributedStrategy.a_sync cannot combine with "
+                f"{conflicting}: parameter-server trainers run plain "
+                "local dense steps (the reference's PS path has the same "
+                "separation from the collective meta-optimizers)"
+            )
         cfg = getattr(strategy, "a_sync_configs", None)
         # the reference documents both the attr form and plain dict
         # assignment (strategy.a_sync_configs = {"k_steps": N})

@@ -22,9 +22,7 @@ import numpy as np
 
 from ..io import Dataset
 
-DATA_HOME = os.path.expanduser(
-    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
-)
+from ..utils.data_home import DATA_HOME, warn_synthetic as _warn_synthetic
 
 # shared deterministic word inventory for synthetic corpora
 _POS_WORDS = ["good", "great", "excellent", "wonderful", "best", "love"]
@@ -90,6 +88,7 @@ class Imdb(Dataset):
             self.docs.append((
                 np.asarray([self.word_idx[w] for w in words], np.int64), y,
             ))
+        _warn_synthetic(self)
         self.synthetic = True
 
     @property
@@ -159,6 +158,7 @@ class Imikolov(Dataset):
                     int(rng.randint(len(vocab)))]
                 sent.append(w)
             self.sents.append(sent)
+        _warn_synthetic(self)
         self.synthetic = True
 
     def _build(self, data_type):
@@ -191,6 +191,7 @@ class _ParallelCorpus(Dataset):
 
     def __init__(self, dict_size, mode, seed, n_train=384, n_test=96,
                  max_len=12):
+        _warn_synthetic(self)
         self.synthetic = True
         self.dict_size = int(dict_size)
         rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
@@ -263,6 +264,7 @@ class Conll05st(Dataset):
 
     def __init__(self, data_file=None, mode="train"):
         rng = np.random.RandomState(31 if mode == "train" else 32)
+        _warn_synthetic(self)
         self.synthetic = True
         vocab = _NEUTRAL + _POS_WORDS + _NEG_WORDS
         self.word_idx = {w: i + 1 for i, w in enumerate(vocab)}
@@ -311,6 +313,7 @@ class Movielens(Dataset):
 
     def __init__(self, data_file=None, mode="train"):
         rng = np.random.RandomState(41 if mode == "train" else 42)
+        _warn_synthetic(self)
         self.synthetic = True
         n = 2048 if mode == "train" else 512
         users = rng.randint(1, self.NUM_USERS + 1, n)
@@ -348,6 +351,8 @@ class UCIHousing(Dataset):
         self.synthetic = False
         if os.path.exists(data_file):
             raw = np.loadtxt(data_file).astype(np.float32)
+            # reference split: first 404 rows train, rest test
+            raw = raw[:404] if mode == "train" else raw[404:]
             feats, prices = raw[:, :-1], raw[:, -1]
         else:
             rng = np.random.RandomState(51 if mode == "train" else 52)
@@ -355,6 +360,7 @@ class UCIHousing(Dataset):
             feats = rng.randn(n, self.FEATURE_DIM).astype(np.float32)
             w = np.linspace(-1.0, 1.0, self.FEATURE_DIM).astype(np.float32)
             prices = feats @ w + 22.5 + rng.randn(n).astype(np.float32) * 0.5
+            _warn_synthetic(self)
             self.synthetic = True
         # normalize like the reference loader (feature_range scaling)
         mu, sd = feats.mean(0), feats.std(0) + 1e-6

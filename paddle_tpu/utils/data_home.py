@@ -1,0 +1,24 @@
+"""Shared dataset-home + synthetic-fallback plumbing (used by
+paddle_tpu.vision.datasets and paddle_tpu.text.datasets)."""
+from __future__ import annotations
+
+import os
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
+)
+
+
+def warn_synthetic(ds):
+    """Loud, once-per-instance notice that a dataset substituted
+    deterministic synthetic samples for absent real files; pairs with the
+    ``ds.synthetic`` attribute tests check."""
+    import warnings
+
+    warnings.warn(
+        f"{type(ds).__name__}: real data files not found under "
+        f"{DATA_HOME!r}; generating deterministic SYNTHETIC samples "
+        "(self.synthetic=True). Place the reference-format files there "
+        "for real-data runs.",
+        RuntimeWarning, stacklevel=3,
+    )

@@ -23,15 +23,18 @@ from ..io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
 
-DATA_HOME = os.path.expanduser(
-    os.environ.get("PADDLE_TPU_DATA_HOME", "~/.cache/paddle_tpu/dataset")
-)
+from ..utils.data_home import DATA_HOME, warn_synthetic as _warn_synthetic
 
 
 class _SyntheticMixin:
-    """Deterministic stand-in data when the real files are absent."""
+    """Deterministic stand-in data when the real files are absent.
+
+    The substitution is LOUD: a warning names the dataset and what to do
+    to get real data, and ``self.synthetic`` is set so tests/metrics can
+    refuse to treat noise-trained numbers as real-data results."""
 
     def _synthesize(self, n, image_shape, num_classes, seed):
+        _warn_synthetic(self)
         rng = np.random.RandomState(seed)
         # class patterns come from a split-independent seed so train and
         # test share the same class structure (only noise/labels differ)

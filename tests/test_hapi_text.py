@@ -1,0 +1,136 @@
+"""hapi text layers: CRF family + CNN encoder (incubate/hapi/text/text.py
+parity; linear_chain_crf_op.cc / crf_decoding_op.cc math checks)."""
+import itertools
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.hapi_text import (
+    CNNEncoder,
+    Conv1dPoolLayer,
+    CRFDecoding,
+    LinearChainCRF,
+    SequenceTagging,
+)
+
+
+def _brute_force(emission, transition, length):
+    """Enumerate all label paths for one sequence: (logZ, best_path)."""
+    n = emission.shape[1]
+    start, stop, trans = transition[0], transition[1], transition[2:]
+    scores = {}
+    for path in itertools.product(range(n), repeat=length):
+        s = start[path[0]] + emission[0, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + emission[t, path[t]]
+        s += stop[path[-1]]
+        scores[path] = s
+    vals = np.asarray(list(scores.values()))
+    logz = np.log(np.exp(vals - vals.max()).sum()) + vals.max()
+    best = max(scores, key=scores.get)
+    return logz, list(best)
+
+
+def test_crf_nll_matches_enumeration():
+    rng = np.random.RandomState(0)
+    n, T = 3, 4
+    crf = LinearChainCRF(n)
+    trans = np.asarray(crf.transition.numpy())
+    emission = rng.randn(1, T, n).astype("float32")
+    labels = rng.randint(0, n, (1, T)).astype("int64")
+    lengths = np.asarray([T], np.int64)
+
+    nll = float(crf(paddle.to_tensor(emission), paddle.to_tensor(labels),
+                    paddle.to_tensor(lengths)).numpy()[0])
+    logz, _ = _brute_force(emission[0], trans, T)
+    gold = trans[0, labels[0, 0]] + emission[0, 0, labels[0, 0]]
+    for t in range(1, T):
+        gold += trans[2 + labels[0, t - 1], labels[0, t]]
+        gold += emission[0, t, labels[0, t]]
+    gold += trans[1, labels[0, -1]]
+    np.testing.assert_allclose(nll, logz - gold, rtol=1e-5)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    n, T = 3, 5
+    crf = LinearChainCRF(n)
+    dec = CRFDecoding(crf)
+    trans = np.asarray(crf.transition.numpy())
+    emission = rng.randn(2, T, n).astype("float32")
+    lengths = np.asarray([T, T], np.int64)
+    paths = np.asarray(dec(paddle.to_tensor(emission),
+                           paddle.to_tensor(lengths)).numpy())
+    for b in range(2):
+        _, best = _brute_force(emission[b], trans, T)
+        assert paths[b].tolist() == best, (b, paths[b], best)
+
+
+def test_crf_respects_lengths():
+    """Positions past `length` must not affect NLL."""
+    rng = np.random.RandomState(2)
+    n, T, L = 3, 6, 4
+    crf = LinearChainCRF(n)
+    e1 = rng.randn(1, T, n).astype("float32")
+    e2 = e1.copy()
+    e2[:, L:] = 99.0  # garbage past the end
+    labels = rng.randint(0, n, (1, T)).astype("int64")
+    lengths = np.asarray([L], np.int64)
+    v1 = float(crf(paddle.to_tensor(e1), paddle.to_tensor(labels),
+                   paddle.to_tensor(lengths)).numpy()[0])
+    v2 = float(crf(paddle.to_tensor(e2), paddle.to_tensor(labels),
+                   paddle.to_tensor(lengths)).numpy()[0])
+    np.testing.assert_allclose(v1, v2, rtol=1e-6)
+
+
+def test_sequence_tagging_trains_on_conll05():
+    """The composite tagging model fits the synthetic SRL corpus: CRF NLL
+    decreases and decode accuracy beats the majority class."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.text import Conll05st
+
+    ds = Conll05st(mode="train")
+    T = max(len(s[0]) for s in ds.samples)
+    n = len(ds.samples)
+    words = np.zeros((n, T), np.int64)
+    labels = np.zeros((n, T), np.int64)
+    lengths = np.zeros(n, np.int64)
+    for i, (w, _, _, lab) in enumerate(ds.samples):
+        words[i, :len(w)] = w
+        labels[i, :len(lab)] = lab
+        lengths[i] = len(w)
+
+    paddle.seed(0)
+    model = SequenceTagging(ds.vocab_size, ds.num_labels,
+                            word_emb_dim=32, hidden_size=32)
+    sgd = opt.Adam(learning_rate=0.01, parameters=model.parameters())
+    first = last = None
+    for epoch in range(8):
+        loss = model(paddle.to_tensor(words[:96]),
+                     paddle.to_tensor(labels[:96]),
+                     paddle.to_tensor(lengths[:96]))
+        loss.backward()
+        sgd.step(); sgd.clear_grad()
+        v = float(loss.numpy())
+        first = v if first is None else first
+        last = v
+    assert last < first * 0.7, (first, last)
+
+    paths = np.asarray(model.decode(
+        paddle.to_tensor(words[:32]), paddle.to_tensor(lengths[:32])
+    ).numpy())
+    mask = np.arange(T)[None, :] < lengths[:32, None]
+    acc = (paths == labels[:32])[mask].mean()
+    majority = max((labels[:32][mask] == k).mean()
+                   for k in range(ds.num_labels))
+    assert acc > majority, (acc, majority)
+
+
+def test_cnn_encoder_shapes():
+    enc = CNNEncoder(num_channels=8, num_filters=4, filter_sizes=(2, 3))
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 8, 10).astype("float32"))
+    out = enc(x)
+    assert list(out.shape) == [2, 8]  # 2 filter sizes x 4 filters
+    single = Conv1dPoolLayer(8, 4, 3)
+    assert list(single(x).shape) == [2, 4]

@@ -2,15 +2,42 @@
 # CI driver (paddle/scripts/paddle_build.sh role: cmake_gen/build/run_test
 # collapsed to what this runtime needs).
 #
-# Usage: tools/build_and_test.sh [fast|full|bench|check]
+# Usage: tools/build_and_test.sh [fast|full|bench|check] [NSHARDS]
 #   fast  - unit tests minus slow/subprocess ones
-#   full  - entire suite (default)
+#   full  - entire suite (default); pass NSHARDS>1 to split the test
+#           FILES across that many parallel pytest processes (xdist-safe
+#           by construction: file granularity, no shared-scope state
+#           crosses processes; compile-heavy files dominate wall time so
+#           sharding gives near-linear speedup)
 #   bench - bench.py smoke on the current backend
 #   check - static gates: op coverage + API spec + graft entry self-test
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 MODE="${1:-full}"
+NSHARDS="${2:-1}"
+
+sharded_pytest() {
+  # split test files round-robin over NSHARDS pytest processes
+  local extra=("$@")
+  mapfile -t files < <(ls tests/test_*.py | sort)
+  local pids=() rc=0
+  for ((s = 0; s < NSHARDS; s++)); do
+    local shard=()
+    for ((i = s; i < ${#files[@]}; i += NSHARDS)); do
+      shard+=("${files[i]}")
+    done
+    # an empty shard must be a no-op (bare pytest would rediscover the
+    # whole suite)
+    [ "${#shard[@]}" -eq 0 ] && continue
+    python -m pytest "${shard[@]}" -q -p no:cacheprovider "${extra[@]}" &
+    pids+=($!)
+  done
+  for pid in "${pids[@]}"; do
+    wait "$pid" || rc=1
+  done
+  return $rc
+}
 
 native_build() {
   # compile the native components into the cache (fails loudly here
@@ -32,7 +59,11 @@ case "$MODE" in
     ;;
   full)
     native_build
-    python -m pytest tests/ -q
+    if [ "$NSHARDS" -gt 1 ]; then
+      sharded_pytest
+    else
+      python -m pytest tests/ -q
+    fi
     ;;
   bench)
     python bench.py

@@ -42,6 +42,7 @@ MODULES = [
     "paddle_tpu.distributed.elastic",
     "paddle_tpu.distributed.ps",
     "paddle_tpu.text",
+    "paddle_tpu.incubate.hapi_text",
 ]
 
 

@@ -14,7 +14,7 @@ import numpy as np
 
 
 def run(data_format: str, batch: int, iters: int = 20, size: int = 224,
-        use_amp: bool = True):
+        use_amp: bool = True, recompute: bool = False):
     import jax
 
     import paddle_tpu as paddle
@@ -38,7 +38,7 @@ def run(data_format: str, batch: int, iters: int = 20, size: int = 224,
             logits = m(x)
         return F.cross_entropy(logits.astype("float32"), y).mean()
 
-    step = fjit.train_step(model, optimizer, loss_fn)
+    step = fjit.train_step(model, optimizer, loss_fn, recompute=recompute)
     rng = np.random.RandomState(0)
     shape = (batch, 3, size, size) if data_format == "NCHW" else (batch, size, size, 3)
     x = jax.device_put(rng.randn(*shape).astype("float32"))
@@ -56,6 +56,7 @@ def run(data_format: str, batch: int, iters: int = 20, size: int = 224,
     ips = batch * iters / dt
     return {
         "data_format": data_format, "batch": batch, "amp": use_amp,
+        "remat": recompute,
         "images_per_sec": round(ips, 1), "compile_s": round(compile_s, 1),
         "loss_start": round(l0, 4), "loss_end": round(l1, 4),
         "vs_2500": round(ips / 2500.0, 3),
@@ -67,9 +68,10 @@ def main():
     for c in configs:
         parts = c.split(":")
         df, b = parts[0], int(parts[1])
-        use_amp = len(parts) < 3 or parts[2] != "noamp"
+        use_amp = len(parts) < 3 or "noamp" not in parts[2:]
+        recompute = "remat" in parts[2:]
         try:
-            r = run(df, b, use_amp=use_amp)
+            r = run(df, b, use_amp=use_amp, recompute=recompute)
         except Exception as e:  # keep sweeping on OOM etc.
             r = {"data_format": df, "batch": b, "error": str(e)[:200]}
         print(json.dumps(r), flush=True)
