@@ -28,9 +28,12 @@ from ..framework.tensor import Tensor
 __all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
            "WHITE_LIST", "BLACK_LIST"]
 
-# fp16_lists.py white list: matmul-class ops that benefit from MXU dtype
+# fp16_lists.py white list: matmul-class ops that benefit from MXU dtype.
+# "linear" is the workhorse: every nn.Linear dispatches it, and leaving
+# it off the list silently ran all transformer MLPs in f32 (caught by
+# tools/bert_dots.py: 225 of 300 BERT-step dots were f32).
 WHITE_LIST = {
-    "matmul", "mul", "bmm", "addmm", "einsum",
+    "matmul", "mul", "bmm", "addmm", "einsum", "linear",
     "conv1d", "conv2d", "conv2d_transpose", "conv3d",
 }
 # fp16_lists.py black list: numerically sensitive reductions/normalizations.

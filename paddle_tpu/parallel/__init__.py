@@ -39,5 +39,6 @@ from .train import (  # noqa: F401
     consume_strategy,
 )
 from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import GPipe, PipelineParallel, pipeline_schedule  # noqa: F401
 from .moe import MoELayer, SwitchFFN  # noqa: F401

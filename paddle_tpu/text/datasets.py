@@ -191,7 +191,7 @@ class _ParallelCorpus(Dataset):
 
     def __init__(self, dict_size, mode, seed, n_train=384, n_test=96,
                  max_len=12):
-        _warn_synthetic(self)
+        _warn_synthetic(self, fallback=False)
         self.synthetic = True
         self.dict_size = int(dict_size)
         rng = np.random.RandomState(seed + (0 if mode == "train" else 1))
@@ -351,9 +351,17 @@ class UCIHousing(Dataset):
         self.synthetic = False
         if os.path.exists(data_file):
             raw = np.loadtxt(data_file).astype(np.float32)
-            # reference split: first 404 rows train, rest test
+            # normalize with FULL-corpus stats before the reference's
+            # 404/102 split (uci_housing.py does the same: one
+            # feature_range over all rows), so train/test share scaling
+            feats_all = raw[:, :-1]
+            mu = feats_all.mean(0)
+            sd = feats_all.std(0) + 1e-6
             raw = raw[:404] if mode == "train" else raw[404:]
-            feats, prices = raw[:, :-1], raw[:, -1]
+            feats = (raw[:, :-1] - mu) / sd
+            self.features = feats
+            self.prices = raw[:, -1].astype(np.float32)
+            return
         else:
             rng = np.random.RandomState(51 if mode == "train" else 52)
             n = 404 if mode == "train" else 102

@@ -115,3 +115,37 @@ def test_decorate_o2_casts_params():
     m = nn.Linear(4, 4)
     amp.decorate(models=m, level="O2", dtype="bfloat16")
     assert m.weight._array.dtype == jnp.bfloat16
+
+
+def test_amp_linear_dots_are_bf16():
+    """Regression (r4): `linear` missing from the AMP white list ran
+    every nn.Linear matmul — fwd and bwd — in f32; the BERT step had 225
+    of 300 dots f32. Pin the compiled dot dtypes."""
+    import re
+
+    import jax
+
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.framework import jit as fjit
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(32, 32), nn.ReLU(), nn.Linear(32, 32))
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+
+    def loss_fn(mm, x, y):
+        with amp.auto_cast():
+            out = mm(x)
+        return F.mse_loss(out.astype("float32"), y)
+
+    step = fjit.train_step(m, o, loss_fn)
+    x = np.random.RandomState(0).randn(4, 32).astype("float32")
+    y = np.random.RandomState(1).randn(4, 32).astype("float32")
+    txt = jax.jit(step.pure).lower(
+        step.state, (x, y), jax.numpy.float32(1e-3), jax.random.PRNGKey(0)
+    ).as_text()
+    dots = [
+        re.findall(r"tensor<[^>]*?x(f32|bf16)>", line)
+        for line in txt.splitlines() if "dot_general" in line
+    ]
+    assert dots, "expected dot_generals in the lowered step"
+    assert all(set(d) == {"bf16"} for d in dots), dots

@@ -52,7 +52,10 @@ def collect():
     lines = []
     for mod_name in MODULES:
         mod = importlib.import_module(mod_name)
-        for name in sorted(dir(mod)):
+        # __all__ is the module's declared public surface — incidental
+        # imports must not get pinned as API
+        public = getattr(mod, "__all__", None)
+        for name in sorted(public) if public is not None else sorted(dir(mod)):
             if name.startswith("_"):
                 continue
             obj = getattr(mod, name)

@@ -1,0 +1,97 @@
+"""BERT-base train-step device profile + HLO cost stats (headline-metric
+evidence, companion to tools/hlo_resnet.py)."""
+from __future__ import annotations
+
+import collections
+import gzip
+import json
+import glob
+import os
+import re
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import amp
+    from paddle_tpu.framework import jit as fjit
+    from paddle_tpu.models import (
+        BertConfig, BertForPretraining, BertPretrainingCriterion,
+    )
+
+    cfg = BertConfig(use_flash_attention=True)
+    batch, seq, n_pred = 128, 128, 20
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids, tt, pos, mlm, nsp):
+        with amp.auto_cast():
+            pred, rel = m(ids, tt, masked_positions=pos)
+        return crit(pred.astype("float32"), rel.astype("float32"), mlm, nsp)
+
+    step = fjit.train_step(model, optimizer, loss_fn)
+    rng = np.random.RandomState(0)
+    ids = jax.device_put(rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64"))
+    tt = jax.device_put(rng.randint(0, 2, (batch, seq)).astype("int64"))
+    pos = jax.device_put(np.stack(
+        [rng.choice(seq, n_pred, replace=False) + i * seq for i in range(batch)]
+    ).ravel().astype("int64"))
+    mlm = jax.device_put(rng.randint(0, cfg.vocab_size, (batch * n_pred,)).astype("int64"))
+    nsp = jax.device_put(rng.randint(0, 2, (batch, 1)).astype("int64"))
+
+    # HLO cost stats
+    lr = jax.numpy.asarray(1e-4, jax.numpy.float32)
+    key = jax.random.PRNGKey(0)
+    batch_args = (ids, tt, pos, mlm, nsp)
+    compiled = jax.jit(step.pure).lower(step.state, batch_args, lr, key).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    txt = compiled.as_text()
+    convs = collections.Counter(
+        m.group(1).split("[")[0]
+        for m in re.finditer(r"= (\S+) (?:convolution|dot)\(", txt)
+    )
+    print(json.dumps({
+        "flops_T": round(ca.get("flops", 0) / 1e12, 2),
+        "bytes_GB": round(ca.get("bytes accessed", 0) / 1e9, 2),
+        "matmul_dtypes": dict(convs),
+    }), flush=True)
+
+    # device trace
+    float(np.asarray(step(*batch_args)["loss"]))
+    float(np.asarray(step(*batch_args)["loss"]))
+    jax.profiler.start_trace("/tmp/bert_trace")
+    for _ in range(3):
+        m = step(*batch_args)
+    float(np.asarray(m["loss"]))
+    jax.profiler.stop_trace()
+
+    run = sorted(os.listdir("/tmp/bert_trace/plugins/profile"))[-1]
+    path = sorted(glob.glob(
+        f"/tmp/bert_trace/plugins/profile/{run}/*.trace.json.gz"))[-1]
+    with gzip.open(path) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    pids = {e["pid"]: e["args"]["name"] for e in evs
+            if e.get("ph") == "M" and e.get("name") == "process_name"}
+    agg = collections.Counter()
+    for e in evs:
+        if e.get("ph") == "X" and "TPU" in pids.get(e["pid"], ""):
+            n = e["name"]
+            if n.startswith("jit_pure") or n.isdigit():
+                continue
+            agg[n] += e.get("dur", 0)
+    total = sum(agg.values())
+    print(json.dumps({"device_ms_per_step": round(total / 3e3, 2)}), flush=True)
+    for name, d in agg.most_common(20):
+        print(f"{d/3e3:8.3f} ms/step  {name[:80]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
