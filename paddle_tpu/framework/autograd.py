@@ -74,7 +74,11 @@ class GradNode:
 
 
 def _is_floating(dtype) -> bool:
-    return jax.numpy.issubdtype(dtype, np.floating)
+    # complex counts: jax reverse-mode handles complex64/128 (Wirtinger
+    # convention), matching the reference's ComplexVariable grads
+    return jax.numpy.issubdtype(dtype, np.floating) or jax.numpy.issubdtype(
+        dtype, np.complexfloating
+    )
 
 
 # AMP autocast hook (imperative/amp_auto_cast.cc equivalent): installed by

@@ -22,6 +22,8 @@ from .lr import LRScheduler
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
     "RMSProp", "Adamax", "Lamb", "lr",
+    "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+    "L1Decay", "L2Decay",
 ]
 
 

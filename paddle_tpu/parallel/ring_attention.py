@@ -98,18 +98,18 @@ def _ring_body(q, k, v, mask, *, axis, scale, causal):
     return (acc / denom[..., None]).astype(q.dtype)
 
 
-def ring_attention(q, k, v, mask=None, axis="sp", causal=False, scale=None,
-                   mesh=None):
-    """Attention with K/V ring-rotated over the sp axis.
-
-    q, k, v: [B, H, L, D] arrays (or Tensors) whose L dim is sharded over
-    ``axis`` in the enclosing mesh; mask: additive [B, 1, 1, L] or
-    [B, 1, Lq, Lk] (only the K-dim-sharded [B,1,1,L] form rotates).
-    Falls back to plain attention when no mesh / axis size 1.
-    """
+def _dispatch_sp_attention(op_name, body_builder, q, k, v, mask, axis,
+                           causal, scale, mesh, guard=None):
+    """Shared dispatch tail for the two SP attention modes (ring and
+    Ulysses): Tensor unwrap, plain-attention fallback without a mesh,
+    partial-manual shard_map construction (sp manual, dp/tp GSPMD-auto),
+    eager resharding of single-device-committed tensors, and tape
+    routing. ``body_builder(scale)`` returns the per-shard body
+    ``f(q, k, v, mask_or_None)``; ``guard(qa, n)`` may raise for
+    unsupported geometries."""
     from ..framework.tensor import Tensor
 
-    unwrap = lambda t: t._array if isinstance(t, Tensor) else t
+    unwrap = lambda t: t._array if isinstance(t, Tensor) else t  # noqa: E731
     wrap_out = isinstance(q, Tensor)
     qa, ka, va = unwrap(q), unwrap(k), unwrap(v)
     ma = unwrap(mask) if mask is not None else None
@@ -119,14 +119,16 @@ def ring_attention(q, k, v, mask=None, axis="sp", causal=False, scale=None,
     mesh = mesh or get_mesh()
     n = axis_size(axis, mesh)
     if mesh is None or n == 1:
-        pure = lambda q, k, v, *m_: _plain_attention(
+        pure = lambda q, k, v, *m_: _plain_attention(  # noqa: E731
             q, k, v, m_[0] if m_ else None, scale, causal
         )
     else:
+        if guard is not None:
+            guard(qa, n)
         # partial-manual: only sp is manual; dp/tp remain GSPMD-auto so
         # this nests inside tp/dp-partitioned programs
         specs = P(None, None, axis, None)
-        body = partial(_ring_body, axis=axis, scale=scale, causal=causal)
+        body = body_builder(scale)
         if ma is None:
             pure = jax.shard_map(
                 lambda q, k, v: body(q, k, v, None),
@@ -151,6 +153,36 @@ def ring_attention(q, k, v, mask=None, axis="sp", causal=False, scale=None,
         tensors = [q, k, v] + ([mask] if ma is not None else [])
         tensors = [t if isinstance(t, Tensor) else Tensor._from_array(jnp.asarray(t))
                    for t in tensors]
-        return apply_op("ring_attention", pure, tensors, {})
+        if mesh is not None and n > 1:
+            # eager edge: single-device-committed tensors conflict with
+            # the mesh inside vjp; settle operands onto the sp layout once
+            from jax.sharding import NamedSharding
+
+            qspec = NamedSharding(mesh, P(None, None, axis, None))
+            mspec = NamedSharding(mesh, P(None, None, None, axis))
+            for i, t in enumerate(tensors):
+                if not isinstance(t._array, jax.core.Tracer):
+                    t._array = jax.device_put(
+                        t._array,
+                        mspec if (ma is not None and i == 3) else qspec,
+                    )
+        return apply_op(op_name, pure, tensors, {})
     args = (qa, ka, va) if ma is None else (qa, ka, va, ma)
     return pure(*args)
+
+
+def ring_attention(q, k, v, mask=None, axis="sp", causal=False, scale=None,
+                   mesh=None):
+    """Attention with K/V ring-rotated over the sp axis.
+
+    q, k, v: [B, H, L, D] arrays (or Tensors) whose L dim is sharded over
+    ``axis`` in the enclosing mesh; mask: additive [B, 1, 1, L] or
+    [B, 1, Lq, Lk] (only the K-dim-sharded [B,1,1,L] form rotates).
+    Falls back to plain attention when no mesh / axis size 1.
+    """
+    return _dispatch_sp_attention(
+        "ring_attention",
+        lambda scale: partial(_ring_body, axis=axis, scale=scale,
+                              causal=causal),
+        q, k, v, mask, axis, causal, scale, mesh,
+    )

@@ -14,8 +14,8 @@ num_heads % sp == 0 and holds full-length K/V per head slice, so its
 max L is bounded by per-chip HBM while the ring's is not. Both ride ICI
 (lax.all_to_all / ppermute under shard_map).
 
-Implemented as a partial-manual shard_map island (only sp manual) so it
-nests inside dp/tp GSPMD programs, same pattern as ring_attention.
+Dispatch plumbing (shard_map island, Tensor tape routing, eager
+resharding) is shared with ring_attention via _dispatch_sp_attention.
 """
 from __future__ import annotations
 
@@ -24,10 +24,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
-from .mesh import axis_size, get_mesh
-from .ring_attention import _plain_attention
+from .ring_attention import _dispatch_sp_attention, _plain_attention
 
 __all__ = ["ulysses_attention"]
 
@@ -38,9 +36,8 @@ def _ulysses_body(q, k, v, mask, *, axis, scale, causal):
 
     def seq_to_heads(x):
         # [B, H, Ls, D] -> all_to_all on H -> [B, H/sp, L, D]
-        y = lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
-                           tiled=True)
-        return y
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
 
     def heads_to_seq(x):
         return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
@@ -65,67 +62,18 @@ def ulysses_attention(q, k, v, mask=None, axis="sp", causal=False,
     (K-dim sharded, same contract as ring_attention). Falls back to plain
     attention when no mesh / axis size 1.
     """
-    from ..framework.tensor import Tensor
 
-    unwrap = lambda t: t._array if isinstance(t, Tensor) else t  # noqa: E731
-    wrap_out = isinstance(q, Tensor)
-    qa, ka, va = unwrap(q), unwrap(k), unwrap(v)
-    ma = unwrap(mask) if mask is not None else None
-    if scale is None:
-        scale = float(qa.shape[-1]) ** -0.5
-
-    mesh = mesh or get_mesh()
-    n = axis_size(axis, mesh)
-    if mesh is None or n == 1:
-        pure = lambda q, k, v, *m_: _plain_attention(  # noqa: E731
-            q, k, v, m_[0] if m_ else None, scale, causal
-        )
-    else:
+    def guard(qa, n):
         if qa.shape[1] % n != 0:
             raise ValueError(
                 f"ulysses_attention needs num_heads ({qa.shape[1]}) "
                 f"divisible by the {axis!r} axis size ({n}); use "
                 "ring_attention for head counts that do not split"
             )
-        specs = P(None, None, axis, None)
-        body = partial(_ulysses_body, axis=axis, scale=scale,
-                       causal=causal)
-        if ma is None:
-            pure = jax.shard_map(
-                lambda q, k, v: body(q, k, v, None),
-                mesh=mesh, in_specs=(specs, specs, specs),
-                out_specs=specs, axis_names={axis}, check_vma=False,
-            )
-        else:
-            mask_spec = P(None, None, None, axis)
-            pure = jax.shard_map(
-                body, mesh=mesh,
-                in_specs=(specs, specs, specs, mask_spec),
-                out_specs=specs, axis_names={axis}, check_vma=False,
-            )
-        pure = jax.jit(pure)  # partial-manual lowers under jit; inlines
-    if wrap_out:
-        from ..framework.autograd import apply_op
 
-        tensors = [q, k, v] + ([mask] if ma is not None else [])
-        tensors = [
-            t if isinstance(t, Tensor) else Tensor._from_array(jnp.asarray(t))
-            for t in tensors
-        ]
-        if mesh is not None and n > 1:
-            # eager edge: single-device-committed tensors conflict with
-            # the mesh inside vjp; settle operands onto the sp layout
-            # once (GPipe.forward's pattern)
-            from jax.sharding import NamedSharding
-
-            qspec = NamedSharding(mesh, P(None, None, axis, None))
-            mspec = NamedSharding(mesh, P(None, None, None, axis))
-            for i, t in enumerate(tensors):
-                if not isinstance(t._array, jax.core.Tracer):
-                    t._array = jax.device_put(
-                        t._array, mspec if (ma is not None and i == 3)
-                        else qspec,
-                    )
-        return apply_op("ulysses_attention", pure, tensors, {})
-    args = (qa, ka, va) if ma is None else (qa, ka, va, ma)
-    return pure(*args)
+    return _dispatch_sp_attention(
+        "ulysses_attention",
+        lambda scale: partial(_ulysses_body, axis=axis, scale=scale,
+                              causal=causal),
+        q, k, v, mask, axis, causal, scale, mesh, guard=guard,
+    )

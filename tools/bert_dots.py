@@ -1,69 +1,30 @@
-"""Attribute f32 vs bf16 dots in the BERT step HLO by op_name metadata."""
+"""Attribute f32 vs bf16 dots in the BERT step HLO (the census that
+caught the missing-"linear" AMP white-list entry, see COVERAGE.md)."""
 from __future__ import annotations
 
 import collections
 import re
-
-import numpy as np
+import sys
 
 
 def main():
     import jax
 
-    import paddle_tpu as paddle
-    import paddle_tpu.optimizer as opt
-    from paddle_tpu import amp
-    from paddle_tpu.framework import jit as fjit
-    from paddle_tpu.models import (
-        BertConfig, BertForPretraining, BertPretrainingCriterion,
-    )
+    sys.path.insert(0, ".")
+    from tools.bert_step_common import build_bert_step
 
-    cfg = BertConfig(use_flash_attention=True)
-    batch, seq, n_pred = 128, 128, 20
-    paddle.seed(0)
-    model = BertForPretraining(cfg)
-    crit = BertPretrainingCriterion(cfg.vocab_size)
-    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
-
-    def loss_fn(m, ids, tt, pos, mlm, nsp):
-        with amp.auto_cast():
-            pred, rel = m(ids, tt, masked_positions=pos)
-        return crit(pred.astype("float32"), rel.astype("float32"), mlm, nsp)
-
-    step = fjit.train_step(model, optimizer, loss_fn)
-    rng = np.random.RandomState(0)
-    ids = rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64")
-    tt = rng.randint(0, 2, (batch, seq)).astype("int64")
-    pos = np.stack(
-        [rng.choice(seq, n_pred, replace=False) + i * seq
-         for i in range(batch)]).ravel().astype("int64")
-    mlm = rng.randint(0, cfg.vocab_size, (batch * n_pred,)).astype("int64")
-    nsp = rng.randint(0, 2, (batch, 1)).astype("int64")
-
+    step, args = build_bert_step()
     lr = jax.numpy.asarray(1e-4, jax.numpy.float32)
     key = jax.random.PRNGKey(0)
-    # use the STABLEHLO (pre-optimization) text: metadata survives there
-    lowered = jax.jit(step.pure).lower(
-        step.state, (ids, tt, pos, mlm, nsp), lr, key)
-    txt = lowered.as_text()
+    txt = jax.jit(step.pure).lower(step.state, args, lr, key).as_text()
     agg = collections.Counter()
     for line in txt.splitlines():
         if "dot_general" not in line:
             continue
         dt = "f32" if re.search(r"->\s*tensor<[^>]*f32>", line) else (
             "bf16" if re.search(r"->\s*tensor<[^>]*bf16>", line) else "?")
-        nm = re.search(r'loc\("([^"]*)"', line)
-        name = nm.group(1) if nm else "?"
-        # compress the op_name path to its most telling component
-        short = "/".join(p for p in name.split("/") if p)[:110]
-        agg[(dt, short)] += 1
-    by_dtype = collections.Counter()
-    for (dt, name), c in agg.items():
-        by_dtype[dt] += c
-    print(dict(by_dtype))
-    for (dt, name), c in sorted(agg.items()):
-        if dt == "f32":
-            print(f"f32 x{c}  {name}")
+        agg[dt] += 1
+    print(dict(agg))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,47 @@
+"""Shared BERT-base train-step construction for the perf diagnostics
+(tools/profile_bert.py and tools/bert_dots.py must measure the SAME
+program as bench.py's headline recipe)."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def build_bert_step(batch=128, seq=128, n_pred=20, device_put=False):
+    """Returns (step, batch_args) — the bench.py phase-1 recipe."""
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import amp
+    from paddle_tpu.framework import jit as fjit
+    from paddle_tpu.models import (
+        BertConfig, BertForPretraining, BertPretrainingCriterion,
+    )
+
+    cfg = BertConfig(use_flash_attention=True)
+    paddle.seed(0)
+    model = BertForPretraining(cfg)
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    optimizer = opt.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+
+    def loss_fn(m, ids, tt, pos, mlm, nsp):
+        with amp.auto_cast():
+            pred, rel = m(ids, tt, masked_positions=pos)
+        return crit(pred.astype("float32"), rel.astype("float32"), mlm, nsp)
+
+    step = fjit.train_step(model, optimizer, loss_fn)
+    rng = np.random.RandomState(0)
+    args = (
+        rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64"),
+        rng.randint(0, 2, (batch, seq)).astype("int64"),
+        np.stack([
+            rng.choice(seq, n_pred, replace=False) + i * seq
+            for i in range(batch)
+        ]).ravel().astype("int64"),
+        rng.randint(0, cfg.vocab_size, (batch * n_pred,)).astype("int64"),
+        rng.randint(0, 2, (batch, 1)).astype("int64"),
+    )
+    if device_put:
+        args = tuple(jax.device_put(a) for a in args)
+    return step, args

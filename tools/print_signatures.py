@@ -24,6 +24,7 @@ MODULES = [
     "paddle_tpu.nn",
     "paddle_tpu.nn.functional",
     "paddle_tpu.optimizer",
+    "paddle_tpu.optimizer.lr",
     "paddle_tpu.static",
     "paddle_tpu.static.nn",
     "paddle_tpu.tensor",

@@ -15,40 +15,16 @@ import numpy as np
 def main():
     import jax
 
-    import paddle_tpu as paddle
-    import paddle_tpu.optimizer as opt
-    from paddle_tpu import amp
-    from paddle_tpu.framework import jit as fjit
-    from paddle_tpu.models import (
-        BertConfig, BertForPretraining, BertPretrainingCriterion,
-    )
+    import sys
 
-    cfg = BertConfig(use_flash_attention=True)
-    batch, seq, n_pred = 128, 128, 20
-    paddle.seed(0)
-    model = BertForPretraining(cfg)
-    crit = BertPretrainingCriterion(cfg.vocab_size)
-    optimizer = opt.AdamW(learning_rate=1e-4, parameters=model.parameters())
+    sys.path.insert(0, ".")
+    from tools.bert_step_common import build_bert_step
 
-    def loss_fn(m, ids, tt, pos, mlm, nsp):
-        with amp.auto_cast():
-            pred, rel = m(ids, tt, masked_positions=pos)
-        return crit(pred.astype("float32"), rel.astype("float32"), mlm, nsp)
-
-    step = fjit.train_step(model, optimizer, loss_fn)
-    rng = np.random.RandomState(0)
-    ids = jax.device_put(rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64"))
-    tt = jax.device_put(rng.randint(0, 2, (batch, seq)).astype("int64"))
-    pos = jax.device_put(np.stack(
-        [rng.choice(seq, n_pred, replace=False) + i * seq for i in range(batch)]
-    ).ravel().astype("int64"))
-    mlm = jax.device_put(rng.randint(0, cfg.vocab_size, (batch * n_pred,)).astype("int64"))
-    nsp = jax.device_put(rng.randint(0, 2, (batch, 1)).astype("int64"))
+    step, batch_args = build_bert_step(device_put=True)
 
     # HLO cost stats
     lr = jax.numpy.asarray(1e-4, jax.numpy.float32)
     key = jax.random.PRNGKey(0)
-    batch_args = (ids, tt, pos, mlm, nsp)
     compiled = jax.jit(step.pure).lower(step.state, batch_args, lr, key).compile()
     ca = compiled.cost_analysis()
     ca = ca[0] if isinstance(ca, (list, tuple)) else ca
