@@ -201,6 +201,7 @@ from . import inference  # noqa: E402
 from . import jit_api as jit  # noqa: E402  (paddle.jit.to_static/save/load)
 from .hapi import Model  # noqa: E402
 from .hapi.model import summary  # noqa: E402  (hapi/model_summary.py)
+from . import device  # noqa: E402  (memory facade: paddle.device surface)
 from . import vision  # noqa: E402
 from . import text  # noqa: E402  (text datasets: imdb/imikolov/wmt/conll05)
 from . import profiler  # noqa: E402

@@ -154,17 +154,21 @@ def _dispatch_sp_attention(op_name, body_builder, q, k, v, mask, axis,
         tensors = [t if isinstance(t, Tensor) else Tensor._from_array(jnp.asarray(t))
                    for t in tensors]
         if mesh is not None and n > 1:
-            # eager edge: single-device-committed tensors conflict with
-            # the mesh inside vjp; settle operands onto the sp layout once
+            # eager edge: a SINGLE-device-committed tensor conflicts with
+            # the mesh inside vjp — settle it onto the sp layout once.
+            # Arrays already laid out across devices (e.g. dp-sharded by
+            # the caller) are left alone: partial-manual shard_map
+            # composes with their sharding as-is.
             from jax.sharding import NamedSharding
 
             qspec = NamedSharding(mesh, P(None, None, axis, None))
             mspec = NamedSharding(mesh, P(None, None, None, axis))
             for i, t in enumerate(tensors):
-                if not isinstance(t._array, jax.core.Tracer):
+                arr = t._array
+                if (not isinstance(arr, jax.core.Tracer)
+                        and len(arr.sharding.device_set) == 1):
                     t._array = jax.device_put(
-                        t._array,
-                        mspec if (ma is not None and i == 3) else qspec,
+                        arr, mspec if (ma is not None and i == 3) else qspec,
                     )
         return apply_op(op_name, pure, tensors, {})
     args = (qa, ka, va) if ma is None else (qa, ka, va, ma)

@@ -21,8 +21,6 @@ from __future__ import annotations
 
 from functools import partial
 
-import jax
-import jax.numpy as jnp
 from jax import lax
 
 from .ring_attention import _dispatch_sp_attention, _plain_attention

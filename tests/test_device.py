@@ -1,0 +1,26 @@
+"""Device memory facade (paddle.device surface over PJRT arena stats)."""
+import paddle_tpu as paddle
+from paddle_tpu import device
+
+
+def test_device_surface():
+    assert device.device_count() >= 1
+    assert isinstance(device.get_device_name(), str)
+    device.synchronize()  # must not raise
+    stats = device.memory_stats()
+    assert isinstance(stats, dict)  # CPU: empty; TPU: arena counters
+    assert device.memory_allocated() >= 0
+    assert device.max_memory_allocated() >= device.memory_allocated() or \
+        device.max_memory_allocated() == 0
+    device.empty_cache()
+    assert device.is_compiled_with_cuda() is False
+
+
+def test_memory_tracks_allocations_on_stat_backends():
+    import numpy as np
+
+    stats0 = device.memory_stats()
+    t = paddle.to_tensor(np.ones((256, 256), np.float32))
+    if stats0:  # backend publishes counters (TPU)
+        assert device.memory_allocated() > 0
+    del t

@@ -4,27 +4,41 @@ from __future__ import annotations
 
 import collections
 import re
+import os
 import sys
 
 
 def main():
     import jax
 
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     from tools.bert_step_common import build_bert_step
 
     step, args = build_bert_step()
     lr = jax.numpy.asarray(1e-4, jax.numpy.float32)
     key = jax.random.PRNGKey(0)
-    txt = jax.jit(step.pure).lower(step.state, args, lr, key).as_text()
+    lowered = jax.jit(step.pure).lower(step.state, args, lr, key)
+    try:  # debug_info carries loc("...") source attribution per op
+        txt = lowered.as_text(debug_info=True)
+    except TypeError:
+        txt = lowered.as_text()
     agg = collections.Counter()
+    by_site = collections.Counter()
     for line in txt.splitlines():
         if "dot_general" not in line:
             continue
         dt = "f32" if re.search(r"->\s*tensor<[^>]*f32>", line) else (
             "bf16" if re.search(r"->\s*tensor<[^>]*bf16>", line) else "?")
         agg[dt] += 1
+        if dt == "f32":
+            nm = re.search(r'loc\("([^"]+)"', line)
+            by_site[(nm.group(1) if nm else "?")[:110]] += 1
     print(dict(agg))
+    # the attribution that caught the missing-"linear" white-list entry:
+    # every f32 dot named by its source site
+    for site, c in by_site.most_common():
+        print(f"f32 x{c}  {site}")
 
 
 if __name__ == "__main__":

@@ -44,6 +44,7 @@ MODULES = [
     "paddle_tpu.distributed.ps",
     "paddle_tpu.text",
     "paddle_tpu.incubate.hapi_text",
+    "paddle_tpu.device",
 ]
 
 

@@ -17,7 +17,8 @@ def main():
 
     import sys
 
-    sys.path.insert(0, ".")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
     from tools.bert_step_common import build_bert_step
 
     step, batch_args = build_bert_step(device_put=True)
