@@ -1,0 +1,104 @@
+"""CTR-style training with the parameter server + Dataset ingestion.
+
+Demonstrates the two large-scale subsystems working together, single
+process for runnability (the multi-process form just moves each role to
+its own host — see tests/fixtures/ps_trainer.py):
+
+1. MultiSlot text files → InMemoryDataset (native C++ parser, worker
+   fan-out) → global shuffle.
+2. A sparse embedding table living on a TableServer (host RAM), pulled/
+   pushed per batch by PSEmbedding; the dense head trains on-device.
+
+Run: JAX_PLATFORMS=cpu PYTHONPATH=. python examples/ps_ctr_training.py
+"""
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed.ps import PSClient, PSEmbedding, ShardedTable, TableServer
+from paddle_tpu.io import DatasetFactory
+
+
+def write_multislot_files(root, n_files=2, rows=64, seed=0):
+    """label(1 int) | ids(1-3 sparse ints) | dense(2 floats) per line."""
+    rng = np.random.RandomState(seed)
+    paths = []
+    for fi in range(n_files):
+        path = os.path.join(root, f"part-{fi:03d}.txt")
+        with open(path, "w") as f:
+            for _ in range(rows):
+                n_ids = int(rng.randint(1, 4))
+                ids = rng.randint(1, 200, n_ids)
+                # learnable signal: even-id-heavy rows click
+                label = int(ids.sum() % 2 == 0)
+                dense = rng.rand(2).round(3)
+                f.write(
+                    f"1 {label} {n_ids} " + " ".join(map(str, ids))
+                    + " 2 " + " ".join(map(str, dense)) + "\n"
+                )
+        paths.append(path)
+    return paths
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="ps_ctr_")
+    files = write_multislot_files(tmp)
+
+    # -- data: file list -> parsed, shuffled, batched ------------------------
+    import paddle_tpu.static as static
+
+    static.enable_static()
+    label_v = static.data("click", [-1, 1], "int64")
+    ids_v = static.data("slot_ids", [-1, 3], "int64")
+    dense_v = static.data("dense_f", [-1, 2], "float32")
+    static.disable_static()
+
+    ds = DatasetFactory().create_dataset("InMemoryDataset")
+    ds.set_batch_size(16)
+    ds.set_thread(2)
+    ds.set_filelist(files)
+    ds.set_use_var([label_v, ids_v, dense_v])
+    ds.load_into_memory()
+    ds.set_shuffle_seed(0)
+    ds.local_shuffle()
+    print("dataset:", ds.desc(), "instances:", ds.get_memory_data_size())
+
+    # -- parameter server: sparse table off-device ---------------------------
+    server = TableServer().start()
+    table = ShardedTable("ctr_emb", 8, [PSClient(server.endpoint)],
+                         init_std=0.05)
+    emb = PSEmbedding(table)
+
+    paddle.seed(0)
+    head = nn.Sequential(nn.Linear(8 + 2, 16), nn.ReLU(), nn.Linear(16, 2))
+    sgd = opt.Adam(learning_rate=0.01, parameters=head.parameters())
+
+    for epoch in range(3):
+        losses = []
+        for batch in ds._iter_batches():
+            label, ids, dense = batch
+            e = emb(paddle.to_tensor(ids))          # [B, 3, 8] pulled rows
+            feat = paddle.concat(
+                [e.sum(axis=1), paddle.to_tensor(dense)], axis=1)
+            logits = head(feat)
+            loss = F.cross_entropy(
+                logits, paddle.to_tensor(label.ravel())).mean()
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+            emb.push_step(lr=0.05)                  # sparse grads -> server
+            losses.append(float(loss.numpy()))
+        print(f"epoch {epoch}: loss {np.mean(losses):.4f}  "
+              f"(server rows: {table.clients[0].stats()['ctr_emb']})")
+
+    table.clients[0].shutdown_server()
+    print("done")
+
+
+if __name__ == "__main__":
+    main()
