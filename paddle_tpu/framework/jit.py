@@ -224,6 +224,11 @@ def init_opt_state(model, optimizer, state=None):
     accums = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), shapes
     )
+    # optimizers whose accumulators must not start at zero (e.g. Lookahead
+    # slow weights = initial fast weights) expose concrete initial values
+    init_hook = getattr(optimizer, "_init_accumulator_values", None)
+    if init_hook is not None:
+        accums = {**accums, **init_hook()}
     optimizer._accumulators = {k: list(v) for k, v in accums.items()}
     state["opt"] = {
         "accums": accums,

@@ -1,3 +1,8 @@
 """Incubating subsystems (reference: python/paddle/fluid/incubate/)."""
 from . import auto_checkpoint  # noqa: F401
 from . import hapi_text  # noqa: F401  (incubate/hapi/text surface)
+# 2.x incubate optimizer-wrapper names (paddle.incubate.ModelAverage /
+# LookAhead in later reference versions; fluid/optimizer.py:3102,4822)
+from ..optimizer.wrappers import ModelAverage, Lookahead  # noqa: F401
+
+LookAhead = Lookahead

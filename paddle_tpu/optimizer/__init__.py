@@ -380,3 +380,13 @@ class Lamb(Optimizer):
         u_norm = jnp.sqrt(jnp.sum(update**2))
         trust = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
         return param - lr * trust * update
+
+
+# wrapper optimizers (fluid/optimizer.py:3411,3102,4822) — imported last so
+# wrappers.py can see Optimizer on the partially-initialized package
+from .wrappers import (  # noqa: E402
+    ExponentialMovingAverage, ModelAverage, Lookahead, LookaheadOptimizer,
+)
+
+__all__ += ["ExponentialMovingAverage", "ModelAverage", "Lookahead",
+            "LookaheadOptimizer"]

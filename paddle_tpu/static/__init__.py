@@ -31,3 +31,8 @@ from .program import (  # noqa: F401
     program_guard,
     reset_default_programs,
 )
+
+# paddle.static.ExponentialMovingAverage (fluid/optimizer.py:3411) — the
+# dygraph-state implementation works for static params too once pulled out
+# of the scope; exported here for 2.x namespace parity.
+from ..optimizer.wrappers import ExponentialMovingAverage  # noqa: F401

@@ -1,0 +1,324 @@
+"""Wrapper-optimizer parity tests: EMA / ModelAverage / Lookahead.
+
+Reference behavior: tests/unittests/test_ema.py (train-loop EMA vs a numpy
+shadow with bias correction), test_lookahead.py (slow/fast param schedule),
+test_model_average semantics from operators/average_accumulates_op.h:40.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def _param(vals):
+    return pt.framework.Parameter.from_array(np.asarray(vals, np.float32))
+
+
+def _sgd_quadratic_step(p, o):
+    loss = (p * p).sum()
+    loss.backward()
+    o.step()
+    o.clear_grad()
+
+
+# -- ExponentialMovingAverage ------------------------------------------------
+
+
+def test_ema_matches_numpy_shadow():
+    """Mirrors tests/unittests/test_ema.py: EMA tracked across a train loop
+    must equal the hand-computed biased-corrected average."""
+    decay = 0.9
+    p = _param([5.0, -3.0])
+    o = opt.SGD(learning_rate=0.1, parameters=[p])
+    ema = opt.ExponentialMovingAverage(parameters=[p], decay=decay)
+
+    shadow = np.zeros(2, np.float32)
+    w = p.numpy().copy()
+    for t in range(1, 6):
+        _sgd_quadratic_step(p, o)
+        w = w - 0.1 * 2 * w
+        ema.update()
+        shadow = decay * shadow + (1 - decay) * w
+
+    corrected = shadow / (1 - decay**5)
+    raw = p.numpy().copy()
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), corrected, rtol=1e-5)
+    # restored after the context
+    np.testing.assert_allclose(p.numpy(), raw, rtol=1e-6)
+
+
+def test_ema_need_restore_false_then_manual_restore():
+    p = _param([1.0])
+    ema = opt.ExponentialMovingAverage(parameters=[p], decay=0.5)
+    ema.update()
+    raw = p.numpy().copy()
+    with ema.apply(need_restore=False):
+        applied = p.numpy().copy()
+    # still applied after exiting
+    np.testing.assert_allclose(p.numpy(), applied)
+    ema.restore()
+    np.testing.assert_allclose(p.numpy(), raw)
+
+
+def test_ema_thres_steps_schedules_decay():
+    """fluid/optimizer.py:3568 — decay_t = min(decay, (1+t)/(10+t))."""
+    p = _param([2.0])
+    steps = {"t": 0}
+    ema = opt.ExponentialMovingAverage(
+        parameters=[p], decay=0.999, thres_steps=lambda: steps["t"])
+    # at t=0 the scheduled decay is 0.1, far below 0.999
+    ema.update()
+    d0 = (1 + 0) / (10 + 0)
+    shadow = (1 - d0) * 2.0
+    corrected = shadow / (1 - d0)
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), [corrected], rtol=1e-6)
+
+
+def test_ema_state_dict_roundtrip():
+    p = _param([3.0, 4.0])
+    ema = opt.ExponentialMovingAverage(parameters=[p], decay=0.9)
+    ema.update()
+    ema.update()
+    state = ema.state_dict()
+
+    p2 = _param([3.0, 4.0])
+    ema2 = opt.ExponentialMovingAverage(parameters=[p2], decay=0.9)
+    ema2.set_state_dict(state)
+    with ema.apply(), ema2.apply():
+        np.testing.assert_allclose(p.numpy(), p2.numpy(), rtol=1e-6)
+
+
+def test_ema_with_compiled_step_via_sync():
+    """EMA reads live eager params; under TrainStepFn the documented
+    protocol is sync() before update(). The EMA trajectory must then match
+    an eager run of the same model."""
+    from paddle_tpu.framework import jit as fjit
+
+    pt.framework.random.seed(3)
+    net = nn.Linear(4, 2)
+    w0 = [p.numpy().copy() for p in net.parameters()]
+    o = opt.SGD(learning_rate=0.05, parameters=net.parameters())
+    ema = opt.ExponentialMovingAverage(parameters=net.parameters(), decay=0.8)
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    step = fjit.train_step(net, o, lambda m, xb: (m(xb) ** 2).mean())
+    for _ in range(3):
+        step(x)
+        step.sync()
+        ema.update()
+
+    # eager shadow with identical init
+    net2 = nn.Linear(4, 2)
+    for p, w in zip(net2.parameters(), w0):
+        p._array = pt.to_tensor(w)._array
+    o2 = opt.SGD(learning_rate=0.05, parameters=net2.parameters())
+    ema2 = opt.ExponentialMovingAverage(parameters=net2.parameters(), decay=0.8)
+    xb = pt.to_tensor(x)
+    for _ in range(3):
+        loss = (net2(xb) ** 2).mean()
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        ema2.update()
+    with ema.apply(), ema2.apply():
+        for p, q in zip(net.parameters(), net2.parameters()):
+            np.testing.assert_allclose(p.numpy(), q.numpy(), rtol=1e-5,
+                                       atol=1e-6)
+
+
+def test_nested_apply_raises():
+    p = _param([1.0])
+    ema = opt.ExponentialMovingAverage(parameters=[p], decay=0.5)
+    ema.update()
+    import pytest
+    with ema.apply():
+        with pytest.raises(RuntimeError):
+            with ema.apply():
+                pass
+
+
+# -- ModelAverage ------------------------------------------------------------
+
+
+def test_model_average_simple_window():
+    """average_accumulates_op.h:40 — with a window wide enough to never
+    restart, apply() must install the plain mean of the visited params."""
+    p = _param([0.0])
+    ma = opt.ModelAverage(0.9, parameters=[p], min_average_window=100,
+                          max_average_window=100)
+    visited = []
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        p._array = p._array * 0 + v
+        visited.append(v)
+        ma.accumulate()
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), [np.mean(visited)], rtol=1e-6)
+    np.testing.assert_allclose(p.numpy(), [4.0])
+
+
+def test_model_average_window_restart():
+    """Window restart: num_accumulates >= min_average_window and
+    >= num_updates * rate moves sums into sum_3 and zeroes the others."""
+    p = _param([0.0])
+    ma = opt.ModelAverage(1.0, parameters=[p], min_average_window=2,
+                          max_average_window=3)
+    for v in [1.0, 2.0]:
+        p._array = p._array * 0 + v
+        ma.accumulate()
+    # restart fired at step 2: old_num_accumulates=2, num_accumulates=0
+    assert ma.old_num_accumulates == 2 and ma.num_accumulates == 0
+    p._array = p._array * 0 + 6.0
+    ma.accumulate()
+    # average over sum_3 (1+2) + sum_1 (6) / (2 + 1)
+    with ma.apply():
+        np.testing.assert_allclose(p.numpy(), [3.0], rtol=1e-6)
+
+
+def test_model_average_state_dict_roundtrip():
+    p = _param([1.0, 2.0])
+    ma = opt.ModelAverage(0.5, parameters=[p], min_average_window=10,
+                          max_average_window=20)
+    for _ in range(3):
+        ma.accumulate()
+    state = ma.state_dict()
+    p2 = _param([1.0, 2.0])
+    ma2 = opt.ModelAverage(0.5, parameters=[p2], min_average_window=10,
+                           max_average_window=20)
+    ma2.set_state_dict(state)
+    with ma.apply(), ma2.apply():
+        np.testing.assert_allclose(p.numpy(), p2.numpy())
+
+
+# -- Lookahead ---------------------------------------------------------------
+
+
+def test_lookahead_matches_manual_schedule():
+    """fluid/optimizer.py:4822 — every k steps:
+    slow += alpha*(fast-slow); fast = slow."""
+    alpha, k = 0.5, 3
+    p = _param([5.0, -3.0])
+    inner = opt.SGD(learning_rate=0.1, parameters=[p])
+    la = opt.Lookahead(inner, alpha=alpha, k=k)
+
+    w = p.numpy().astype(np.float64).copy()
+    slow = w.copy()
+    for t in range(1, 8):
+        _sgd_quadratic_step(p, la)
+        w = w - 0.1 * 2 * w  # inner SGD on the quadratic
+        if t % k == 0:
+            slow = slow + alpha * (w - slow)
+            w = slow.copy()
+    np.testing.assert_allclose(p.numpy(), w, rtol=1e-5)
+
+
+def test_lookahead_alias_and_validation():
+    p = _param([1.0])
+    inner = opt.SGD(learning_rate=0.1, parameters=[p])
+    assert opt.LookaheadOptimizer is opt.Lookahead
+    import pytest
+    with pytest.raises(ValueError):
+        opt.Lookahead(None)
+    with pytest.raises(ValueError):
+        opt.Lookahead(inner, alpha=1.5)
+    with pytest.raises(ValueError):
+        opt.Lookahead(inner, k=0)
+
+
+def test_lookahead_state_dict_roundtrip():
+    """The whole wrapped state (slow weights + inner Adam moments + step)
+    round-trips through the base Optimizer state_dict."""
+    p = _param([5.0, -3.0])
+    inner = opt.Adam(learning_rate=0.1, parameters=[p])
+    la = opt.Lookahead(inner, alpha=0.5, k=2)
+    for _ in range(3):
+        _sgd_quadratic_step(p, la)
+    state = la.state_dict()
+    assert any(k.startswith("slow_") for k in state)
+    assert any(k.startswith("moment") for k in state)  # inner Adam state too
+
+    p2 = _param([5.0, -3.0])
+    inner2 = opt.Adam(learning_rate=0.1, parameters=[p2])
+    la2 = opt.Lookahead(inner2, alpha=0.5, k=2)
+    p2._array = p._array
+    la2.set_state_dict(state)
+    _sgd_quadratic_step(p, la)
+    _sgd_quadratic_step(p2, la2)
+    np.testing.assert_allclose(p.numpy(), p2.numpy(), rtol=1e-6)
+
+
+def test_lookahead_under_compiled_step_matches_eager():
+    """The compiled TrainStepFn path must produce the same trajectory as
+    the eager loop, including the k-step slow-weight sync (data-dependent,
+    not baked at trace time) and without leaking tracers into the inner
+    optimizer."""
+    from paddle_tpu.framework import jit as fjit
+
+    pt.framework.random.seed(11)
+    net = nn.Linear(3, 2)
+    w0 = [p.numpy().copy() for p in net.parameters()]
+    x = np.random.RandomState(1).randn(6, 3).astype(np.float32)
+
+    def loss_fn(m, xb):
+        return (m(xb) ** 2).mean()
+
+    inner = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+    la = opt.Lookahead(inner, alpha=0.5, k=2)
+    step = fjit.train_step(net, la, loss_fn)
+    for _ in range(5):  # crosses two sync boundaries (k=2)
+        step(x)
+    step.sync()
+    compiled_params = [p.numpy().copy() for p in net.parameters()]
+    # no tracers leaked into the inner optimizer
+    assert isinstance(inner._global_step, (int, np.integer)) or \
+        not hasattr(inner._global_step, "aval")
+
+    net2 = nn.Linear(3, 2)
+    for p, w in zip(net2.parameters(), w0):
+        p._array = pt.to_tensor(w)._array
+    inner2 = opt.SGD(learning_rate=0.1, parameters=net2.parameters())
+    la2 = opt.Lookahead(inner2, alpha=0.5, k=2)
+    xb = pt.to_tensor(x)
+    for _ in range(5):
+        loss = loss_fn(net2, xb)
+        loss.backward()
+        la2.step()
+        la2.clear_grad()
+    for c, q in zip(compiled_params, net2.parameters()):
+        np.testing.assert_allclose(c, q.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_lookahead_set_lr_reaches_inner():
+    p = _param([4.0])
+    inner = opt.SGD(learning_rate=0.1, parameters=[p])
+    la = opt.Lookahead(inner, alpha=0.5, k=10)
+    la.set_lr(0.5)
+    assert la.get_lr() == 0.5
+    before = p.numpy().copy()
+    _sgd_quadratic_step(p, la)
+    np.testing.assert_allclose(p.numpy(), before - 0.5 * 2 * before, rtol=1e-6)
+
+
+def test_lookahead_converges_on_model():
+    rng = np.random.RandomState(0)
+    pt.framework.random.seed(0)
+    net = nn.Linear(4, 1)
+    inner = opt.SGD(learning_rate=0.05, parameters=net.parameters())
+    la = opt.Lookahead(inner, alpha=0.8, k=5)
+    x = pt.to_tensor(rng.randn(16, 4).astype(np.float32))
+    y = pt.to_tensor(rng.randn(16, 1).astype(np.float32))
+    losses = []
+    for _ in range(80):
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        la.step()
+        la.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_incubate_and_static_namespaces():
+    assert pt.incubate.LookAhead is opt.Lookahead
+    assert pt.incubate.ModelAverage is opt.ModelAverage
+    assert pt.static.ExponentialMovingAverage is opt.ExponentialMovingAverage
