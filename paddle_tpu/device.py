@@ -70,8 +70,18 @@ def max_memory_allocated(device=None) -> int:
 
 
 def memory_reserved(device=None) -> int:
+    """Bytes the runtime currently holds from the device (the reference's
+    allocator-reserved-pool semantics, memory/allocation/allocator_facade).
+
+    PJRT publishes no reserved-pool counter, so the closest truthful
+    figure is ``peak_bytes_in_use`` — the arena's high-water mark, a floor
+    on what the runtime holds. Returns 0 when the backend publishes no
+    counters at all. NOT ``bytes_limit``: that is total addressable HBM
+    capacity, and reporting it here would make reserved look like the
+    whole chip (use ``memory_stats()['bytes_limit']`` for capacity).
+    """
     s = memory_stats(device)
-    return int(s.get("bytes_limit", s.get("bytes_reservable_limit", 0)))
+    return int(s.get("peak_bytes_in_use", 0))
 
 
 def empty_cache():

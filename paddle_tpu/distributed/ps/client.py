@@ -76,6 +76,13 @@ class PSClient:
     def stats(self):
         return self.request("stats")
 
+    def save(self, dirname):
+        """checkpoint_notify parity: server snapshots all tables to dir."""
+        return self.request("save", str(dirname))
+
+    def load(self, dirname):
+        return self.request("load", str(dirname))
+
     def shutdown_server(self):
         try:
             return self.request("shutdown")

@@ -8,11 +8,21 @@ and the sync barrier of the sync-mode transpiler
 
 TPU-native redesign: the PS holds what does NOT belong on a TPU chip —
 huge, sparsely-touched embedding tables living in host RAM. The transport
-is a plain length-prefixed-pickle TCP loop (python threads; the grpc/brpc
-machinery of the reference collapses because there are no zero-copy GPU
-buffers to negotiate — rows are small numpy slabs). Dense parameters stay
-on the TPU path (collectives over ICI); ONLY the sparse half goes through
-the PS, which is also the reference's recommended large-scale layout.
+is a length-prefixed TCP loop (python threads; the grpc/brpc machinery of
+the reference collapses because there are no zero-copy GPU buffers to
+negotiate — rows are small numpy slabs) carrying a fixed type-tagged
+binary codec: struct-packed scalars/strings plus raw C-order numpy bytes,
+mirroring the role of the reference's protobuf schema
+(operators/distributed/send_recv.proto.in). Deserialization never
+constructs code objects — no pickle anywhere on the wire — so a hostile
+peer that reaches the port can at worst read/write table rows, never
+execute code. Dense parameters stay on the TPU path (collectives over
+ICI); ONLY the sparse half goes through the PS, which is also the
+reference's recommended large-scale layout.
+
+Trust model: the server binds loopback by default; binding a routable
+address puts the table contents (not the host) at risk — run it inside
+the training network perimeter exactly as the reference's brpc PS expects.
 
 Row updates:
 - sync/async ("sgd"/"adagrad"): trainers push per-row gradients, the
@@ -24,36 +34,162 @@ Row updates:
 """
 from __future__ import annotations
 
-import pickle
+import os
 import socket
 import struct
 import threading
+import time
 
 import numpy as np
 
 __all__ = ["TableServer", "serve_forever"]
 
 
+# -- wire codec -------------------------------------------------------------
+# Type-tagged binary values; the decoder is a pure data parser (struct +
+# np.frombuffer), so untrusted bytes cannot execute anything. Supported
+# value types are exactly what the PS protocol needs: None, bool, int,
+# float, str, bytes, non-object ndarray, list/tuple, dict[str, value].
+
+_MAGIC = b"PTPS"
+_MAX_MSG = 1 << 31  # reject garbage/hostile length prefixes early
+
+
+def _enc_value(obj, out):
+    if obj is None:
+        out.append(b"N")
+    elif obj is True:
+        out.append(b"T")
+    elif obj is False:
+        out.append(b"F")
+    elif isinstance(obj, (int, np.integer)):
+        out.append(b"i" + struct.pack("<q", int(obj)))
+    elif isinstance(obj, (float, np.floating)):
+        out.append(b"f" + struct.pack("<d", float(obj)))
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        out.append(b"s" + struct.pack("<I", len(b)) + b)
+    elif isinstance(obj, (bytes, bytearray)):
+        out.append(b"b" + struct.pack("<I", len(obj)) + bytes(obj))
+    elif isinstance(obj, np.ndarray):
+        if obj.dtype.hasobject:
+            raise TypeError("object arrays are not wire-encodable")
+        descr = np.lib.format.dtype_to_descr(obj.dtype).encode("ascii")
+        a = np.ascontiguousarray(obj)
+        out.append(
+            b"a"
+            + struct.pack("<B", len(descr)) + descr
+            + struct.pack("<B", a.ndim)
+            + struct.pack("<%dq" % a.ndim, *a.shape)
+            + a.tobytes()
+        )
+    elif isinstance(obj, (list, tuple)):
+        out.append(b"l" + struct.pack("<I", len(obj)))
+        for v in obj:
+            _enc_value(v, out)
+    elif isinstance(obj, dict):
+        out.append(b"d" + struct.pack("<I", len(obj)))
+        for k, v in obj.items():
+            if not isinstance(k, str):
+                raise TypeError("wire dict keys must be str")
+            kb = k.encode("utf-8")
+            out.append(struct.pack("<I", len(kb)) + kb)
+            _enc_value(v, out)
+    else:
+        raise TypeError(f"not wire-encodable: {type(obj).__name__}")
+
+
+def _dec_value(buf, off):
+    tag = buf[off:off + 1]
+    off += 1
+    if tag == b"N":
+        return None, off
+    if tag == b"T":
+        return True, off
+    if tag == b"F":
+        return False, off
+    if tag == b"i":
+        return struct.unpack_from("<q", buf, off)[0], off + 8
+    if tag == b"f":
+        return struct.unpack_from("<d", buf, off)[0], off + 8
+    if tag in (b"s", b"b"):
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        raw = bytes(buf[off:off + n])
+        return (raw.decode("utf-8") if tag == b"s" else raw), off + n
+    if tag == b"a":
+        (dlen,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        dtype = np.lib.format.descr_to_dtype(
+            buf[off:off + dlen].decode("ascii"))
+        off += dlen
+        if dtype.hasobject:
+            raise ValueError("object dtype rejected on the wire")
+        (ndim,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        shape = struct.unpack_from("<%dq" % ndim, buf, off)
+        off += 8 * ndim
+        if any(d < 0 for d in shape):
+            raise ValueError(f"negative array dim on the wire: {shape}")
+        count = int(np.prod(shape, dtype=np.int64)) if ndim else 1
+        nbytes = count * dtype.itemsize
+        if nbytes > len(buf) - off:
+            raise ValueError("array payload exceeds message bounds")
+        arr = np.frombuffer(
+            buf, dtype=dtype, count=count, offset=off
+        ).reshape(shape).copy()  # copy: writable, detached from the buffer
+        return arr, off + nbytes
+    if tag == b"l":
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            v, off = _dec_value(buf, off)
+            items.append(v)
+        return tuple(items), off
+    if tag == b"d":
+        (n,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        d = {}
+        for _ in range(n):
+            (klen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            k = bytes(buf[off:off + klen]).decode("utf-8")
+            off += klen
+            d[k], off = _dec_value(buf, off)
+        return d, off
+    raise ValueError(f"bad wire tag {tag!r} at offset {off - 1}")
+
+
 def _recv_msg(sock):
     hdr = b""
-    while len(hdr) < 8:
-        chunk = sock.recv(8 - len(hdr))
+    while len(hdr) < 12:
+        chunk = sock.recv(12 - len(hdr))
         if not chunk:
             return None
         hdr += chunk
-    (n,) = struct.unpack("<q", hdr)
+    if hdr[:4] != _MAGIC:
+        raise ValueError("bad PS wire magic (protocol mismatch or garbage)")
+    (n,) = struct.unpack("<q", hdr[4:])
+    if not 0 <= n <= _MAX_MSG:
+        raise ValueError(f"implausible PS message length {n}")
     buf = bytearray()
     while len(buf) < n:
         chunk = sock.recv(min(1 << 20, n - len(buf)))
         if not chunk:
             return None
         buf += chunk
-    return pickle.loads(bytes(buf))
+    val, off = _dec_value(bytes(buf), 0)
+    if off != n:
+        raise ValueError("trailing bytes in PS message")
+    return val
 
 
 def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=4)
-    sock.sendall(struct.pack("<q", len(payload)) + payload)
+    out = []
+    _enc_value(obj, out)
+    payload = b"".join(out)
+    sock.sendall(_MAGIC + struct.pack("<q", len(payload)) + payload)
 
 
 class _Table:
@@ -116,15 +252,52 @@ class _Table:
             ids = np.asarray(sorted(self.rows), np.int64)
             return ids, np.stack([self.rows[int(i)] for i in ids])
 
+    def snapshot(self):
+        """Checkpoint payload (checkpoint_notify_op.cc parity): rows +
+        optimizer state + config, all as plain arrays."""
+        with self.lock:
+            ids, rows = self.dump()
+            aids = np.asarray(sorted(self.accum), np.int64)
+            accum = (np.stack([self.accum[int(i)] for i in aids])
+                     if len(aids) else np.zeros((0, self.dim), np.float32))
+            return {
+                "dim": self.dim, "init_std": self.init_std,
+                "optimizer": self.optimizer,
+                "ids": ids, "rows": rows,
+                "accum_ids": aids, "accum": accum,
+            }
+
+    def restore(self, snap):
+        with self.lock:
+            if int(snap["dim"]) != self.dim:
+                raise ValueError(
+                    f"snapshot dim {snap['dim']} != table dim {self.dim}")
+            self.rows = {
+                int(i): np.asarray(r, np.float32)
+                for i, r in zip(snap["ids"], snap["rows"])
+            }
+            self.accum = {
+                int(i): np.asarray(a, np.float32)
+                for i, a in zip(snap["accum_ids"], snap["accum"])
+            }
+
 
 class TableServer:
     """listen_and_serv_op equivalent: a threaded TCP table service."""
 
-    def __init__(self, port=0, host="127.0.0.1"):
+    def __init__(self, port=0, host="127.0.0.1", barrier_timeout=600.0,
+                 ckpt_root=None):
+        # save/load over the wire are confined to this directory; when
+        # None (default) they are refused — a remote peer must never pick
+        # filesystem paths (the reference's checkpoint_notify likewise
+        # writes a server-side-configured dir, checkpoint_notify_op.cc)
+        self._ckpt_root = (os.path.realpath(ckpt_root)
+                           if ckpt_root is not None else None)
         self._tables = {}
         self._tables_lock = threading.RLock()
-        self._barriers = {}  # token -> [count, threading.Condition]
+        self._barriers = {}  # token -> {count, cond, state, error}
         self._barrier_lock = threading.Lock()
+        self._barrier_timeout = float(barrier_timeout)
         self._stop = threading.Event()
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -184,6 +357,10 @@ class TableServer:
         op = msg[0]
         if op == "create_table":
             _, name, dim, init_std, optimizer = msg
+            if "/" in name or "\\" in name or ".." in name or not name:
+                raise ValueError(
+                    f"table name {name!r} must be a plain identifier "
+                    "(it becomes a checkpoint filename)")
             with self._tables_lock:
                 if name not in self._tables:
                     self._tables[name] = _Table(dim, init_std, optimizer)
@@ -216,37 +393,106 @@ class TableServer:
                 return ("ok", {
                     name: len(t.rows) for name, t in self._tables.items()
                 })
+        if op == "save":
+            # checkpoint_notify parity: snapshot every table to a directory
+            _, dirname = msg
+            dirname = self._resolve_ckpt_dir(dirname)
+            os.makedirs(dirname, exist_ok=True)
+            with self._tables_lock:
+                for name, t in self._tables.items():
+                    np.savez(os.path.join(dirname, f"{name}.npz"),
+                             **t.snapshot())
+            return ("ok", None)
+        if op == "load":
+            _, dirname = msg
+            dirname = self._resolve_ckpt_dir(dirname)
+            with self._tables_lock:
+                for fn in sorted(os.listdir(dirname)):
+                    if not fn.endswith(".npz"):
+                        continue
+                    name = fn[:-4]
+                    with np.load(os.path.join(dirname, fn)) as z:
+                        snap = {k: z[k] for k in z.files}
+                    if name not in self._tables:
+                        self._tables[name] = _Table(
+                            int(snap["dim"]), float(snap["init_std"]),
+                            str(snap["optimizer"]))
+                    self._tables[name].restore(snap)
+            return ("ok", None)
         if op == "shutdown":
             self.stop()
             return ("ok", None)
         raise ValueError(f"unknown PS op {op!r}")
 
+    def _resolve_ckpt_dir(self, dirname):
+        """Confine wire-requested checkpoint paths to ckpt_root: a remote
+        peer names a subdirectory, never an arbitrary host path."""
+        if self._ckpt_root is None:
+            raise PermissionError(
+                "this server was started without ckpt_root; save/load "
+                "over the wire are disabled (pass ckpt_root= to "
+                "TableServer/serve_forever)")
+        resolved = os.path.realpath(
+            os.path.join(self._ckpt_root, str(dirname).lstrip("/\\")))
+        if (resolved != self._ckpt_root
+                and not resolved.startswith(self._ckpt_root + os.sep)):
+            raise PermissionError(
+                f"checkpoint path {dirname!r} escapes ckpt_root")
+        return resolved
+
     def _barrier(self, token, n):
-        """Named n-party barrier (sync-mode per-step fence). A shutdown
-        while parties are parked ABORTS the fence with an error — a
-        success reply would silently void the sync-mode guarantee."""
+        """Named n-party barrier (sync-mode per-step fence).
+
+        A shutdown OR a timeout (default 600s; mismatched tokens from a
+        crashed/retried worker would otherwise park everyone forever)
+        ABORTS the fence: every parked party gets an error naming the
+        token and how many of n arrived — a success reply would silently
+        void the sync-mode guarantee."""
         with self._barrier_lock:
-            ent = self._barriers.setdefault(
-                token, [0, threading.Condition(self._barrier_lock)]
-            )
-            ent[0] += 1
-            if ent[0] >= n:
+            ent = self._barriers.get(token)
+            if ent is None:
+                ent = {"count": 0,
+                       "cond": threading.Condition(self._barrier_lock),
+                       "state": "waiting", "error": None}
+                self._barriers[token] = ent
+            ent["count"] += 1
+            if ent["count"] >= n:
+                ent["state"] = "done"
                 self._barriers.pop(token, None)
-                ent[1].notify_all()
+                ent["cond"].notify_all()
                 return
-            cond = ent[1]
-            while token in self._barriers and not self._stop.is_set():
-                cond.wait(timeout=0.5)
-            if self._stop.is_set() and token in self._barriers:
-                raise RuntimeError(
-                    f"barrier {token!r} aborted: server shutting down "
-                    f"with {ent[0]}/{n} parties arrived"
+            deadline = time.monotonic() + self._barrier_timeout
+            while ent["state"] == "waiting" and not self._stop.is_set():
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                ent["cond"].wait(timeout=min(0.5, remaining))
+            if ent["state"] == "done":
+                return
+            if ent["state"] == "waiting":  # first to notice: abort fence
+                cause = ("server shutting down" if self._stop.is_set()
+                         else f"timed out after {self._barrier_timeout:.0f}s")
+                ent["state"] = "aborted"
+                ent["error"] = (
+                    f"barrier {token!r} aborted ({cause}) with "
+                    f"{ent['count']}/{n} parties arrived — a worker "
+                    f"crashed, retried, or called barrier_worker a "
+                    f"different number of times"
                 )
+                # drop the token so it is reusable: parked waiters hold
+                # their own `ent` reference and still see the abort; a
+                # very-late straggler founds a fresh fence (which will
+                # itself time out with its own diagnostic) instead of the
+                # token being poisoned forever
+                self._barriers.pop(token, None)
+                ent["cond"].notify_all()
+            raise RuntimeError(ent["error"])
 
 
-def serve_forever(port=0, host="127.0.0.1", ready_cb=None):
-    """Blocking entry for a dedicated server process."""
-    srv = TableServer(port=port, host=host).start()
+def serve_forever(port=0, host="127.0.0.1", ready_cb=None, **server_kwargs):
+    """Blocking entry for a dedicated server process. Extra kwargs
+    (barrier_timeout, ckpt_root) are forwarded to TableServer."""
+    srv = TableServer(port=port, host=host, **server_kwargs).start()
     if ready_cb is not None:
         ready_cb(srv.endpoint)
     srv.join()

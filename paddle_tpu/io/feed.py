@@ -171,8 +171,16 @@ class DatasetBase:
         return is_float, widths
 
     def _pools_iter(self):
-        """Yield (counts, ints, floats) pools per file, parsed by worker
-        processes over the shm ring (DataLoader's transport)."""
+        """Yield (file_idx, (counts, ints, floats)) per file, parsed by
+        worker processes over the shm ring (DataLoader's transport).
+
+        file_idx is the file's position in the filelist. With thread>1 the
+        rings drain in timing-dependent order, but worker w emits exactly
+        one pool per assigned file, in order — so the consumer recovers
+        the deterministic index as w + seq_w * n_workers. InMemoryDataset
+        reassembles in file order; without this, every trainer would hold
+        a differently-ordered memory and a positional global_shuffle
+        partition would silently drop/duplicate instances."""
         is_float, _ = self._slot_spec()
         if not self._filelist:
             return
@@ -180,8 +188,8 @@ class DatasetBase:
 
         n_workers = min(self._thread_num, len(self._filelist))
         if n_workers <= 1 or not _native.available():
-            for path in self._filelist:
-                yield _parse_bytes(
+            for idx, path in enumerate(self._filelist):
+                yield idx, _parse_bytes(
                     _read_file(path, self._pipe_command), is_float)
             return
 
@@ -203,6 +211,7 @@ class DatasetBase:
             p.start()
             procs.append(p)
         live = set(range(n_workers))
+        seq = [0] * n_workers  # per-worker pool count -> global file index
         try:
             while live:
                 progressed = False
@@ -228,7 +237,9 @@ class DatasetBase:
                             f"dataset parse worker {w}: {payload}"
                         )
                     else:
-                        yield payload
+                        file_idx = w + seq[w] * n_workers
+                        seq[w] += 1
+                        yield file_idx, payload
                 if live and not progressed:
                     import time as _time
 
@@ -290,8 +301,13 @@ class InMemoryDataset(DatasetBase):
         self._shuffled = None
 
     def load_into_memory(self):
+        # reassemble in file order so every trainer holding the same
+        # filelist holds the same instance ordering, no matter how the
+        # worker rings interleave — global_shuffle's positional partition
+        # depends on this
+        chunks = sorted(self._pools_iter(), key=lambda t: t[0])
         self._memory = []
-        for pools in self._pools_iter():
+        for _, pools in chunks:
             self._memory.extend(self._split_instances(pools))
         self._shuffled = None
 
@@ -379,7 +395,7 @@ class QueueDataset(DatasetBase):
     def _iter_batches(self):
         b = self._batch_size
         pending = []
-        for pools in self._pools_iter():
+        for _idx, pools in self._pools_iter():
             pending.extend(self._split_instances(pools))
             while len(pending) >= b:
                 yield self._assemble_batch(pending[:b])

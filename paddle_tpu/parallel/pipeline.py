@@ -180,22 +180,48 @@ def _gpipe_pure(*args, stage0, names, buf_names=(), n_stages, n_micro, axis,
         return out, tuple(new_state["buffers"][n] for n in buf_names)
 
     if mesh is None or int(mesh.shape.get(axis, 1)) == 1:
-        # no pp axis: run stages sequentially (single-device semantics)
-        y = x
-        per_stage_bufs = []
-        for s in range(n_stages):
-            y, nb = stage_fn(
-                {n: stacked[n][s] for n in names},
-                {n: bufs[n][s] for n in buf_names}, y, *extras,
-            )
-            per_stage_bufs.append(nb)
+        # no pp axis: run stages sequentially — but over the SAME n_micro
+        # microbatches as the pipelined path, so stateful buffers
+        # (batchnorm running stats) see an identical update trajectory
+        # (n_micro momentum updates per step, not one full-batch update);
+        # otherwise eval outputs diverge between single-device and
+        # pipelined training of the same model
+        b = x.shape[0]
+        if n_micro > 1 and b % n_micro == 0:
+            x_parts = jnp.split(x, n_micro)
+            ex_parts = [
+                (jnp.split(e, n_micro)
+                 if e.ndim >= 1 and e.shape[0] == b else [e] * n_micro)
+                for e in extras
+            ]
+        else:
+            x_parts = [x]
+            ex_parts = [[e] for e in extras]
+        cur_bufs = {n: bufs[n] for n in buf_names}
+        y_parts = []
+        for m, xm in enumerate(x_parts):
+            y = xm
+            per_stage_bufs = []
+            for s in range(n_stages):
+                y, nb = stage_fn(
+                    {n: stacked[n][s] for n in names},
+                    {n: cur_bufs[n][s] for n in buf_names}, y,
+                    *[ep[m] for ep in ex_parts],
+                )
+                per_stage_bufs.append(nb)
+            y_parts.append(y)
+            if buf_names:
+                cur_bufs = {
+                    n: jnp.stack(
+                        [per_stage_bufs[s][i] for s in range(n_stages)]
+                    )
+                    for i, n in enumerate(buf_names)
+                }
+        y = (jnp.concatenate(y_parts)
+             if len(y_parts) > 1 else y_parts[0])
         if not buf_names:
             return y
-        new_stacked = tuple(
-            jnp.stack([per_stage_bufs[s][i] for s in range(n_stages)])
-            for i in range(n_bufs)
-        )
-        return (y, *new_stacked)
+        return (y, *(cur_bufs[n] for n in buf_names))
 
     b = x.shape[0]
     assert b % n_micro == 0, (b, n_micro)

@@ -250,3 +250,87 @@ def test_auto_checkpoint_claim_name_deterministic():
     assert (a, b, c) == ("LeNet-0", "LeNet-1", "ResNet-0")
     acp.reset_registry()  # "process restart"
     assert acp.claim_name("LeNet") == "LeNet-0"
+
+
+# -- round-4 advisor findings -------------------------------------------------
+
+
+def test_gpipe_buffer_trajectory_matches_between_paths():
+    """ADVICE r4 (low): the no-mesh GPipe path must apply the SAME
+    n_micro per-microbatch BN stat updates as the pp-mesh path, so
+    running stats (and later eval outputs) are identical whether the
+    model trained single-device or pipelined."""
+    import paddle_tpu.parallel as parallel
+    from tests.test_pipeline_sp import BNBlock
+
+    x = np.random.RandomState(3).randn(8, 16).astype("float32")
+
+    def run(mesh_ctx):
+        paddle.seed(21)
+        stages = [BNBlock() for _ in range(4)]
+        pipe = parallel.GPipe(stages, num_microbatches=2)
+        pipe.train()
+        with mesh_ctx() if mesh_ctx else _null():
+            pipe(paddle.to_tensor(x))
+        return {n: np.asarray(b.numpy()) for n, b in pipe.named_buffers()}
+
+    import contextlib
+
+    @contextlib.contextmanager
+    def _null():
+        yield
+
+    no_mesh = run(None)
+    mesh = parallel.create_mesh(pp=4, dp=2)
+    on_mesh = run(lambda: parallel.mesh_scope(mesh))
+    assert no_mesh.keys() == on_mesh.keys()
+    for n in no_mesh:
+        np.testing.assert_allclose(no_mesh[n], on_mesh[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_memory_reserved_is_not_capacity():
+    """ADVICE r4 (low): memory_reserved must report a runtime-held floor
+    (peak_bytes_in_use), never the whole chip's bytes_limit."""
+    from paddle_tpu import device
+
+    stats = device.memory_stats()
+    reserved = device.memory_reserved()
+    if not stats:  # CPU backend publishes nothing -> 0, not capacity
+        assert reserved == 0
+    else:
+        assert reserved == int(stats.get("peak_bytes_in_use", 0))
+        if "bytes_limit" in stats:
+            assert reserved <= int(stats["bytes_limit"])
+
+
+def test_inmemory_dataset_order_deterministic_across_drain_orders(tmp_path):
+    """ADVICE r4 (medium): _memory must be in filelist order regardless of
+    worker-ring drain timing, so global_shuffle's positional partition is
+    consistent across trainers. Exercised via the multi-worker path when
+    the native ring is available, single-worker otherwise — both must
+    produce file order."""
+    from paddle_tpu.io.feed import InMemoryDataset
+
+    files = []
+    for i in range(6):
+        p = tmp_path / f"part-{i}.txt"
+        # one slot, one int value per line = the file's index
+        p.write_text("".join(f"1 {i}\n" for _ in range(3)))
+        files.append(str(p))
+
+    class V:
+        name, dtype, shape = "slot0", "int64", [1]
+
+    def load(threads):
+        ds = InMemoryDataset()
+        ds.set_use_var([V()])
+        ds.set_thread(threads)
+        ds.set_filelist(files)
+        ds.load_into_memory()
+        return [int(inst[0][0]) for inst in ds._memory]
+
+    expected = [i for i in range(6) for _ in range(3)]
+    assert load(1) == expected
+    for _ in range(3):  # multi-worker drain order is timing-dependent
+        assert load(3) == expected

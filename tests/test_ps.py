@@ -198,3 +198,137 @@ def test_all_gather_and_global_shuffle_guard():
         assert len(errs) == 2 and "same full filelist" in errs[0]
     finally:
         srv.stop()
+
+
+def test_ps_snapshot_restart_resume(tmp_path):
+    """checkpoint_notify parity: snapshot the server, kill it, start a
+    fresh one, restore, and training state (rows + adagrad accumulators)
+    resumes exactly."""
+    root = str(tmp_path)
+    srv = TableServer(ckpt_root=root).start()
+    try:
+        c = PSClient(srv.endpoint)
+        t = ShardedTable("emb", 3, [c], init_std=0.1, optimizer="adagrad")
+        g = np.ones((2, 3), np.float32)
+        t.push_grad([1, 5], g, lr=0.5)
+        rows_before = t.pull([1, 5]).copy()
+        c.save("ps_ckpt")  # a subdir of the server's ckpt_root
+        c.shutdown_server()
+    finally:
+        srv.stop()
+
+    srv2 = TableServer(ckpt_root=root).start()
+    try:
+        c2 = PSClient(srv2.endpoint)
+        c2.load("ps_ckpt")
+        t2 = ShardedTable("emb", 3, [c2], init_std=0.9, optimizer="adagrad")
+        np.testing.assert_allclose(t2.pull([1, 5]), rows_before, atol=1e-6)
+        # adagrad accumulators survived: a second identical push moves rows
+        # LESS than the first did (sqrt(2g^2) in the denominator)
+        t2.push_grad([1], np.ones((1, 3), np.float32), lr=0.5)
+        second_delta = rows_before[0] - t2.pull([1])[0]
+        first_delta = 0.5 * 1.0 / (np.sqrt(1.0) + 1e-6)
+        assert np.all(second_delta < first_delta * 0.9)
+        c2.shutdown_server()
+    finally:
+        srv2.stop()
+
+
+def test_ps_wire_codec_roundtrip_and_safety():
+    """The wire codec round-trips every protocol type and its decoder is
+    a pure data parser — hostile bytes raise, never execute."""
+    from paddle_tpu.distributed.ps.server import _dec_value, _enc_value
+
+    def roundtrip(v):
+        out = []
+        _enc_value(v, out)
+        got, off = _dec_value(b"".join(out), 0)
+        return got
+
+    assert roundtrip(None) is None
+    assert roundtrip(True) is True and roundtrip(False) is False
+    assert roundtrip(42) == 42 and roundtrip(-7) == -7
+    assert roundtrip(3.5) == 3.5
+    assert roundtrip("tablé") == "tablé"
+    assert roundtrip(b"\x00\xff") == b"\x00\xff"
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_array_equal(roundtrip(a), a)
+    i = np.array([1, 2], np.int64)
+    np.testing.assert_array_equal(roundtrip(i), i)
+    got = roundtrip(("pull", "t", a, {"n": 3, "x": None}))
+    assert got[0] == "pull" and got[3]["n"] == 3
+    # decoded arrays are writable copies detached from the buffer
+    arr = roundtrip(a)
+    arr[0, 0] = 99.0
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        _dec_value(b"Z", 0)  # unknown tag
+    with _pytest.raises(TypeError):
+        _enc_value(object(), [])  # unencodable
+    obj_arr = np.array([object()], dtype=object)
+    with _pytest.raises(TypeError):
+        _enc_value(obj_arr, [])
+
+
+def test_barrier_timeout_aborts_with_diagnostic():
+    """A lone party at an n=2 fence must get an error naming the token
+    and arrival count after the server-side timeout — not park forever
+    (mismatched tokens from a crashed/retried worker)."""
+    srv = TableServer(barrier_timeout=1.0).start()
+    try:
+        c = PSClient(srv.endpoint)
+        t0 = time.time()
+        with pytest.raises(RuntimeError) as ei:
+            c.barrier("lonely_fence", 2, timeout=30.0)
+        assert time.time() - t0 < 10.0
+        assert "lonely_fence" in str(ei.value) and "1/2" in str(ei.value)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_ps_ckpt_path_confinement(tmp_path):
+    """Wire save/load must be confined to the server's ckpt_root; a peer
+    can never name an arbitrary host path, and a server without ckpt_root
+    refuses the ops entirely."""
+    srv = TableServer(ckpt_root=str(tmp_path / "root")).start()
+    try:
+        c = PSClient(srv.endpoint)
+        ShardedTable("t", 2, [c])
+        with pytest.raises(RuntimeError, match="escapes ckpt_root"):
+            c.save("../outside")
+        c.save("/abs/is/relative")  # leading slash stripped -> inside root
+        assert (tmp_path / "root" / "abs" / "is" / "relative").is_dir()
+        with pytest.raises(RuntimeError, match="plain identifier"):
+            c.create_table("../../etc/evil", 2)
+        c.shutdown_server()
+    finally:
+        srv.stop()
+
+    srv2 = TableServer().start()  # no ckpt_root
+    try:
+        c2 = PSClient(srv2.endpoint)
+        with pytest.raises(RuntimeError, match="without ckpt_root"):
+            c2.save("anywhere")
+        c2.shutdown_server()
+    finally:
+        srv2.stop()
+
+
+def test_wire_codec_rejects_negative_dims():
+    """A hostile negative array dim must raise, not move the decode
+    offset backwards (amplification DoS)."""
+    import struct as _s
+
+    from paddle_tpu.distributed.ps.server import _dec_value
+
+    evil = (b"a" + _s.pack("<B", 5) + b"<f4" + b"  ")  # descr len lies
+    with pytest.raises(Exception):
+        _dec_value(evil, 0)
+    # well-formed header, negative dim
+    descr = b"<f4"
+    payload = (b"a" + _s.pack("<B", len(descr)) + descr
+               + _s.pack("<B", 1) + _s.pack("<q", -4) + b"\x00" * 16)
+    with pytest.raises(ValueError, match="negative array dim"):
+        _dec_value(payload, 0)
