@@ -1,21 +1,33 @@
 """Headline benchmark: BERT-base MLM pretraining tokens/sec/chip, plus
-ResNet-50 images/sec/chip as the secondary BASELINE.md metric.
+ResNet-50 images/sec/chip and BERT phase-2 (seq 512, pallas flash
+attention) as secondary BASELINE.md metrics.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
-"loss_start", "loss_end", "secondary": {...resnet50...}}.
+"loss_start", "loss_end", "median_of", "samples",
+"secondary": {...resnet50...}, "secondary2": {...bert phase-2 flash...}}.
 
 vs_baseline compares against the A100 GPU-parity target from BASELINE.md
 (the reference publishes no numbers in-tree; NVIDIA DeepLearningExamples
 BERT-base phase-1 pretraining, seq 128 fp16 + fused kernels, reports
 ~700-800 sequences/sec on one A100 ≈ 90-100k tokens/sec — we use 90000
-tokens/sec/chip as the parity bar).
+tokens/sec/chip as the parity bar; phase-2 at seq 512 reports ~80-90k
+tokens/sec — we use 85000; ResNet-50 v1.5 AMP+DALI ~2500-2900 images/sec
+— we use 2500).
 
 Recipe parity: phase-1 pretraining at seq 128 with
-max_predictions_per_seq=20 — MLM logits are computed only at the gathered
-masked positions (BertForPretraining masked_positions path), exactly as the
-A100 reference recipe does; dropout (hidden 0.1 + attention 0.1) is ON, as
-in the standard config. RNG uses the TPU-native rbg implementation
-(framework/random.py) — part of the measured win.
+max_predictions_per_seq=20 (phase-2: seq 512, 80) — MLM logits are
+computed only at the gathered masked positions (BertForPretraining
+masked_positions path), exactly as the A100 reference recipe does; dropout
+(hidden 0.1 + attention 0.1) is ON, as in the standard config. RNG uses
+the TPU-native rbg implementation (framework/random.py) — part of the
+measured win. Phase-2 runs the pallas flash-attention kernel
+(ops/pallas/flash_attention.py): seq 512 >= FLASH_ATTENTION_MIN_SEQ, where
+the XLA path OOMs at this batch and the kernel is the measured winner.
+
+Noise discipline: the axon tunnel shows up to ±30% run-to-run variance, so
+a single sample cannot certify a bar crossing. Every metric times
+``repeats`` independent passes in-process and reports the MEDIAN (all
+samples are included in the JSON for auditability).
 
 Timing note: the final loss value is fetched (np.asarray), not just
 block_until_ready'd — on the remote-TPU (axon) backend block_until_ready
@@ -30,19 +42,30 @@ import time
 import numpy as np
 
 GPU_PARITY_TOKENS_PER_SEC = 90000.0
-# NVIDIA DeepLearningExamples ResNet-50 v1.5 training on one A100, AMP +
-# DALI: ~2500-2900 images/sec; 2500 is the parity bar.
+GPU_PARITY_TOKENS_PER_SEC_PHASE2 = 85000.0
 GPU_PARITY_IMAGES_PER_SEC = 2500.0
+
+REPEATS_TPU = 3  # median-of-3: certifies bar crossings under tunnel noise
+
+
+def _timed_median(step_once, items_per_iter, iters, repeats):
+    """Run ``repeats`` timed passes of ``iters`` steps; return
+    (median items/sec, samples, last_loss)."""
+    samples = []
+    last = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            m = step_once()
+        last = float(np.asarray(m["loss"]))  # value fetch = barrier
+        dt = time.perf_counter() - t0
+        samples.append(round(items_per_iter * iters / dt, 1))
+    return float(np.median(samples)), samples, last
 
 
 def bench_resnet50(on_tpu):
     """ResNet-50 images/sec/chip (BASELINE.md row 1)."""
-    import time
-
-    import numpy as np
-
     import paddle_tpu as paddle
-    import paddle_tpu.nn as nn
     import paddle_tpu.nn.functional as F
     import paddle_tpu.optimizer as opt
     from paddle_tpu import amp
@@ -55,9 +78,11 @@ def bench_resnet50(on_tpu):
         # amortizes it below 2% — the steady-state rate a real training
         # loop (which fetches loss rarely) actually sees.
         batch, size, iters, make = 128, 224, 50, resnet50
+        repeats = REPEATS_TPU
         name = "resnet50_images_per_sec_per_chip"
     else:  # CPU smoke: tiny net, tiny images
         batch, size, iters, make = 8, 32, 2, resnet18
+        repeats = 1
         name = "resnet18_cpu_smoke_images_per_sec"
 
     paddle.seed(0)
@@ -84,12 +109,9 @@ def bench_resnet50(on_tpu):
 
     l0 = float(np.asarray(step(x, y)["loss"]))  # warmup/compile
     float(np.asarray(step(x, y)["loss"]))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        m = step(x, y)
-    l1 = float(np.asarray(m["loss"]))  # value fetch = reliable barrier
-    dt = time.perf_counter() - t0
-    ips = batch * iters / dt
+    ips, samples, l1 = _timed_median(
+        lambda: step(x, y), batch, iters, repeats
+    )
     return {
         "metric": name,
         "value": round(ips, 1),
@@ -98,14 +120,25 @@ def bench_resnet50(on_tpu):
         if on_tpu else 0.0,
         "loss_start": round(l0, 4),
         "loss_end": round(l1, 4),
+        "median_of": repeats,
+        "samples": samples,
     }
 
 
-def main():
+def bench_bert(on_tpu, phase=1):
+    """BERT-base MLM pretraining tokens/sec/chip.
+
+    phase 1: seq 128, n_pred 20, batch 128 — the headline (XLA attention
+    path below FLASH_ATTENTION_MIN_SEQ, the measured winner at seq 128).
+    phase 2: seq 512, n_pred 80, batch 32 — runs the pallas flash
+    attention kernel (the measured winner at seq >= 512, where the plain
+    XLA path exhausts HBM at this batch).
+    """
     import jax
 
     import paddle_tpu as paddle
     import paddle_tpu.optimizer as opt
+    from paddle_tpu import amp
     from paddle_tpu.framework import jit as fjit
     from paddle_tpu.models import (
         BertConfig,
@@ -113,29 +146,33 @@ def main():
         BertPretrainingCriterion,
     )
 
-    from paddle_tpu import amp
-
-    on_tpu = jax.devices()[0].platform != "cpu"
-    # BERT-base with bf16 AMP on TPU (BASELINE.md names "bf16 AMP" as the
-    # headline config); batch 128 amortizes the remote-dispatch overhead of
-    # the axon backend. Scaled-down config for CPU smoke so bench.py always
-    # completes quickly in dev environments.
     if on_tpu:
-        # use_flash_attention=True is the recommended TPU config: the MHA
-        # layer dispatches to the pallas flash kernel at seq >= 512 and to
-        # XLA's fused attention below (at seq 128 the XLA path measured
-        # 129k tokens/s vs 104k for the kernel — see COVERAGE.md "Flash
-        # attention" for the committed A/B).
         cfg = BertConfig(use_flash_attention=True)  # base: 12L/768H
-        batch, seq, iters = 128, 128, 50  # amortize tunnel fetch latency
+        if phase == 1:
+            batch, seq, n_pred, iters = 128, 128, 20, 50
+        else:
+            batch, seq, n_pred, iters = 32, 512, 80, 25
+        repeats = REPEATS_TPU
+        name = f"bert_base_pretrain_tokens_per_sec_per_chip"
+        if phase == 2:
+            name = "bert_base_phase2_seq512_flash_tokens_per_sec_per_chip"
+        bar = (GPU_PARITY_TOKENS_PER_SEC if phase == 1
+               else GPU_PARITY_TOKENS_PER_SEC_PHASE2)
     else:
         cfg = BertConfig(
             vocab_size=8192, hidden_size=256, num_hidden_layers=4,
             num_attention_heads=8, intermediate_size=1024,
-            max_position_embeddings=128,
+            max_position_embeddings=512 if phase == 2 else 128,
+            use_flash_attention=(phase == 2),
         )
-        batch, seq, iters = 8, 128, 3
-    n_pred = 20  # max_predictions_per_seq, phase-1 standard
+        if phase == 1:
+            batch, seq, n_pred, iters = 8, 128, 20, 3
+        else:
+            batch, seq, n_pred, iters = 2, 512, 80, 2
+        repeats = 1
+        name = ("bert_small_cpu_smoke_tokens_per_sec" if phase == 1
+                else "bert_small_cpu_smoke_phase2_tokens_per_sec")
+        bar = None
 
     paddle.seed(0)
     model = BertForPretraining(cfg)
@@ -159,7 +196,8 @@ def main():
     tt = jax.device_put(rng.randint(0, 2, (batch, seq)).astype("int64"))
     # flat positions into the [B*L] hidden-state table, n_pred per sequence
     pos = jax.device_put(np.stack(
-        [rng.choice(seq, n_pred, replace=False) + i * seq for i in range(batch)]
+        [rng.choice(seq, n_pred, replace=False) + i * seq
+         for i in range(batch)]
     ).ravel().astype("int64"))
     mlm = jax.device_put(
         rng.randint(0, cfg.vocab_size, (batch * n_pred,)).astype("int64")
@@ -170,28 +208,33 @@ def main():
     loss_start = float(np.asarray(step(ids, tt, pos, mlm, nsp)["loss"]))
     float(np.asarray(step(ids, tt, pos, mlm, nsp)["loss"]))
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        m = step(ids, tt, pos, mlm, nsp)
-    loss_end = float(np.asarray(m["loss"]))  # value fetch = barrier
-    dt = time.perf_counter() - t0
-
-    tokens_per_sec = batch * seq * iters / dt
-    result = {
-        "metric": "bert_base_pretrain_tokens_per_sec_per_chip"
-        if on_tpu
-        else "bert_small_cpu_smoke_tokens_per_sec",
-        "value": round(tokens_per_sec, 1),
+    tps, samples, loss_end = _timed_median(
+        lambda: step(ids, tt, pos, mlm, nsp), batch * seq, iters, repeats
+    )
+    return {
+        "metric": name,
+        "value": round(tps, 1),
         "unit": "tokens/sec",
-        "vs_baseline": round(tokens_per_sec / GPU_PARITY_TOKENS_PER_SEC, 3)
-        if on_tpu
-        else 0.0,
+        "vs_baseline": round(tps / bar, 3) if bar else 0.0,
         # convergence evidence: repeated steps on one batch must drive the
         # loss down (full loss-parity training lives in tests/test_book.py)
         "loss_start": round(loss_start, 4),
         "loss_end": round(loss_end, 4),
-        "secondary": bench_resnet50(on_tpu),
+        "median_of": repeats,
+        "samples": samples,
     }
+
+
+def main():
+    import jax
+
+    on_tpu = jax.devices()[0].platform != "cpu"
+    result = bench_bert(on_tpu, phase=1)
+    result["secondary"] = bench_resnet50(on_tpu)
+    # phase-2 at seq 512 exercises the pallas flash-attention kernel on a
+    # driver-captured number (dispatch: nn/transformer.py
+    # FLASH_ATTENTION_MIN_SEQ)
+    result["secondary2"] = bench_bert(on_tpu, phase=2)
     print(json.dumps(result))
 
 

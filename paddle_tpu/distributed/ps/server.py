@@ -52,7 +52,11 @@ __all__ = ["TableServer", "serve_forever"]
 # float, str, bytes, non-object ndarray, list/tuple, dict[str, value].
 
 _MAGIC = b"PTPS"
-_MAX_MSG = 1 << 31  # reject garbage/hostile length prefixes early
+# reject garbage/hostile length prefixes early. Generous (256 GiB) because
+# full-table dumps of host-RAM embedding tables legitimately run multi-GiB;
+# the receive loop only allocates as bytes actually arrive, so a hostile
+# *claimed* length alone cannot balloon memory.
+_MAX_MSG = 1 << 38
 
 
 def _enc_value(obj, out):
@@ -115,6 +119,8 @@ def _dec_value(buf, off):
     if tag in (b"s", b"b"):
         (n,) = struct.unpack_from("<I", buf, off)
         off += 4
+        if n > len(buf) - off:
+            raise ValueError("string payload exceeds message bounds")
         raw = bytes(buf[off:off + n])
         return (raw.decode("utf-8") if tag == b"s" else raw), off + n
     if tag == b"a":
@@ -407,12 +413,23 @@ class TableServer:
             _, dirname = msg
             dirname = self._resolve_ckpt_dir(dirname)
             with self._tables_lock:
+                # two-pass: read + validate EVERY snapshot before touching
+                # any live table, so a dim mismatch on the Nth file cannot
+                # leave the server half-restored
+                snaps = {}
                 for fn in sorted(os.listdir(dirname)):
                     if not fn.endswith(".npz"):
                         continue
                     name = fn[:-4]
                     with np.load(os.path.join(dirname, fn)) as z:
-                        snap = {k: z[k] for k in z.files}
+                        snaps[name] = {k: z[k] for k in z.files}
+                for name, snap in snaps.items():
+                    t = self._tables.get(name)
+                    if t is not None and t.dim != int(snap["dim"]):
+                        raise ValueError(
+                            f"snapshot {name!r} dim {int(snap['dim'])} != "
+                            f"live table dim {t.dim}; no tables restored")
+                for name, snap in snaps.items():
                     if name not in self._tables:
                         self._tables[name] = _Table(
                             int(snap["dim"]), float(snap["init_std"]),

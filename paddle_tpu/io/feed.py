@@ -304,11 +304,19 @@ class InMemoryDataset(DatasetBase):
         # reassemble in file order so every trainer holding the same
         # filelist holds the same instance ordering, no matter how the
         # worker rings interleave — global_shuffle's positional partition
-        # depends on this
-        chunks = sorted(self._pools_iter(), key=lambda t: t[0])
+        # depends on this. Only out-of-order pools are buffered (the
+        # drain-order backlog), not the whole dataset twice.
         self._memory = []
-        for _, pools in chunks:
-            self._memory.extend(self._split_instances(pools))
+        pending = {}
+        next_idx = 0
+        for idx, pools in self._pools_iter():
+            pending[idx] = pools
+            while next_idx in pending:
+                self._memory.extend(
+                    self._split_instances(pending.pop(next_idx)))
+                next_idx += 1
+        for idx in sorted(pending):  # gaps only if a tail file was empty
+            self._memory.extend(self._split_instances(pending.pop(idx)))
         self._shuffled = None
 
     def local_shuffle(self):

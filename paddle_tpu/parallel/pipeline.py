@@ -180,48 +180,66 @@ def _gpipe_pure(*args, stage0, names, buf_names=(), n_stages, n_micro, axis,
         return out, tuple(new_state["buffers"][n] for n in buf_names)
 
     if mesh is None or int(mesh.shape.get(axis, 1)) == 1:
-        # no pp axis: run stages sequentially — but over the SAME n_micro
-        # microbatches as the pipelined path, so stateful buffers
-        # (batchnorm running stats) see an identical update trajectory
-        # (n_micro momentum updates per step, not one full-batch update);
+        # no pp axis: run stages sequentially. When the model carries
+        # stateful buffers (batchnorm running stats), iterate the SAME
+        # n_micro microbatches as the pipelined path — via lax.scan with
+        # the buffers as carry, so trace/compile cost stays constant in
+        # n_micro — giving an identical buffer update trajectory (n_micro
+        # momentum updates per step, each from microbatch statistics);
         # otherwise eval outputs diverge between single-device and
-        # pipelined training of the same model
+        # pipelined training of the same model. Buffer-free models keep
+        # the plain full-batch pass (pointwise-per-sample ⇒ identical
+        # outputs, cheaper).
         b = x.shape[0]
-        if n_micro > 1 and b % n_micro == 0:
-            x_parts = jnp.split(x, n_micro)
-            ex_parts = [
-                (jnp.split(e, n_micro)
-                 if e.ndim >= 1 and e.shape[0] == b else [e] * n_micro)
-                for e in extras
-            ]
-        else:
-            x_parts = [x]
-            ex_parts = [[e] for e in extras]
-        cur_bufs = {n: bufs[n] for n in buf_names}
-        y_parts = []
-        for m, xm in enumerate(x_parts):
-            y = xm
+        if not (buf_names and n_micro > 1 and b % n_micro == 0):
+            y = x
             per_stage_bufs = []
             for s in range(n_stages):
                 y, nb = stage_fn(
                     {n: stacked[n][s] for n in names},
-                    {n: cur_bufs[n][s] for n in buf_names}, y,
-                    *[ep[m] for ep in ex_parts],
+                    {n: bufs[n][s] for n in buf_names}, y, *extras,
                 )
                 per_stage_bufs.append(nb)
-            y_parts.append(y)
-            if buf_names:
-                cur_bufs = {
-                    n: jnp.stack(
-                        [per_stage_bufs[s][i] for s in range(n_stages)]
-                    )
-                    for i, n in enumerate(buf_names)
-                }
-        y = (jnp.concatenate(y_parts)
-             if len(y_parts) > 1 else y_parts[0])
-        if not buf_names:
-            return y
-        return (y, *(cur_bufs[n] for n in buf_names))
+            if not buf_names:
+                return y
+            new_stacked = tuple(
+                jnp.stack([per_stage_bufs[s][i] for s in range(n_stages)])
+                for i in range(n_bufs)
+            )
+            return (y, *new_stacked)
+
+        mb = b // n_micro
+        x_mb = x.reshape((n_micro, mb) + x.shape[1:])
+        per_sample = [e.ndim >= 1 and e.shape[0] == b for e in extras]
+        scanned_ex = tuple(
+            e.reshape((n_micro, mb) + e.shape[1:])
+            for e, ps in zip(extras, per_sample) if ps
+        )
+        bcast_ex = tuple(e for e, ps in zip(extras, per_sample) if not ps)
+
+        def body(carry, xs):
+            xm = xs[0]
+            it_s, it_b = iter(xs[1:]), iter(bcast_ex)
+            ex = [next(it_s) if ps else next(it_b) for ps in per_sample]
+            y = xm
+            per_stage = []
+            for s in range(n_stages):
+                y, nb = stage_fn(
+                    {n: stacked[n][s] for n in names},
+                    {n: carry[n][s] for n in buf_names}, y, *ex,
+                )
+                per_stage.append(nb)
+            new_carry = {
+                n: jnp.stack([per_stage[s][i] for s in range(n_stages)])
+                for i, n in enumerate(buf_names)
+            }
+            return new_carry, y
+
+        final_bufs, y_mb = lax.scan(
+            body, {n: bufs[n] for n in buf_names}, (x_mb, *scanned_ex)
+        )
+        y = y_mb.reshape((b,) + y_mb.shape[2:])
+        return (y, *(final_bufs[n] for n in buf_names))
 
     b = x.shape[0]
     assert b % n_micro == 0, (b, n_micro)
