@@ -153,9 +153,8 @@ def bench_bert(on_tpu, phase=1):
         else:
             batch, seq, n_pred, iters = 32, 512, 80, 25
         repeats = REPEATS_TPU
-        name = f"bert_base_pretrain_tokens_per_sec_per_chip"
-        if phase == 2:
-            name = "bert_base_phase2_seq512_flash_tokens_per_sec_per_chip"
+        name = ("bert_base_pretrain_tokens_per_sec_per_chip" if phase == 1
+                else "bert_base_phase2_seq512_flash_tokens_per_sec_per_chip")
         bar = (GPU_PARITY_TOKENS_PER_SEC if phase == 1
                else GPU_PARITY_TOKENS_PER_SEC_PHASE2)
     else:

@@ -423,7 +423,14 @@ class TableServer:
                     name = fn[:-4]
                     with np.load(os.path.join(dirname, fn)) as z:
                         snaps[name] = {k: z[k] for k in z.files}
+                required = ("dim", "init_std", "optimizer", "ids", "rows",
+                            "accum_ids", "accum")
                 for name, snap in snaps.items():
+                    missing = [k for k in required if k not in snap]
+                    if missing:
+                        raise ValueError(
+                            f"snapshot {name!r} missing keys {missing}; "
+                            "no tables restored")
                     t = self._tables.get(name)
                     if t is not None and t.dim != int(snap["dim"]):
                         raise ValueError(

@@ -47,6 +47,10 @@ class BertConfig:
     # dispatch attention to the pallas flash kernel (ops/pallas); dropout
     # runs inside the kernel via the TPU PRNG
     use_flash_attention: bool = False
+    # sequence-parallel attention over the sp mesh axis: "none" | "ring"
+    # (parallel/ring_attention.py) | "ulysses" (parallel/ulysses.py).
+    # Requires attention_probs_dropout_prob == 0.
+    sp_attention: str = "none"
 
 
 def bert_base_config() -> BertConfig:
@@ -159,6 +163,7 @@ class BertModel(Layer):
                 attn_dropout=cfg.attention_probs_dropout_prob,
                 act_dropout=0.0,
                 use_flash_attention=cfg.use_flash_attention,
+                sp_attention=cfg.sp_attention,
             )
 
         self._pipelined = pipeline_stages > 1

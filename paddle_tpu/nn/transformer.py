@@ -38,21 +38,27 @@ class MultiHeadAttention(Layer):
 
     def __init__(self, embed_dim, num_heads, dropout=0.0, kdim=None, vdim=None,
                  need_weights=False, weight_attr=None, bias_attr=None,
-                 use_ring_attention=False, use_flash_attention=False):
+                 use_ring_attention=False, use_flash_attention=False,
+                 use_ulysses_attention=False):
         super().__init__()
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.dropout = dropout
         self.need_weights = need_weights
-        # TPU extensions: sequence-parallel ring attention over the sp mesh
-        # axis (parallel/ring_attention.py) and the fused pallas flash
-        # kernel (ops/pallas/flash_attention.py). Flash supports attention
-        # dropout (in-kernel TPU PRNG); ring still requires dropout == 0.
+        # TPU extensions: sequence-parallel attention over the sp mesh axis
+        # — ring (parallel/ring_attention.py) or Ulysses all-to-all
+        # (parallel/ulysses.py) — and the fused pallas flash kernel
+        # (ops/pallas/flash_attention.py). Flash supports attention dropout
+        # (in-kernel TPU PRNG); ring/Ulysses require dropout == 0.
         self.use_ring_attention = use_ring_attention
+        self.use_ulysses_attention = use_ulysses_attention
+        if use_ring_attention and use_ulysses_attention:
+            raise ValueError("pick ONE sp attention mode: ring or ulysses")
         self.use_flash_attention = use_flash_attention
-        if use_ring_attention and dropout:
+        if (use_ring_attention or use_ulysses_attention) and dropout:
             raise ValueError(
-                "ring attention does not support attn dropout"
+                "sequence-parallel attention (ring/ulysses) does not "
+                "support attn dropout"
             )
         self.head_dim = embed_dim // num_heads
         assert self.head_dim * num_heads == embed_dim
@@ -91,6 +97,12 @@ class MultiHeadAttention(Layer):
 
             mask = _convert_attention_mask(attn_mask, q.dtype)
             out = ring_attention(q, k, v, mask=mask, scale=scale)
+        elif (self.use_ulysses_attention and not self.need_weights
+                and cache is None and mask_ring_ok):
+            from ..parallel.ulysses import ulysses_attention
+
+            mask = _convert_attention_mask(attn_mask, q.dtype)
+            out = ulysses_attention(q, k, v, mask=mask, scale=scale)
         elif (self.use_flash_attention and not self.need_weights
                 and cache is None
                 and k.shape[2] >= FLASH_ATTENTION_MIN_SEQ):
@@ -108,6 +120,19 @@ class MultiHeadAttention(Layer):
                 dropout_rate=self.dropout if self.training else 0.0,
             )
         else:
+            if self.use_ring_attention or self.use_ulysses_attention:
+                # an sp mode was requested but the call shape ruled it out
+                # (need_weights / incremental cache / Lq>1 mask): record
+                # the fallback so harness asserts can't false-pass on a
+                # stale "sharded" entry
+                from ..parallel.ring_attention import LAST_DISPATCH
+
+                LAST_DISPATCH.clear()
+                LAST_DISPATCH.update(
+                    op=("ring_attention" if self.use_ring_attention
+                        else "ulysses_attention"),
+                    mode="fallback", axis_size=0,
+                )
             scores = ops.matmul(q, k, transpose_y=True) * scale
             mask = _convert_attention_mask(attn_mask, q.dtype)
             if mask is not None:
@@ -138,14 +163,20 @@ class MultiHeadAttention(Layer):
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1, activation="relu",
                  attn_dropout=None, act_dropout=None, normalize_before=False,
-                 weight_attr=None, bias_attr=None, use_flash_attention=False):
+                 weight_attr=None, bias_attr=None, use_flash_attention=False,
+                 sp_attention="none"):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
+        if sp_attention not in ("none", "ring", "ulysses"):
+            raise ValueError(f"sp_attention must be none|ring|ulysses, "
+                             f"got {sp_attention!r}")
         self.self_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
                                             weight_attr=weight_attr, bias_attr=bias_attr,
-                                            use_flash_attention=use_flash_attention)
+                                            use_flash_attention=use_flash_attention,
+                                            use_ring_attention=sp_attention == "ring",
+                                            use_ulysses_attention=sp_attention == "ulysses")
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.dropout = Dropout(act_dropout)
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)

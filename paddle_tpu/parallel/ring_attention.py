@@ -98,6 +98,14 @@ def _ring_body(q, k, v, mask, *, axis, scale, causal):
     return (acc / denom[..., None]).astype(q.dtype)
 
 
+# last SP-attention dispatch decision, written at trace/call time:
+# {"op": <ring|ulysses>, "mode": "sharded"|"fallback", "axis_size": n}.
+# Lets harnesses (tests, __graft_entry__.dryrun_multichip) assert the
+# sequence-parallel path actually ran instead of silently falling back to
+# replicated attention when the mesh/axis was absent.
+LAST_DISPATCH = {}
+
+
 def _dispatch_sp_attention(op_name, body_builder, q, k, v, mask, axis,
                            causal, scale, mesh, guard=None):
     """Shared dispatch tail for the two SP attention modes (ring and
@@ -118,6 +126,12 @@ def _dispatch_sp_attention(op_name, body_builder, q, k, v, mask, axis,
 
     mesh = mesh or get_mesh()
     n = axis_size(axis, mesh)
+    LAST_DISPATCH.clear()
+    LAST_DISPATCH.update(
+        op=op_name,
+        mode="fallback" if (mesh is None or n == 1) else "sharded",
+        axis_size=n,
+    )
     if mesh is None or n == 1:
         pure = lambda q, k, v, *m_: _plain_attention(  # noqa: E731
             q, k, v, m_[0] if m_ else None, scale, causal

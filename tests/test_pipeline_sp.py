@@ -264,3 +264,69 @@ def test_ulysses_gradient_flows():
         out.sum().backward()
     assert q.grad is not None
     assert np.isfinite(np.asarray(q.grad.numpy())).all()
+
+
+def test_mha_sp_attention_modes_match_plain():
+    """MultiHeadAttention(sp_attention=ring|ulysses) on an sp mesh must
+    match the plain-attention MHA numerically (eval mode, no dropout),
+    and the dispatch record must show the sharded path ran."""
+    import importlib
+
+    _ra = importlib.import_module("paddle_tpu.parallel.ring_attention")
+
+    paddle.seed(5)
+    ref = nn.MultiHeadAttention(32, 4, dropout=0.0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 16, 32).astype("float32"))
+    ref.eval()
+    want = ref(x, x, x).numpy()
+
+    for mode, opname in (("ring", "ring_attention"),
+                         ("ulysses", "ulysses_attention")):
+        m = nn.MultiHeadAttention(
+            32, 4, dropout=0.0,
+            use_ring_attention=mode == "ring",
+            use_ulysses_attention=mode == "ulysses")
+        m.eval()
+        m.set_state_dict(ref.state_dict())
+        # settle all operands onto the mesh first: sp attention composes
+        # with mesh-resident programs (the sharded-train-step path); a
+        # single-device-committed weight cannot mix with a mesh-committed
+        # activation
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = parallel.create_mesh(sp=4, dp=2)
+        repl = NamedSharding(mesh, P())
+        for p in m.parameters():
+            p._array = jax.device_put(p._array, repl)
+        xm = paddle.to_tensor(
+            np.asarray(jax.device_put(x.numpy(), repl)))
+        xm._array = jax.device_put(xm._array, repl)
+        with parallel.mesh_scope(mesh):
+            got = m(xm, xm, xm).numpy()
+        d = dict(_ra.LAST_DISPATCH)
+        assert d == {"op": opname, "mode": "sharded", "axis_size": 4}, d
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=mode)
+
+
+def test_bert_sp_attention_config_threads_to_layers():
+    """BertConfig.sp_attention reaches every encoder layer's MHA; dropout
+    guard rejects ring/ulysses with attention dropout."""
+    import dataclasses
+
+    import pytest
+    from paddle_tpu.models import BertModel, bert_tiny_config
+
+    cfg = dataclasses.replace(
+        bert_tiny_config(), sp_attention="ulysses",
+        attention_probs_dropout_prob=0.0)
+    model = BertModel(cfg)
+    mhas = [m for _, m in model.named_sublayers()
+            if isinstance(m, nn.MultiHeadAttention)]
+    assert mhas and all(m.use_ulysses_attention for m in mhas)
+
+    with pytest.raises(ValueError, match="dropout"):
+        BertModel(dataclasses.replace(bert_tiny_config(),
+                                      sp_attention="ring"))
