@@ -117,3 +117,15 @@ define_flag("benchmark", False,
 # formatting (0: message only, 1: + op context, 2: + python stack)
 define_flag("call_stack_level", 1,
             "error verbosity: 0 message, 1 +op context, 2 +python stack")
+
+# TPU pallas fused max-pool backward (ops/pallas/pool_backward.py) — the
+# role of the reference's hand-written MaxPool2dGradFunctor CUDA kernel
+# (operators/math/pooling.cu). OFF by default: the kernel is numerically
+# exact (first-max parity with select_and_scatter, tested), but ordered
+# A/B at the ResNet-50 stem shape measured XLA's select_and_scatter at
+# 4.7 ms vs 24 ms for the kernel — per-program pallas dispatch overhead
+# dominates at the block sizes the kernel's VMEM footprint allows (lane-
+# dim stride work must run as one-hot MXU matmuls, tripling the working
+# set). Kept behind the flag for future backends/shapes.
+define_flag("use_pallas_pool_bwd", False,
+            "fused pallas kernel for max-pool backward on TPU")

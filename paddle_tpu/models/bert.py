@@ -362,6 +362,7 @@ def bert_pipeline_stages(cfg: BertConfig, n_stages: int):
             attn_dropout=cfg.attention_probs_dropout_prob,
             act_dropout=0.0,
             use_flash_attention=cfg.use_flash_attention,
+            sp_attention=cfg.sp_attention,
         )
 
     n_layers = cfg.num_hidden_layers
