@@ -232,5 +232,7 @@ from .ops import (  # noqa: E402,F401  (2.0 tail additions, flat aliases)
     stanh,
 )
 from . import utils  # noqa: E402  (run_check, gated download)
+from . import reader  # noqa: E402  (reader decorator library, paddle.reader)
+from . import nets  # noqa: E402  (composite helpers, fluid/nets.py)
 from . import flags as _flags_mod  # noqa: E402
 from .flags import get_flags, set_flags  # noqa: E402  (core.globals() API)

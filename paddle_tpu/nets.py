@@ -1,0 +1,172 @@
+"""Composite network helpers (fluid/nets.py).
+
+Reference parity: python/paddle/fluid/nets.py:29 (simple_img_conv_pool),
+:141 (img_conv_group), :256 (sequence_conv_pool), :328 (glu), :372
+(scaled_dot_product_attention). Pure composition over existing ops /
+static.nn builders — the mode-aware ``ops`` dispatch makes glu and
+scaled_dot_product_attention work in BOTH dygraph and static graph; the
+conv/sequence composites create implicit parameters and therefore follow
+the reference's static-graph contract (use nn.Conv2D layers in dygraph).
+
+Ragged design note: the reference's sequence_conv_pool consumes an
+LoDTensor; our sequence ops use the padded+lengths representation
+(ops/sequence.py), so it takes an explicit ``lengths`` operand.
+"""
+from __future__ import annotations
+
+from . import ops
+from .static import nn as static_nn
+
+__all__ = [
+    "simple_img_conv_pool", "img_conv_group", "sequence_conv_pool",
+    "glu", "scaled_dot_product_attention",
+]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None,
+                         use_cudnn=True):
+    """conv2d + pool2d (fluid/nets.py:29). ``use_cudnn`` accepted for
+    signature parity; XLA owns the lowering."""
+    conv_out = static_nn.conv2d(
+        input, num_filters, filter_size, stride=conv_stride,
+        padding=conv_padding, dilation=conv_dilation, groups=conv_groups,
+        weight_attr=param_attr, bias_attr=bias_attr, activation=act,
+    )
+    if global_pooling:
+        pool = (ops.adaptive_max_pool2d if pool_type == "max"
+                else ops.adaptive_avg_pool2d)
+        return pool(conv_out, output_size=1)
+    pool = ops.max_pool2d if pool_type == "max" else ops.avg_pool2d
+    return pool(conv_out, kernel_size=pool_size, stride=pool_stride,
+                padding=pool_padding)
+
+
+def _per_layer(value, n, name):
+    if isinstance(value, (list, tuple)):
+        if len(value) != n:
+            raise ValueError(
+                f"{name} list length {len(value)} != number of conv "
+                f"layers {n}")
+        return list(value)
+    return [value] * n
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """Serial conv(+bn)(+dropout) stack closed by one pool
+    (fluid/nets.py:141 — the VGG building block)."""
+    if not isinstance(conv_num_filter, (list, tuple)):
+        raise TypeError("conv_num_filter must be a list or tuple")
+    n = len(conv_num_filter)
+    paddings = _per_layer(conv_padding, n, "conv_padding")
+    filter_sizes = _per_layer(conv_filter_size, n, "conv_filter_size")
+    with_bn = _per_layer(conv_with_batchnorm, n, "conv_with_batchnorm")
+    drop_rates = _per_layer(conv_batchnorm_drop_rate, n,
+                            "conv_batchnorm_drop_rate")
+    attrs = (list(param_attr) if isinstance(param_attr, (list, tuple))
+             else [param_attr] * n)
+
+    tmp = input
+    for i in range(n):
+        # conv act is deferred to after BN when BN follows (reference
+        # local_conv_act logic)
+        local_act = None if with_bn[i] else conv_act
+        tmp = static_nn.conv2d(
+            tmp, conv_num_filter[i], filter_sizes[i], padding=paddings[i],
+            weight_attr=attrs[i],
+            bias_attr=False if with_bn[i] else None,
+            activation=local_act,
+        )
+        if with_bn[i]:
+            tmp = static_nn.batch_norm(tmp)
+            if conv_act:
+                tmp = getattr(ops, conv_act)(tmp)
+            if drop_rates[i]:
+                tmp = static_nn.dropout(tmp, dropout_prob=drop_rates[i])
+    pool = ops.max_pool2d if pool_type == "max" else ops.avg_pool2d
+    return pool(tmp, kernel_size=pool_size, stride=pool_stride)
+
+
+def sequence_conv_pool(input, lengths, num_filters, filter_size,
+                       param_attr=None, act="sigmoid", pool_type="max",
+                       bias_attr=None):
+    """sequence_conv + sequence_pool (fluid/nets.py:256).
+
+    input: [B, T, H] padded batch; lengths: [B] valid lengths (the ragged
+    redesign of the reference's LoDTensor input).
+    """
+    in_hidden = input.shape[-1]
+    w = static_nn.create_parameter(
+        [filter_size * in_hidden, num_filters], str(input.dtype),
+        initializer=param_attr)
+    conv_out = ops.sequence_conv(input, lengths, w,
+                                 context_length=filter_size)
+    if bias_attr is not False:
+        b = static_nn.create_parameter(
+            [num_filters], str(input.dtype), initializer=bias_attr,
+            is_bias=True)
+        conv_out = ops.add(conv_out, b)
+    if act:
+        conv_out = getattr(ops, act)(conv_out)
+    return ops.sequence_pool(conv_out, lengths, pooltype=pool_type.upper())
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in half along ``dim``, a * sigmoid(b)
+    (fluid/nets.py:328)."""
+    a, b = ops.split(input, 2, axis=dim)
+    return ops.multiply(a, ops.sigmoid(b))
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Batched multi-head scaled-dot-product attention
+    (fluid/nets.py:372). q [N, Lq, dk*h], k [N, Lk, dk*h],
+    v [N, Lk, dv*h] -> [N, Lq, dv*h]. With num_heads == 1 no projection
+    is applied, exactly like the reference.
+    """
+    if queries.shape[-1] != keys.shape[-1]:
+        raise ValueError(
+            "queries and keys must have the same feature size, got "
+            f"{queries.shape[-1]} vs {keys.shape[-1]}")
+    if keys.shape[1] != values.shape[1]:
+        raise ValueError(
+            "keys and values must have the same sequence length, got "
+            f"{keys.shape[1]} vs {values.shape[1]}")
+    if queries.shape[-1] % num_heads or values.shape[-1] % num_heads:
+        raise ValueError(
+            f"hidden sizes (q {queries.shape[-1]}, v {values.shape[-1]}) "
+            f"must be divisible by num_heads ({num_heads})")
+    q, k, v = queries, keys, values
+    if num_heads > 1:
+        q = static_nn.fc(q, q.shape[-1], num_flatten_dims=2,
+                         bias_attr=False)
+        k = static_nn.fc(k, k.shape[-1], num_flatten_dims=2,
+                         bias_attr=False)
+        v = static_nn.fc(v, v.shape[-1], num_flatten_dims=2,
+                         bias_attr=False)
+
+    def split_heads(x):
+        b, l, hd = x.shape
+        x = ops.reshape(x, [b, l, num_heads, hd // num_heads])
+        return ops.transpose(x, [0, 2, 1, 3])  # [B, H, L, D]
+
+    def merge_heads(x):
+        x = ops.transpose(x, [0, 2, 1, 3])
+        b, l, h, d = x.shape
+        return ops.reshape(x, [b, l, h * d])
+
+    qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)
+    dk = qh.shape[-1]
+    scores = ops.matmul(qh, kh, transpose_y=True)
+    scores = ops.scale(scores, scale=float(dk) ** -0.5)
+    weights = ops.softmax(scores)
+    if dropout_rate:
+        weights = ops.dropout(weights, p=dropout_rate)
+    return merge_heads(ops.matmul(weights, vh))

@@ -18,6 +18,8 @@ from .datasets import (  # noqa: F401
     Imdb,
     Imikolov,
     Movielens,
+    MQ2007,
+    Sentiment,
     UCIHousing,
     WMT14,
     WMT16,
@@ -25,5 +27,5 @@ from .datasets import (  # noqa: F401
 
 __all__ = [
     "Imdb", "Imikolov", "Movielens", "WMT14", "WMT16", "Conll05st",
-    "UCIHousing",
+    "UCIHousing", "Sentiment", "MQ2007",
 ]

@@ -380,3 +380,179 @@ class UCIHousing(Dataset):
 
     def __len__(self):
         return len(self.features)
+
+
+class Sentiment(Dataset):
+    """NLTK movie_reviews sentiment (dataset/sentiment.py): samples are
+    (word-id sequence, label 0/1), vocabulary sorted by corpus frequency
+    (sentiment.py:70 get_word_dict), interleaved neg/pos file order for
+    cross reading (sentiment.py:91 sort_files), 1600/400 train/test
+    split (NUM_TRAINING_INSTANCES).
+
+    Real data: a movie_reviews directory (or zip layout extracted) with
+    {pos,neg}/*.txt under ``data_file``. Otherwise loud synthetic.
+    """
+
+    NUM_TRAINING_INSTANCES = 1600
+    NUM_TOTAL_INSTANCES = 2000
+
+    def __init__(self, data_file=None, mode="train", seed=None):
+        self.mode = mode
+        self.synthetic = False
+        data_file = data_file or os.path.join(DATA_HOME, "corpora",
+                                              "movie_reviews")
+        if os.path.isdir(data_file):
+            self._load_dir(data_file, mode)
+        else:
+            self._synthesize(mode)
+
+    def _docs_to_ids(self, docs, labels, mode):
+        import collections
+
+        freq = collections.Counter()
+        for words in docs:
+            freq.update(words)
+        # frequency-sorted vocabulary (ties broken by insertion order,
+        # matching the reference's stable sort over iteritems)
+        self.word_idx = {
+            w: i for i, (w, _) in enumerate(
+                sorted(freq.items(), key=lambda kv: -kv[1]))
+        }
+        data = [
+            (np.asarray([self.word_idx[w] for w in words], np.int64), lab)
+            for words, lab in zip(docs, labels)
+        ]
+        split = int(len(data) * self.NUM_TRAINING_INSTANCES
+                    / self.NUM_TOTAL_INSTANCES)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def _load_dir(self, root, mode):
+        import glob as _glob
+
+        neg = sorted(_glob.glob(os.path.join(root, "neg", "*.txt")))
+        pos = sorted(_glob.glob(os.path.join(root, "pos", "*.txt")))
+        docs, labels = [], []
+        # interleave neg/pos (sort_files cross-reading order)
+        for nf, pf in zip(neg, pos):
+            for path, lab in ((nf, 0), (pf, 1)):
+                with open(path, errors="ignore") as f:
+                    docs.append(f.read().split())
+                labels.append(lab)
+        self._docs_to_ids(docs, labels, mode)
+
+    def _synthesize(self, mode):
+        _warn_synthetic(self)
+        self.synthetic = True
+        rng = np.random.RandomState(31)
+        docs, labels = [], []
+        for i in range(200):  # scaled-down corpus, same structure
+            for lab, bank in ((0, _NEG_WORDS), (1, _POS_WORDS)):
+                n = rng.randint(8, 24)
+                words = [
+                    (bank if rng.rand() < 0.4 else _NEUTRAL)[
+                        rng.randint(0, 6)]
+                    for _ in range(n)
+                ]
+                docs.append(words)
+                labels.append(lab)
+        self._docs_to_ids(docs, labels, mode)
+
+    def get_word_dict(self):
+        """[(word, rank)] sorted by frequency (sentiment.py:70)."""
+        return sorted(self.word_idx.items(), key=lambda kv: kv[1])
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class MQ2007(Dataset):
+    """LETOR MQ2007 learning-to-rank (dataset/mq2007.py).
+
+    Parses the LETOR line format ``rel qid:<id> 1:<v> ... 46:<v> #docid
+    = <d>`` into per-query groups and yields samples in one of the
+    reference's formats (mq2007.py:295 __reader__):
+
+    - "pointwise": (feature [46], score)
+    - "pairwise":  (feature_left [46], feature_right [46]) with
+      rel(left) > rel(right) (full partial order, mq2007.py:189)
+    - "listwise":  (label_list [n], feature_list [n, 46])
+    """
+
+    FEATURE_DIM = 46
+
+    def __init__(self, data_file=None, format="pairwise", mode="train",
+                 fill_missing=-1.0):
+        if format not in ("pointwise", "pairwise", "listwise"):
+            raise ValueError(f"unknown MQ2007 format {format!r}")
+        self.format = format
+        self.synthetic = False
+        data_file = data_file or os.path.join(
+            DATA_HOME, "MQ2007", "Fold1",
+            {"train": "train.txt", "test": "test.txt",
+             "vali": "vali.txt"}[mode])
+        if os.path.exists(data_file):
+            queries = self._load_text(data_file, fill_missing)
+        else:
+            queries = self._synthesize(mode)
+        self._build(queries)
+
+    def _load_text(self, path, fill_missing):
+        queries = {}
+        with open(path) as f:
+            for line in f:
+                body = line.split("#")[0].split()
+                if len(body) < 2:
+                    continue
+                rel = int(body[0])
+                qid = int(body[1].split(":")[1])
+                feat = np.full(self.FEATURE_DIM, fill_missing, np.float32)
+                for tok in body[2:]:
+                    k, v = tok.split(":")
+                    feat[int(k) - 1] = float(v)
+                queries.setdefault(qid, []).append((rel, feat))
+        return queries
+
+    def _synthesize(self, mode):
+        _warn_synthetic(self)
+        self.synthetic = True
+        rng = np.random.RandomState(61 if mode == "train" else 62)
+        queries = {}
+        w = rng.randn(self.FEATURE_DIM).astype(np.float32)
+        for qid in range(24):
+            docs = []
+            for _ in range(rng.randint(4, 12)):
+                feat = rng.randn(self.FEATURE_DIM).astype(np.float32)
+                # relevance correlates with a hidden linear score
+                rel = int(np.clip(feat @ w / 4 + rng.randn() * 0.3 + 1,
+                                  0, 2))
+                docs.append((rel, feat))
+            queries[qid] = docs
+        return queries
+
+    def _build(self, queries):
+        self.data = []
+        for qid in sorted(queries):
+            docs = queries[qid]
+            if self.format == "pointwise":
+                for rel, feat in docs:
+                    self.data.append((feat, np.float32(rel)))
+            elif self.format == "pairwise":
+                for i, (ri, fi) in enumerate(docs):
+                    for rj, fj in docs[i + 1:]:
+                        if ri > rj:
+                            self.data.append((fi, fj))
+                        elif rj > ri:
+                            self.data.append((fj, fi))
+            else:  # listwise
+                labels = np.asarray([r for r, _ in docs], np.float32)
+                feats = np.stack([f for _, f in docs])
+                self.data.append((labels, feats))
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+    def __len__(self):
+        return len(self.data)

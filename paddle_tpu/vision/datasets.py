@@ -21,7 +21,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "VOC2012"]
 
 from ..utils.data_home import DATA_HOME, warn_synthetic as _warn_synthetic
 
@@ -160,3 +160,86 @@ class Cifar10(_SyntheticMixin, Dataset):
 class Cifar100(Cifar10):
     NUM_CLASSES = 100
     _ARCHIVE = "cifar-100-python.tar.gz"
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (dataset/voc2012.py): samples are
+    (image HWC uint8, label mask HW uint8), read from the VOCtrainval
+    tar when present (ImageSets/Segmentation/{set}.txt naming JPEG +
+    SegmentationClass pairs), else loud synthetic blobs whose mask
+    matches the painted class regions. Modes: train -> trainval list,
+    test -> train list, val -> val list (voc2012.py:68-85 mapping)."""
+
+    N_CLASSES = 21
+
+    def __init__(self, data_file=None, mode="train", image_size=64):
+        self.synthetic = False
+        data_file = data_file or os.path.join(
+            DATA_HOME, "voc2012", "VOCtrainval_11-May-2012.tar")
+        sub = {"train": "trainval", "test": "train", "val": "val"}[mode]
+        if os.path.exists(data_file):
+            self._load_tar(data_file, sub)
+        else:
+            self._synthesize(mode, image_size)
+
+    def _load_tar(self, path, sub):
+        import tarfile
+
+        try:
+            from PIL import Image  # noqa: F401 — fail before first access
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(
+                "VOC2012 real-data path needs PIL to decode JPEG/PNG"
+            ) from e
+        voc = "VOCdevkit/VOC2012"
+        # index only; decode lazily per __getitem__ — trainval holds ~3k
+        # full-resolution pairs (>1.5 GB decoded), far too much to
+        # materialize at construction
+        self._tar_path = path
+        self._tar = tarfile.open(path)
+        names = {m.name: m for m in self._tar.getmembers()}
+        listing = self._tar.extractfile(
+            names[f"{voc}/ImageSets/Segmentation/{sub}.txt"])
+        self._members = [
+            (names[f"{voc}/JPEGImages/{line}.jpg"],
+             names[f"{voc}/SegmentationClass/{line}.png"])
+            for line in listing.read().decode().split()
+        ]
+        self.data = None
+
+    def _decode(self, i):
+        import io
+
+        from PIL import Image
+
+        im, lm = self._members[i]
+        img = Image.open(io.BytesIO(self._tar.extractfile(im).read()))
+        lab = Image.open(io.BytesIO(self._tar.extractfile(lm).read()))
+        return np.array(img, np.uint8), np.array(lab, np.uint8)
+
+    def _synthesize(self, mode, size):
+        _warn_synthetic(self)
+        self.synthetic = True
+        rng = np.random.RandomState({"train": 71, "test": 73,
+                                     "val": 72}[mode])
+        n = {"train": 64, "test": 32, "val": 16}[mode]
+        self.data = []
+        for _ in range(n):
+            img = rng.randint(0, 40, (size, size, 3)).astype(np.uint8)
+            mask = np.zeros((size, size), np.uint8)
+            for _ in range(rng.randint(1, 4)):  # paint class rectangles
+                cls = rng.randint(1, self.N_CLASSES)
+                y0, x0 = rng.randint(0, size // 2, 2)
+                h, w = rng.randint(size // 8, size // 2, 2)
+                mask[y0:y0 + h, x0:x0 + w] = cls
+                img[y0:y0 + h, x0:x0 + w] += np.uint8(cls * 10)
+            self.data.append((img, mask))
+
+    def __getitem__(self, i):
+        if self.data is None:
+            return self._decode(i)
+        return self.data[i]
+
+    def __len__(self):
+        return (len(self._members) if self.data is None
+                else len(self.data))

@@ -1,0 +1,332 @@
+"""Data/API tail: paddle.reader decorators, paddle.nets composites, and
+the Sentiment/MQ2007/VOC2012 dataset fetchers.
+
+Reference behaviors mirrored: python/paddle/reader/decorator.py examples
+and tests (tests/unittests/reader tests), fluid/nets.py compositions,
+dataset/{sentiment,mq2007,voc2012}.py sample formats.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.reader as reader
+import paddle_tpu.static as static_mod
+from paddle_tpu import nets
+
+
+@pytest.fixture(autouse=True)
+def _fresh_static_programs():
+    static_mod.reset_default_programs()
+    static_mod.global_scope().clear()
+    yield
+    static_mod.reset_default_programs()
+    static_mod.global_scope().clear()
+
+
+def _creator(seq):
+    def r():
+        return iter(seq)
+    return r
+
+
+# -- reader decorators -------------------------------------------------------
+
+
+def test_cache_reads_source_once():
+    calls = {"n": 0}
+
+    def src():
+        calls["n"] += 1
+        yield from range(5)
+
+    c = reader.cache(src)
+    assert list(c()) == list(range(5)) == list(c())
+    assert calls["n"] == 1
+
+
+def test_map_readers():
+    d = {"h": 0, "i": 1}
+    m = reader.map_readers(lambda x: d[x], _creator(["h", "i"]))
+    assert list(m()) == [0, 1]
+
+
+def test_shuffle_is_permutation():
+    s = reader.shuffle(_creator(list(range(20))), buf_size=7)
+    out = list(s())
+    assert sorted(out) == list(range(20))
+
+
+def test_chain_concatenates():
+    c = reader.chain(_creator([[0, 0]]), _creator([[10, 10]]),
+                     _creator([[20, 20]]))
+    assert list(c()) == [[0, 0], [10, 10], [20, 20]]
+
+
+def test_compose_flattens_and_checks_alignment():
+    c = reader.compose(_creator([(1, 2), (3, 4)]), _creator([5, 6]))
+    assert list(c()) == [(1, 2, 5), (3, 4, 6)]
+    bad = reader.compose(_creator([1, 2, 3]), _creator([1]))
+    with pytest.raises(reader.ComposeNotAligned):
+        list(bad())
+    ok = reader.compose(_creator([1, 2, 3]), _creator([1]),
+                        check_alignment=False)
+    assert list(ok()) == [(1, 1)]
+
+
+def test_buffered_preserves_order():
+    b = reader.buffered(_creator(list(range(50))), size=8)
+    assert list(b()) == list(range(50))
+
+
+def test_firstn():
+    f = reader.firstn(_creator(list(range(100))), 7)
+    assert list(f()) == list(range(7))
+
+
+def test_xmap_readers_unordered_and_ordered():
+    src = _creator(list(range(30)))
+    un = reader.xmap_readers(lambda x: x * 2, src, process_num=4,
+                             buffer_size=8)
+    assert sorted(un()) == [2 * i for i in range(30)]
+    o = reader.xmap_readers(lambda x: x * 2, src, process_num=4,
+                            buffer_size=8, order=True)
+    assert list(o()) == [2 * i for i in range(30)]
+
+
+def test_multiprocess_reader_merges():
+    r = reader.multiprocess_reader(
+        [_creator([1, 2, 3]), _creator([10, 20])])
+    assert sorted(r()) == [1, 2, 3, 10, 20]
+
+
+def test_book_style_pipeline_with_decorators():
+    """Book-style input pipeline: dataset -> reader -> shuffle ->
+    buffered -> batched training of a small model."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as opt
+
+    ds = paddle.text.UCIHousing(mode="train")
+
+    def raw_reader():
+        for i in range(len(ds)):
+            yield ds[i]
+
+    pipe = reader.buffered(reader.shuffle(raw_reader, buf_size=64), 16)
+    net = nn.Linear(13, 1)
+    o = opt.SGD(learning_rate=0.05, parameters=net.parameters())
+    losses = []
+    batch = []
+    for sample in pipe():
+        batch.append(sample)
+        if len(batch) < 32:
+            continue
+        x = paddle.to_tensor(np.stack([b[0] for b in batch]))
+        y = paddle.to_tensor(np.stack([b[1] for b in batch]))
+        loss = ((net(x) - y) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        losses.append(float(loss.item()))
+        batch = []
+    assert len(losses) >= 8 and losses[-1] < losses[0]
+
+
+# -- nets composites ---------------------------------------------------------
+
+
+def test_glu_matches_manual():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 8).astype(np.float32))
+    out = nets.glu(x, dim=-1)
+    a = x.numpy()[:, :4]
+    b = x.numpy()[:, 4:]
+    want = a * (1 / (1 + np.exp(-b)))
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5)
+
+
+def test_scaled_dot_product_attention_single_head():
+    rng = np.random.RandomState(1)
+    q = paddle.to_tensor(rng.randn(2, 5, 8).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(2, 7, 8).astype(np.float32))
+    v = paddle.to_tensor(rng.randn(2, 7, 8).astype(np.float32))
+    out = nets.scaled_dot_product_attention(q, k, v, num_heads=1)
+    s = (q.numpy() @ k.numpy().transpose(0, 2, 1)) / np.sqrt(8)
+    w = np.exp(s - s.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    np.testing.assert_allclose(out.numpy(), w @ v.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    assert tuple(out.shape) == (2, 5, 8)
+
+
+def test_scaled_dot_product_attention_validation():
+    rng = np.random.RandomState(1)
+    q = paddle.to_tensor(rng.randn(2, 5, 8).astype(np.float32))
+    k = paddle.to_tensor(rng.randn(2, 7, 6).astype(np.float32))
+    with pytest.raises(ValueError, match="same feature size"):
+        nets.scaled_dot_product_attention(q, k, k)
+
+
+def test_simple_img_conv_pool_static():
+    """Static-graph composition trains end to end (the reference's
+    recommended usage, book ch.3 recognize_digits CNN)."""
+    import paddle_tpu.static as static
+    from paddle_tpu import ops
+
+    static.enable_static()
+    try:
+        img = static.data("img", [None, 1, 28, 28], "float32")
+        label = static.data("label", [None, 1], "int64")
+        c1 = nets.simple_img_conv_pool(
+            img, 8, 5, pool_size=2, pool_stride=2, act="relu")
+        c2 = nets.simple_img_conv_pool(
+            c1, 16, 5, pool_size=2, pool_stride=2, act="relu")
+        pred = static.nn.fc(c2, 10, num_flatten_dims=1,
+                            activation="softmax")
+        loss = ops.mean(ops.cross_entropy(pred, label))
+        static.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        exe = static.Executor()
+        exe.run_startup()
+        rng = np.random.RandomState(0)
+        x = rng.randn(8, 1, 28, 28).astype(np.float32)
+        y = rng.randint(0, 10, (8, 1)).astype(np.int64)
+        l0 = float(exe.run(feed={"img": x, "label": y},
+                           fetch_list=[loss])[0])
+        for _ in range(5):
+            l1 = float(exe.run(feed={"img": x, "label": y},
+                               fetch_list=[loss])[0])
+        assert l1 < l0
+    finally:
+        static.disable_static()
+
+
+def test_img_conv_group_static():
+    import paddle_tpu.static as static
+
+    static.enable_static()
+    try:
+        img = static.data("img", [None, 3, 16, 16], "float32")
+        out = nets.img_conv_group(
+            img, conv_num_filter=[8, 8], pool_size=2, pool_stride=2,
+            conv_act="relu", conv_with_batchnorm=True,
+            conv_batchnorm_drop_rate=0.0)
+        exe = static.Executor()
+        exe.run_startup()
+        r = exe.run(feed={"img": np.random.RandomState(0).randn(
+                        2, 3, 16, 16).astype(np.float32)},
+                    fetch_list=[out])[0]
+        assert r.shape == (2, 8, 8, 8)
+    finally:
+        static.disable_static()
+
+
+def test_sequence_conv_pool_static():
+    import paddle_tpu.static as static
+
+    static.enable_static()
+    try:
+        x = static.data("x", [None, 6, 4], "float32")
+        lens = static.data("lens", [None], "int64")
+        out = nets.sequence_conv_pool(x, lens, num_filters=5,
+                                      filter_size=3)
+        exe = static.Executor()
+        exe.run_startup()
+        r = exe.run(feed={
+            "x": np.random.RandomState(0).randn(2, 6, 4).astype(np.float32),
+            "lens": np.asarray([6, 3], np.int64),
+        }, fetch_list=[out])[0]
+        assert r.shape == (2, 5)
+    finally:
+        static.disable_static()
+
+
+# -- dataset fetchers --------------------------------------------------------
+
+
+def test_sentiment_dataset():
+    tr = paddle.text.Sentiment(mode="train")
+    te = paddle.text.Sentiment(mode="test")
+    assert tr.synthetic and te.synthetic  # no real corpus in CI
+    assert len(tr) + len(te) == 400  # scaled 1600/2000 split ratio: 320/80
+    assert len(tr) == int(400 * 1600 / 2000)
+    ids, lab = tr[0]
+    assert ids.dtype == np.int64 and lab in (0, 1)
+    wd = tr.get_word_dict()
+    assert wd[0][1] == 0 and len(wd) == len(tr.word_idx)
+    # labels must be learnable-balanced
+    labs = [tr[i][1] for i in range(len(tr))]
+    assert 0.3 < np.mean(labs) < 0.7
+
+
+def test_mq2007_formats():
+    pw = paddle.text.MQ2007(format="pairwise")
+    fi, fj = pw[0]
+    assert fi.shape == (46,) and fj.shape == (46,)
+    pt = paddle.text.MQ2007(format="pointwise")
+    f, s = pt[0]
+    assert f.shape == (46,) and s in (0.0, 1.0, 2.0)
+    lw = paddle.text.MQ2007(format="listwise")
+    labels, feats = lw[0]
+    assert feats.shape == (len(labels), 46)
+    with pytest.raises(ValueError):
+        paddle.text.MQ2007(format="bogus")
+
+
+def test_mq2007_parses_letor_text(tmp_path):
+    lines = [
+        "2 qid:10 1:0.5 2:0.25 46:1.0 #docid = GX1",
+        "0 qid:10 1:0.1 2:0.0 46:0.5 #docid = GX2",
+        "1 qid:11 1:0.9 46:0.2 #docid = GX3",
+    ]
+    p = tmp_path / "train.txt"
+    p.write_text("\n".join(lines))
+    ds = paddle.text.MQ2007(data_file=str(p), format="listwise")
+    assert not ds.synthetic and len(ds) == 2
+    labels, feats = ds[0]  # qid 10
+    assert list(labels) == [2.0, 0.0]
+    assert feats[0, 0] == np.float32(0.5) and feats[0, 45] == 1.0
+    assert feats[1, 2] == -1.0  # fill_missing default
+
+
+def test_voc2012_dataset():
+    ds = paddle.vision.datasets.VOC2012(mode="train")
+    img, mask = ds[0]
+    assert ds.synthetic
+    assert img.ndim == 3 and img.shape[2] == 3 and img.dtype == np.uint8
+    assert mask.shape == img.shape[:2] and mask.dtype == np.uint8
+    assert mask.max() < ds.N_CLASSES
+    val = paddle.vision.datasets.VOC2012(mode="val")
+    assert len(val) < len(ds)
+
+
+def test_buffered_propagates_source_error():
+    def flaky():
+        yield 1
+        raise IOError("disk gone")
+
+    b = reader.buffered(flaky, 4)
+    it = b()
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="source failed"):
+        list(it)
+
+
+def test_xmap_propagates_mapper_error():
+    for order in (False, True):
+        r = reader.xmap_readers(lambda s: 1 // s,
+                                _creator([1, 1, 0, 1]), process_num=2,
+                                buffer_size=4, order=order)
+        with pytest.raises(RuntimeError, match="worker failed"):
+            list(r())
+
+
+def test_sdpa_num_heads_divisibility():
+    rng = np.random.RandomState(1)
+    q = paddle.to_tensor(rng.randn(2, 5, 64).astype(np.float32))
+    with pytest.raises(ValueError, match="divisible by num_heads"):
+        nets.scaled_dot_product_attention(q, q, q, num_heads=3)
+
+
+def test_voc2012_test_split_differs_from_train():
+    tr = paddle.vision.datasets.VOC2012(mode="train")
+    te = paddle.vision.datasets.VOC2012(mode="test")
+    assert not np.array_equal(tr[0][0], te[0][0])

@@ -45,6 +45,8 @@ MODULES = [
     "paddle_tpu.text",
     "paddle_tpu.incubate.hapi_text",
     "paddle_tpu.device",
+    "paddle_tpu.reader",
+    "paddle_tpu.nets",
 ]
 
 
