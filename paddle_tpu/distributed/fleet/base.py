@@ -188,19 +188,27 @@ class Role:
 
 class UserDefinedRoleMaker(RoleMakerBase):
     def __init__(self, current_id=0, role=None, worker_num=1,
-                 server_endpoints=None, is_collective=True, **kwargs):
+                 server_endpoints=None, is_collective=True,
+                 device_type="cpu", **kwargs):
         super().__init__()
         self._current_id = current_id
         self._role = role
         self._worker_num = worker_num
         self._server_endpoints = list(server_endpoints or [])
         self._is_collective = is_collective
+        # heterogeneous worker typing (HeterXpuTrainer,
+        # framework/trainer.h:149): device-typed workers split one PS job
+        # and run per-type step functions (see Fleet.heter_step_fn)
+        self._device_type = str(device_type)
 
     def worker_index(self):
         return self._current_id
 
     def worker_num(self):
         return self._worker_num
+
+    def device_type(self):
+        return self._device_type
 
     def is_worker(self):
         return self._role in (None, Role.WORKER, "WORKER", "worker")
@@ -262,6 +270,33 @@ class Fleet:
 
     def is_worker(self):
         return self._role_maker.is_worker()
+
+    def device_type(self):
+        """This worker's device type ("cpu"/"tpu"/...) — heterogeneous
+        worker typing (framework/trainer.h:149 HeterXpuTrainer,
+        device_worker.h:334 HeterCpuWorker). Role makers without the
+        notion report "cpu"."""
+        fn = getattr(self._role_maker, "device_type", None)
+        return fn() if callable(fn) else "cpu"
+
+    def heter_step_fn(self, step_fns):
+        """Pick this worker's step function by device type — the minimal
+        HeterXpuTrainer contract: one PS job, device-typed workers, each
+        type running its own (CPU-eager vs accelerator-compiled) step.
+
+        ``step_fns``: dict like {"cpu": fn, "tpu": fn} or with a
+        "default" entry. Raises when this worker's type has no entry and
+        no default — a silently wrong step function must never run.
+        """
+        dt = self.device_type()
+        if dt in step_fns:
+            return step_fns[dt]
+        if "default" in step_fns:
+            return step_fns["default"]
+        raise KeyError(
+            f"no step function for device type {dt!r} (have "
+            f"{sorted(step_fns)}); heterogeneous jobs must cover every "
+            "worker type explicitly")
 
     def worker_endpoints(self, to_string=False):
         eps = self._role_maker.get_trainer_endpoints()

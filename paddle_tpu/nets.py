@@ -4,9 +4,11 @@ Reference parity: python/paddle/fluid/nets.py:29 (simple_img_conv_pool),
 :141 (img_conv_group), :256 (sequence_conv_pool), :328 (glu), :372
 (scaled_dot_product_attention). Pure composition over existing ops /
 static.nn builders — the mode-aware ``ops`` dispatch makes glu and
-scaled_dot_product_attention work in BOTH dygraph and static graph; the
-conv/sequence composites create implicit parameters and therefore follow
-the reference's static-graph contract (use nn.Conv2D layers in dygraph).
+single-head scaled_dot_product_attention work in BOTH dygraph and static
+graph; the conv/sequence composites and the multi-head projection path
+create implicit parameters and therefore follow the reference's
+static-graph contract (use nn.Conv2D / nn.MultiHeadAttention in
+dygraph).
 
 Ragged design note: the reference's sequence_conv_pool consumes an
 LoDTensor; our sequence ops use the padded+lengths representation
@@ -69,8 +71,7 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
     with_bn = _per_layer(conv_with_batchnorm, n, "conv_with_batchnorm")
     drop_rates = _per_layer(conv_batchnorm_drop_rate, n,
                             "conv_batchnorm_drop_rate")
-    attrs = (list(param_attr) if isinstance(param_attr, (list, tuple))
-             else [param_attr] * n)
+    attrs = _per_layer(param_attr, n, "param_attr")
 
     tmp = input
     for i in range(n):
@@ -145,6 +146,14 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
             f"must be divisible by num_heads ({num_heads})")
     q, k, v = queries, keys, values
     if num_heads > 1:
+        from .static.program import in_static_mode
+
+        if not in_static_mode():
+            raise RuntimeError(
+                "scaled_dot_product_attention(num_heads > 1) creates "
+                "implicit projection parameters and is static-graph only "
+                "(matching the reference, fluid/nets.py:372); in dygraph "
+                "use nn.MultiHeadAttention instead")
         q = static_nn.fc(q, q.shape[-1], num_flatten_dims=2,
                          bias_attr=False)
         k = static_nn.fc(k, k.shape[-1], num_flatten_dims=2,
@@ -154,12 +163,14 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
 
     def split_heads(x):
         b, l, hd = x.shape
+        b = -1 if b is None else b  # static data vars declare batch None
         x = ops.reshape(x, [b, l, num_heads, hd // num_heads])
         return ops.transpose(x, [0, 2, 1, 3])  # [B, H, L, D]
 
     def merge_heads(x):
         x = ops.transpose(x, [0, 2, 1, 3])
         b, l, h, d = x.shape
+        b = -1 if b is None else b
         return ops.reshape(x, [b, l, h * d])
 
     qh, kh, vh = split_heads(q), split_heads(k), split_heads(v)

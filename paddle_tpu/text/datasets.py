@@ -431,6 +431,12 @@ class Sentiment(Dataset):
 
         neg = sorted(_glob.glob(os.path.join(root, "neg", "*.txt")))
         pos = sorted(_glob.glob(os.path.join(root, "pos", "*.txt")))
+        if not neg or not pos or len(neg) != len(pos):
+            raise ValueError(
+                f"Sentiment: {root!r} exists but does not look like a "
+                f"movie_reviews layout (found {len(neg)} neg / "
+                f"{len(pos)} pos .txt files; need equal non-zero counts "
+                "under neg/ and pos/)")
         docs, labels = [], []
         # interleave neg/pos (sort_files cross-reading order)
         for nf, pf in zip(neg, pos):

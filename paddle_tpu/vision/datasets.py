@@ -196,25 +196,35 @@ class VOC2012(Dataset):
         # full-resolution pairs (>1.5 GB decoded), far too much to
         # materialize at construction
         self._tar_path = path
-        self._tar = tarfile.open(path)
-        names = {m.name: m for m in self._tar.getmembers()}
-        listing = self._tar.extractfile(
-            names[f"{voc}/ImageSets/Segmentation/{sub}.txt"])
-        self._members = [
-            (names[f"{voc}/JPEGImages/{line}.jpg"],
-             names[f"{voc}/SegmentationClass/{line}.png"])
-            for line in listing.read().decode().split()
-        ]
+        with tarfile.open(path) as tf:
+            names = set(m.name for m in tf.getmembers())
+            listing = tf.extractfile(
+                f"{voc}/ImageSets/Segmentation/{sub}.txt")
+            self._members = []
+            for line in listing.read().decode().split():
+                im = f"{voc}/JPEGImages/{line}.jpg"
+                lm = f"{voc}/SegmentationClass/{line}.png"
+                if im in names and lm in names:
+                    self._members.append((im, lm))
+        # one tar handle PER PROCESS: forked DataLoader workers must not
+        # share a file descriptor (concurrent seeks corrupt reads)
+        self._tars = {}
         self.data = None
 
     def _decode(self, i):
         import io
+        import os
+        import tarfile
 
         from PIL import Image
 
+        tar = self._tars.get(os.getpid())
+        if tar is None:
+            tar = tarfile.open(self._tar_path)
+            self._tars[os.getpid()] = tar
         im, lm = self._members[i]
-        img = Image.open(io.BytesIO(self._tar.extractfile(im).read()))
-        lab = Image.open(io.BytesIO(self._tar.extractfile(lm).read()))
+        img = Image.open(io.BytesIO(tar.extractfile(im).read()))
+        lab = Image.open(io.BytesIO(tar.extractfile(lm).read()))
         return np.array(img, np.uint8), np.array(lab, np.uint8)
 
     def _synthesize(self, mode, size):

@@ -1,0 +1,142 @@
+"""Heterogeneous-trainer fixture: device-typed workers sharing one PS job.
+
+Minimal HeterXpuTrainer semantics (framework/trainer.h:149,
+device_worker.h:334): one parameter server, one worker declared
+device_type="cpu" and one declared device_type="tpu", each running the
+step function registered for its type via fleet.heter_step_fn —
+the cpu worker an eager sparse-embedding step, the tpu worker a COMPILED
+dense step (framework/jit.py train_step) over features pulled through the
+same PS table. Both push into the shared table; both must converge.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (
+    DistributedStrategy,
+    Role,
+    UserDefinedRoleMaker,
+)
+from paddle_tpu.distributed.ps import PSEmbedding
+
+
+def main():
+    role = os.environ["PS_ROLE"]
+    endpoint = os.environ["PS_ENDPOINT"]
+
+    if role == "server":
+        rm = UserDefinedRoleMaker(
+            current_id=0, role=Role.SERVER, server_endpoints=[endpoint],
+            is_collective=False,
+        )
+        fleet.init(rm, is_collective=False)
+        fleet.run_server()
+        print(json.dumps({"role": "server", "ok": True}))
+        return
+
+    tid = int(os.environ["PS_TRAINER_ID"])
+    tnum = int(os.environ["PS_TRAINER_NUM"])
+    device_type = os.environ["PS_DEVICE_TYPE"]
+    strategy = DistributedStrategy()
+    strategy.a_sync = True
+    rm = UserDefinedRoleMaker(
+        current_id=tid, role=Role.WORKER, worker_num=tnum,
+        server_endpoints=[endpoint], is_collective=False,
+        device_type=device_type,
+    )
+    fleet.init(rm, is_collective=False, strategy=strategy)
+    fleet.init_worker()
+    assert fleet.device_type() == device_type
+
+    table = fleet.embedding_table("emb", 8, init_std=0.1)
+    emb = PSEmbedding(table)
+    paddle.seed(100 + tid)
+    head = nn.Linear(8, 1)
+    sgd = opt.SGD(learning_rate=0.1, parameters=head.parameters())
+
+    rng = np.random.RandomState(tid)
+    ids_pool = np.arange(tid * 50, tid * 50 + 20, dtype=np.int64)
+    targets = {int(i): float(np.sin(i)) for i in ids_pool}
+
+    # -- per-device-type step functions (the heter contract) ----------------
+    def cpu_step(ids, y):
+        """Sparse-heavy eager step (HeterCpuWorker role)."""
+        e = emb(paddle.to_tensor(ids.reshape(-1, 1)))
+        pred = head(e[:, 0, :])
+        loss = F.mse_loss(pred, paddle.to_tensor(y.reshape(-1, 1)))
+        loss.backward()
+        sgd.step()
+        sgd.clear_grad()
+        emb.push_step(lr=0.3)
+        return float(loss.numpy())
+
+    # tpu worker: the dense half runs as ONE compiled XLA step that also
+    # emits d(loss)/d(features) — the sparse gradient the host ships to
+    # the PS table (the reference's heter split: device-side dense
+    # compute, CPU-side sparse exchange)
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def tpu_train(hp, feats, y):
+        def lf(hp, feats):
+            pred = feats @ hp["w"] + hp["b"]
+            return jnp.mean((pred - y.reshape(-1, 1)) ** 2)
+
+        loss, (gp, gf) = jax.value_and_grad(lf, (0, 1))(hp, feats)
+        new = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, hp, gp)
+        return new, gf, loss
+
+    hstate = {"w": head.weight._array, "b": head.bias._array}
+
+    def tpu_step(ids, y):
+        rows = table.pull(ids)  # ids are unique per batch
+        nonlocal_state["hp"], gf, loss = tpu_train(
+            nonlocal_state["hp"], jnp.asarray(rows), jnp.asarray(y))
+        table.push_grad(ids, np.asarray(gf), lr=0.3)
+        return float(np.asarray(loss))
+
+    nonlocal_state = {"hp": hstate}
+    step = fleet.heter_step_fn({"cpu": cpu_step, "tpu": tpu_step})
+
+    def probe_loss():
+        if device_type == "tpu":  # write compiled state back to the layer
+            head.weight._array = nonlocal_state["hp"]["w"]
+            head.bias._array = nonlocal_state["hp"]["b"]
+        y = np.asarray([targets[int(i)] for i in ids_pool], np.float32)
+        e = emb(paddle.to_tensor(ids_pool.reshape(-1, 1)))
+        pred = head(e[:, 0, :])
+        l = F.mse_loss(pred, paddle.to_tensor(y.reshape(-1, 1)))
+        emb._pending.clear()
+        return float(l.numpy())
+
+    loss0 = probe_loss()
+    for _ in range(25):
+        ids = rng.choice(ids_pool, 16, replace=False)  # unique per batch
+        y = np.asarray([targets[int(i)] for i in ids], np.float32)
+        step(ids, y)
+        fleet.barrier_worker()
+    loss1 = probe_loss()
+
+    stats = fleet._ps_clients[0].stats()
+    fleet.barrier_worker()
+    if tid == 0:
+        fleet.shutdown_server()
+    fleet.stop_worker()
+    print(json.dumps({
+        "role": "trainer", "id": tid, "device_type": device_type,
+        "path": "compiled" if device_type == "tpu" else "eager",
+        "loss0": round(loss0, 5), "loss1": round(loss1, 5),
+        "rows": stats.get("emb", 0),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -332,3 +332,80 @@ def test_wire_codec_rejects_negative_dims():
                + _s.pack("<B", 1) + _s.pack("<q", -4) + b"\x00" * 16)
     with pytest.raises(ValueError, match="negative array dim"):
         _dec_value(payload, 0)
+
+
+HETER_FIXTURE = os.path.join(REPO, "tests", "fixtures", "heter_trainer.py")
+
+
+@pytest.mark.slow
+def test_heterogeneous_device_typed_trainers():
+    """Minimal HeterXpuTrainer semantics (trainer.h:149,
+    device_worker.h:334): one PS job, one cpu-typed and one tpu-typed
+    worker, each running its registered per-device-type step function
+    (eager sparse vs compiled dense) against the shared table."""
+    endpoint = f"127.0.0.1:{_free_port()}"
+    base = dict(os.environ)
+    base.pop("PYTEST_CURRENT_TEST", None)
+    base["JAX_PLATFORMS"] = "cpu"
+    base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
+    base["PS_ENDPOINT"] = endpoint
+
+    def spawn(extra):
+        env = dict(base)
+        env.update(extra)
+        return subprocess.Popen(
+            [sys.executable, HETER_FIXTURE], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+
+    server = spawn({"PS_ROLE": "server"})
+    host, port = endpoint.rsplit(":", 1)
+    for _ in range(100):
+        try:
+            socket.create_connection((host, int(port)), timeout=1.0).close()
+            break
+        except OSError:
+            time.sleep(0.1)
+    trainers = [
+        spawn({"PS_ROLE": "trainer", "PS_TRAINER_ID": "0",
+               "PS_TRAINER_NUM": "2", "PS_DEVICE_TYPE": "cpu"}),
+        spawn({"PS_ROLE": "trainer", "PS_TRAINER_ID": "1",
+               "PS_TRAINER_NUM": "2", "PS_DEVICE_TYPE": "tpu"}),
+    ]
+    outs = []
+    try:
+        for p in trainers + [server]:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, f"heter process failed:\n{err[-4000:]}"
+            line = [l for l in out.strip().splitlines()
+                    if l.startswith("{")][-1]
+            outs.append(json.loads(line))
+    except subprocess.TimeoutExpired:
+        for p in trainers + [server]:
+            p.kill()
+        raise
+    ts = [o for o in outs if o["role"] == "trainer"]
+    assert {t["device_type"] for t in ts} == {"cpu", "tpu"}
+    assert {t["path"] for t in ts} == {"eager", "compiled"}
+    for t in ts:
+        assert t["loss1"] < t["loss0"] * 0.7, t  # both device types learn
+        assert t["rows"] == 40, t  # both halves landed in the shared table
+
+
+def test_heter_step_fn_dispatch_and_validation():
+    from paddle_tpu.distributed.fleet.base import (
+        Fleet, UserDefinedRoleMaker)
+
+    f = Fleet()
+    f._role_maker = UserDefinedRoleMaker(device_type="tpu")
+    fns = {"cpu": lambda: "c", "tpu": lambda: "t"}
+    assert f.heter_step_fn(fns)() == "t"
+    assert f.device_type() == "tpu"
+    f2 = Fleet()
+    f2._role_maker = UserDefinedRoleMaker()  # default cpu
+    assert f2.heter_step_fn(fns)() == "c"
+    f3 = Fleet()
+    f3._role_maker = UserDefinedRoleMaker(device_type="npu")
+    assert f3.heter_step_fn({**fns, "default": lambda: "d"})() == "d"
+    with pytest.raises(KeyError, match="npu"):
+        f3.heter_step_fn(fns)

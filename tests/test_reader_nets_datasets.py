@@ -330,3 +330,44 @@ def test_voc2012_test_split_differs_from_train():
     tr = paddle.vision.datasets.VOC2012(mode="train")
     te = paddle.vision.datasets.VOC2012(mode="test")
     assert not np.array_equal(tr[0][0], te[0][0])
+
+
+def test_sdpa_static_none_batch_and_dygraph_multihead_guard():
+    import paddle_tpu.static as static
+
+    static.enable_static()
+    try:
+        q = static_mod.data("q", [None, 5, 8], "float32")
+        out = nets.scaled_dot_product_attention(q, q, q, num_heads=2)
+        exe = static_mod.Executor()
+        exe.run_startup()
+        r = exe.run(feed={"q": np.random.RandomState(0).randn(
+            3, 5, 8).astype(np.float32)}, fetch_list=[out])[0]
+        assert r.shape == (3, 5, 8)
+    finally:
+        static.disable_static()
+
+    qd = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 5, 8).astype(np.float32))
+    with pytest.raises(RuntimeError, match="static-graph only"):
+        nets.scaled_dot_product_attention(qd, qd, qd, num_heads=2)
+
+
+def test_sentiment_bad_layout_raises(tmp_path):
+    (tmp_path / "neg").mkdir()  # neg exists, pos missing
+    (tmp_path / "neg" / "a.txt").write_text("bad movie")
+    with pytest.raises(ValueError, match="movie_reviews layout"):
+        paddle.text.Sentiment(data_file=str(tmp_path))
+
+
+def test_img_conv_group_param_attr_length_validated():
+    import paddle_tpu.static as static
+
+    static.enable_static()
+    try:
+        img = static_mod.data("img", [None, 3, 8, 8], "float32")
+        with pytest.raises(ValueError, match="param_attr list length"):
+            nets.img_conv_group(img, conv_num_filter=[4, 4], pool_size=2,
+                                param_attr=[None])
+    finally:
+        static.disable_static()
