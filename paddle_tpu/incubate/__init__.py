@@ -6,3 +6,4 @@ from . import hapi_text  # noqa: F401  (incubate/hapi/text surface)
 from ..optimizer.wrappers import ModelAverage, Lookahead  # noqa: F401
 
 LookAhead = Lookahead
+from .model_stat import memory_usage, op_freq_statistic  # noqa: F401
