@@ -322,8 +322,6 @@ def _layer_cost(layer, args, kwargs):
     (no backend compile — client-side analysis of the lowered module)."""
     import jax
 
-    from ..framework import jit as fjit
-
     state = fjit.capture_state(layer)
 
     def pure(state, args):
@@ -380,21 +378,28 @@ def summary(net, input_size=None, dtypes=None, cost=False):
         if isinstance(dts, str):
             dts = [dts] * len(sizes)
         xs = [Tensor(np_.zeros(s, dtype=d)) for s, d in zip(sizes, dts)]
+        uncosted = []
         was_training = net.training
         net.eval()
         try:
             with no_grad():
                 net(*xs)
+            # lower per-layer costs INSIDE the eval window, so the cost
+            # graphs match the captured eval-mode activations (BN uses
+            # running stats, dropout is identity)
+            for name, l in leaves:
+                if id(l) not in captured:
+                    continue
+                c = _layer_cost(l, captured[id(l)], {})
+                if c is not None:
+                    cost_rows[name] = c
+                else:
+                    uncosted.append(name)
         finally:
             if was_training:
                 net.train()
             for h in hooks:
                 h.remove()
-        for name, l in leaves:
-            if id(l) in captured:
-                c = _layer_cost(l, captured[id(l)], {})
-                if c is not None:
-                    cost_rows[name] = c
 
     rows = []
     total, trainable = 0, 0
@@ -434,6 +439,13 @@ def summary(net, input_size=None, dtypes=None, cost=False):
         out["layer_costs"] = cost_rows
         out["total_flops"] = total_flops
         out["total_bytes"] = total_bytes
+        out["uncosted_layers"] = uncosted
+        if uncosted:
+            # never let skipped layers masquerade as fusion savings
+            lines.append(
+                f"NOT costed ({len(uncosted)} layers — lowering failed, "
+                f"totals underreport): {', '.join(uncosted[:8])}"
+                + ("…" if len(uncosted) > 8 else ""))
     text = "\n".join(lines)
     print(text)
     return out

@@ -1,0 +1,25 @@
+"""Export a MobileNet inference model for the R demo (the role of the
+reference's r/example/mobilenet.py). Run once before mobilenet.r:
+
+    python r/example/mobilenet.py /tmp/mobilenet_model
+"""
+import sys
+
+import paddle_tpu as paddle
+from paddle_tpu.models import MobileNetV1
+from paddle_tpu.static import InputSpec
+
+
+def main(out_dir):
+    paddle.seed(0)
+    net = MobileNetV1(num_classes=1000)
+    net.eval()
+    paddle.jit.save(
+        net, out_dir,
+        input_spec=[InputSpec([None, 3, 224, 224], "float32", name="x")],
+    )
+    print(f"saved inference model to {out_dir}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/mobilenet_model")

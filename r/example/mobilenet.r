@@ -1,0 +1,38 @@
+#!/usr/bin/env Rscript
+# MobileNet classification through paddle_tpu inference (the reference's
+# r/example/mobilenet.r, ported to the paddle_tpu.inference surface).
+# First: python r/example/mobilenet.py /tmp/mobilenet_model
+
+library(reticulate)
+
+np        <- import("numpy")
+inference <- import("paddle_tpu.inference")
+
+set_config <- function() {
+    config <- inference$Config("/tmp/mobilenet_model")
+    config$switch_ir_optim(TRUE)
+    return(config)
+}
+
+run_mobilenet <- function() {
+    config <- set_config()
+    predictor <- inference$create_predictor(config)
+
+    input_names <- predictor$get_input_names()
+    input_tensor <- predictor$get_input_handle(input_names[[1]])
+    data <- np_array(runif(3 * 224 * 224), dtype = "float32")$reshape(
+        as.integer(c(1, 3, 224, 224)))
+    input_tensor$copy_from_cpu(data)
+
+    predictor$run()
+
+    output_names <- predictor$get_output_names()
+    output_tensor <- predictor$get_output_handle(output_names[[1]])
+    output_data <- output_tensor$copy_to_cpu()
+    cat("logits shape:", dim(output_data), "\n")
+    cat("argmax class:", which.max(output_data) - 1, "\n")
+}
+
+if (!interactive()) {
+    run_mobilenet()
+}

@@ -1,7 +1,12 @@
 """Vision datasets/transforms + static io + inference predictor tests."""
+import os
+
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 import paddle_tpu.nn as nn
 from paddle_tpu.vision import transforms
 from paddle_tpu.vision.datasets import MNIST, Cifar10
@@ -90,3 +95,32 @@ def test_static_save_load_inference_model(tmp_path):
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
     finally:
         static.disable_static()
+
+
+@pytest.mark.slow
+def test_r_client_example_sequence(tmp_path):
+    """r/example/mobilenet.r drives paddle_tpu.inference through
+    reticulate — same-surface validation: run the bundled export script
+    then the exact Python call sequence the R script performs."""
+    import runpy
+    import sys
+
+    d = str(tmp_path / "mobilenet_model")
+    argv = sys.argv
+    sys.argv = ["mobilenet.py", d]
+    try:
+        runpy.run_path(os.path.join(REPO, "r", "example", "mobilenet.py"),
+                       run_name="__main__")
+    finally:
+        sys.argv = argv
+
+    import paddle_tpu.inference as inference
+
+    config = inference.Config(d)
+    config.switch_ir_optim(True)
+    p = inference.create_predictor(config)
+    t = p.get_input_handle(p.get_input_names()[0])
+    t.copy_from_cpu(np.random.rand(1, 3, 224, 224).astype("float32"))
+    p.run()
+    out = p.get_output_handle(p.get_output_names()[0]).copy_to_cpu()
+    assert out.shape == (1, 1000) and np.isfinite(out).all()
