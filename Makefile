@@ -1,0 +1,44 @@
+# Developer entry points (paddle/scripts/paddle_build.sh roles).
+#
+# Test-suite wall time is CPU-bound (the XLA:CPU backend compiles and
+# runs every test's programs; user time ~= real time on 1 core). The
+# persistent compilation cache (.jax_cache, wired in tests/conftest.py
+# and inherited by subprocess worlds) cuts repeat-run compile cost; on
+# multi-core hosts `make test` shards test FILES across xdist workers
+# for near-linear speedup (file granularity is xdist-safe by
+# construction).
+#
+# Measured on the 1-core reference box (warm cache):
+#   make test        16m10  (589 tests; floor is compute, not overhead)
+#   make test-fast   10m39  (580 tests; skips the 9 subprocess-heavy
+#                            "slow" tests)
+# Projected at >=4 cores: test ~4-5m, test-fast ~3m.
+
+NPROC := $(shell nproc 2>/dev/null || echo 1)
+# shard only with >1 core AND pytest-xdist importable (pip install -e .[test])
+HAS_XDIST := $(shell python -c "import xdist" 2>/dev/null && echo 1 || echo 0)
+DIST_FLAGS :=
+ifneq ($(NPROC),1)
+ifeq ($(HAS_XDIST),1)
+DIST_FLAGS := -n auto --dist loadfile
+endif
+endif
+
+.PHONY: test test-fast test-seq bench check
+
+test:
+	python -m pytest tests/ -q $(DIST_FLAGS)
+
+test-fast:
+	python -m pytest tests/ -q -m "not slow" $(DIST_FLAGS)
+
+test-seq:  # force sequential (timing baselines)
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+check:
+	python tools/check_op_coverage.py --min-pct 90
+	python tools/print_signatures.py --check
+	JAX_PLATFORMS=cpu python __graft_entry__.py

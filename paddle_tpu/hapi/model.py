@@ -358,6 +358,8 @@ def summary(net, input_size=None, dtypes=None, cost=False):
         hooks = []
         leaves = [(n, l) for n, l in net.named_sublayers()
                   if not list(l.children())]
+        if not leaves:  # the net itself is a single leaf layer
+            leaves = [(type(net).__name__, net)]
 
         def make_hook(lid):
             def pre_hook(layer, inputs):
@@ -400,6 +402,8 @@ def summary(net, input_size=None, dtypes=None, cost=False):
                 net.train()
             for h in hooks:
                 h.remove()
+        never_ran = [n for n, l in leaves if id(l) not in captured]
+        uncosted.extend(never_ran)
 
     rows = []
     total, trainable = 0, 0

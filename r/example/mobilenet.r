@@ -5,7 +5,6 @@
 
 library(reticulate)
 
-np        <- import("numpy")
 inference <- import("paddle_tpu.inference")
 
 set_config <- function() {
