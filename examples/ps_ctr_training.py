@@ -11,6 +11,10 @@ its own host — see tests/fixtures/ps_trainer.py):
 
 Run: JAX_PLATFORMS=cpu PYTHONPATH=. python examples/ps_ctr_training.py
 """
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable from anywhere
 import os
 import tempfile
 

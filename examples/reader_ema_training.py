@@ -1,0 +1,67 @@
+"""Classic book-style pipeline with the r5 surfaces: paddle.reader
+decorators feeding a model trained with Lookahead(Adam), evaluated
+through ExponentialMovingAverage weights.
+
+Run: JAX_PLATFORMS=cpu python examples/reader_ema_training.py
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable from anywhere
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.reader as reader
+
+
+def main():
+    paddle.seed(0)
+    ds = paddle.text.UCIHousing(mode="train")
+    test = paddle.text.UCIHousing(mode="test")
+
+    def raw():
+        for i in range(len(ds)):
+            yield ds[i]
+
+    pipe = reader.buffered(reader.shuffle(raw, buf_size=128), size=32)
+
+    net = nn.Sequential(nn.Linear(13, 32), nn.ReLU(), nn.Linear(32, 1))
+    inner = opt.Adam(learning_rate=2e-2, parameters=net.parameters())
+    lookahead = opt.Lookahead(inner, alpha=0.5, k=5)
+    ema = opt.ExponentialMovingAverage(parameters=net.parameters(),
+                                       decay=0.95)
+
+    def run_epoch():
+        batch, losses = [], []
+        for sample in pipe():
+            batch.append(sample)
+            if len(batch) < 32:
+                continue
+            x = paddle.to_tensor(np.stack([b[0] for b in batch]))
+            y = paddle.to_tensor(np.stack([b[1] for b in batch]))
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            lookahead.step()
+            lookahead.clear_grad()
+            ema.update()
+            losses.append(float(loss.item()))
+            batch = []
+        return float(np.mean(losses))
+
+    for epoch in range(40):
+        tl = run_epoch()
+    xt = paddle.to_tensor(np.stack([test[i][0] for i in range(len(test))]))
+    yt = paddle.to_tensor(np.stack([test[i][1] for i in range(len(test))]))
+    raw_mse = float(F.mse_loss(net(xt), yt).item())
+    with ema.apply():  # evaluate on the smoothed weights
+        ema_mse = float(F.mse_loss(net(xt), yt).item())
+    print(f"train loss {tl:.4f} | test mse raw {raw_mse:.4f} "
+          f"| test mse EMA {ema_mse:.4f}")
+    assert tl < 60.0 and np.isfinite(ema_mse)  # prices are ~22.5-scale
+
+
+if __name__ == "__main__":
+    main()
