@@ -1,0 +1,9 @@
+"""Shared example bootstrap: make the repo root importable so every
+example runs from any cwd (`python examples/foo.py`). The script's own
+directory is always on sys.path, so examples just `import _bootstrap`."""
+import os
+import sys
+
+_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _root not in sys.path:
+    sys.path.insert(0, _root)

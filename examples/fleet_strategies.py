@@ -7,10 +7,7 @@ Run on a dev box:
     JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
         python examples/fleet_strategies.py
 """
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable from anywhere
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import numpy as np
 
 import paddle_tpu as paddle

@@ -2,10 +2,7 @@
 
 Run: JAX_PLATFORMS=cpu python examples/inference_deploy.py
 """
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable from anywhere
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import numpy as np
 
 import paddle_tpu.static as static

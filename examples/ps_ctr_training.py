@@ -9,12 +9,9 @@ its own host — see tests/fixtures/ps_trainer.py):
 2. A sparse embedding table living on a TableServer (host RAM), pulled/
    pushed per batch by PSEmbedding; the dense head trains on-device.
 
-Run: JAX_PLATFORMS=cpu PYTHONPATH=. python examples/ps_ctr_training.py
+Run: JAX_PLATFORMS=cpu python examples/ps_ctr_training.py
 """
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable from anywhere
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
 import tempfile
 

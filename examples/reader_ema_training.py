@@ -4,10 +4,7 @@ through ExponentialMovingAverage weights.
 
 Run: JAX_PLATFORMS=cpu python examples/reader_ema_training.py
 """
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable from anywhere
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import numpy as np
 
 import paddle_tpu as paddle

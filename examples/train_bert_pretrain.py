@@ -7,10 +7,7 @@ Usage:
 On CPU dev boxes: JAX_PLATFORMS=cpu
 XLA_FLAGS=--xla_force_host_platform_device_count=8 for a virtual mesh.
 """
-import os as _os
-import sys as _sys
-
-_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))  # runnable from anywhere
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import argparse
 
 import numpy as np
