@@ -315,6 +315,18 @@ def _pallas_fwd_small(q, k, v, bias, seed, causal, scale, rate):
     return out, lse
 
 
+def _bwd_small_fits_vmem(h, lq, lk, d, budget=6 << 20):
+    """The one-pass backward holds ALL heads of one batch item in VMEM:
+    7 bf16 [h,l,d] operand/result tiles plus 3 f32 [h,lq,lk] score-sized
+    intermediates. The compiler's scoped-vmem stack roughly doubles the
+    estimate (in/out buffering), so gate at ~6 MB against the 16 MB core
+    limit — at h=12,d=64 this admits L=128 (3.7 MB) and correctly sends
+    L>=256 (12+ MB, observed 18.5 MB scoped OOM) to the tiled kernels."""
+    tiles = 7 * h * max(lq, lk) * d * 2
+    scores = 3 * h * lq * lk * 4
+    return tiles + scores <= budget
+
+
 def _pallas_bwd_small(q, k, v, bias, seed, causal, scale, rate, lse, g,
                       delta):
     from jax.experimental import pallas as pl
@@ -397,6 +409,33 @@ def _bias_spec(bias, b, h, lq, lk, block_q, pl, pltpu):
     return arr, spec
 
 
+def _effective_blocks(lq, lk, block_q, block_k):
+    """Tile sizes the kernels actually use. The grids FLOOR-divide seq
+    by block, so a 128-multiple that is not a block multiple (L=384,
+    640, ...) must shrink to the 128 base tile or its tail rows are
+    silently dropped (_supported gates on L % 128 == 0)."""
+    block_q = min(block_q, lq)
+    block_k = min(block_k, lk)
+    if lq % block_q:
+        block_q = 128
+    if lk % block_k:
+        block_k = 128
+    return block_q, block_k
+
+
+def _use_small_path(h, lq, lk, d, block_q, block_k):
+    """One dispatch predicate for BOTH forward and backward small
+    kernels. They must agree whenever dropout is on: the small kernels
+    seed the PRNG per batch item while the tiled ones re-seed per head,
+    so a small-forward/tiled-backward split would regenerate a DIFFERENT
+    mask for every head but the first — silently wrong gradients."""
+    # the backward's VMEM bound gates BOTH directions: with dropout the
+    # masks must pair, and without it the small backward would still OOM
+    # scoped VMEM at shapes the forward alone could handle
+    return (lq <= block_q and lk <= block_k
+            and _bwd_small_fits_vmem(h, lq, lk, d))
+
+
 def _pallas_fwd(q, k, v, bias, seed, causal, scale, rate,
                 block_q=256, block_k=256):
     """Returns (out, lse): lse is the per-row logsumexp [B*H, LQ], f32."""
@@ -405,9 +444,8 @@ def _pallas_fwd(q, k, v, bias, seed, causal, scale, rate,
 
     b, h, lq, d = q.shape
     lk = k.shape[2]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
-    if lq <= block_q and lk <= block_k:
+    block_q, block_k = _effective_blocks(lq, lk, block_q, block_k)
+    if _use_small_path(h, lq, lk, d, block_q, block_k):
         out, lse = _pallas_fwd_small(q, k, v, bias, seed, causal, scale,
                                      rate)
         return out, lse.reshape(b * h, lq, 1)
@@ -597,8 +635,7 @@ def _pallas_bwd(q, k, v, bias, seed, causal, scale, rate, out, lse, g,
 
     b, h, lq, d = q.shape
     lk = k.shape[2]
-    block_q = min(block_q, lq)
-    block_k = min(block_k, lk)
+    block_q, block_k = _effective_blocks(lq, lk, block_q, block_k)
     qf = q.reshape(b * h, lq, d)
     kf = k.reshape(b * h, lk, d)
     vf = v.reshape(b * h, lk, d)
@@ -608,9 +645,11 @@ def _pallas_bwd(q, k, v, bias, seed, causal, scale, rate, out, lse, g,
         gf.astype(jnp.float32) * out.reshape(b * h, lq, d).astype(jnp.float32),
         axis=-1, keepdims=True,
     )  # [B*H, LQ, 1]
-    if lq <= block_q and lk <= block_k:
+    if _use_small_path(h, lq, lk, d, block_q, block_k):
         # short-sequence regime: one program per batch item (all heads)
-        # beats two tiled passes (launch + DMA overhead dominates there)
+        # beats two tiled passes (launch + DMA overhead dominates there);
+        # the predicate is SHARED with the forward so dropout seeding
+        # schemes always pair
         return _pallas_bwd_small(
             q, k, v, bias, seed, causal, scale, rate,
             lse.reshape(b, h, lq, 1), g, delta.reshape(b, h, lq, 1))

@@ -161,3 +161,42 @@ def test_bert_flash_config_matches_plain_eval(monkeypatch):
     s1, p1 = m1(ids)
     s2, p2 = m2(ids)
     np.testing.assert_allclose(s1.numpy(), s2.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_block_adaptation_for_non_multiple_lengths():
+    """Seq lengths that are 128-multiples but not 256-multiples (384,
+    640) must shrink the tile to the 128 base block — the grids FLOOR-
+    divide, and with block 256 the tail rows were silently dropped
+    (garbage forward, NaN gradients; caught on-chip at L=384)."""
+    from paddle_tpu.ops.pallas.flash_attention import _effective_blocks
+
+    assert _effective_blocks(512, 512, 256, 256) == (256, 256)
+    assert _effective_blocks(384, 384, 256, 256) == (128, 128)
+    assert _effective_blocks(640, 640, 256, 256) == (128, 128)
+    assert _effective_blocks(128, 128, 256, 256) == (128, 128)
+    assert _effective_blocks(256, 256, 256, 256) == (256, 256)
+    assert _effective_blocks(384, 512, 256, 256) == (128, 256)  # lq != lk
+    # every gate-admitted length divides its effective block
+    for l in range(128, 2049, 128):
+        bq, _ = _effective_blocks(l, l, 256, 256)
+        assert l % bq == 0, (l, bq)
+
+
+def test_bwd_small_vmem_gate_shared_between_fwd_and_bwd():
+    """The one-pass kernels hold h*(7 l d bf16 + 3 l^2 f32) per program;
+    at BERT-base geometry they fit at L=128 and must NOT be chosen at
+    L>=256 (observed 18.5MB scoped-vmem OOM on chip). The predicate is
+    SHARED by forward and backward dispatch: a small-forward with a
+    tiled-backward would regenerate different dropout masks (per-batch
+    vs per-head PRNG seeding) for every head but the first."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        _bwd_small_fits_vmem, _use_small_path)
+
+    assert _bwd_small_fits_vmem(12, 128, 128, 64)
+    assert not _bwd_small_fits_vmem(12, 256, 256, 64)
+    assert _bwd_small_fits_vmem(1, 256, 256, 64)  # single head fits
+
+    # dispatch agreement: whatever the shape, the one predicate decides
+    assert _use_small_path(12, 128, 128, 64, 256, 256)
+    assert not _use_small_path(12, 256, 256, 64, 256, 256)
+    assert not _use_small_path(12, 384, 384, 64, 128, 128)  # > block
