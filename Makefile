@@ -9,8 +9,8 @@
 # construction).
 #
 # Measured on the 1-core reference box (warm cache):
-#   make test        16m10  (589 tests; floor is compute, not overhead)
-#   make test-fast   10m39  (580 tests; skips the 9 subprocess-heavy
+#   make test        12m20  (591 tests; floor is compute, not overhead)
+#   make test-fast   10m39  (582 tests; skips the 9 subprocess-heavy
 #                            "slow" tests)
 # Projected at >=4 cores: test ~4-5m, test-fast ~3m.
 
