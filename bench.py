@@ -237,6 +237,59 @@ def bench_bert(on_tpu, phase=1):
     })
 
 
+def bench_executor_dispatch(iters=200):
+    """Static-graph Executor steady-state dispatch micro-bench.
+
+    Runs one small compiled train step ``iters+1`` times through
+    Executor.run and reports dispatches/sec plus the executor's
+    plan-cache / jit-cache / donation counters (profiler.counters): in
+    steady state every run after the first must be a plan-cache hit — the
+    op walk runs exactly once — and the written persistables are donated.
+    """
+    import paddle_tpu.static as static
+    from paddle_tpu import ops, profiler
+
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        x = static.data("x", [32, 64], "float32")
+        y = static.data("y", [32, 1], "float32")
+        w = static.nn.create_parameter([64, 1], "float32")
+        pred = ops.matmul(x, w)
+        loss = ops.mean(ops.square(ops.subtract(pred, y)))
+        opt = static.optimizer.Adam(learning_rate=0.01)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run_startup()
+        rng = np.random.RandomState(0)
+        X = rng.randn(32, 64).astype("float32")
+        Y = rng.randn(32, 1).astype("float32")
+
+        profiler.reset_counters()
+        exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])  # compile
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+        loss_end = float(np.asarray(out[0]))  # value fetch = barrier
+        dt = time.perf_counter() - t0
+        counters = {k: v for k, v in profiler.counters().items()
+                    if k.startswith("executor::")}
+        return {
+            "metric": "executor_steady_state_dispatches_per_sec",
+            "value": round(iters / dt, 1),
+            "unit": "runs/sec",
+            "runs": iters + 1,
+            "loss_end": round(loss_end, 4),
+            "counters": counters,
+        }
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+
 def main():
     import jax
 
@@ -247,6 +300,8 @@ def main():
     # driver-captured number (dispatch: nn/transformer.py
     # FLASH_ATTENTION_MIN_SEQ)
     result["secondary2"] = bench_bert(on_tpu, phase=2)
+    # host-side dispatch health: plan-cache hit rate + donation counters
+    result["executor_dispatch"] = bench_executor_dispatch()
     print(json.dumps(result))
 
 

@@ -103,7 +103,17 @@ def _enc_value(obj, out):
         raise TypeError(f"not wire-encodable: {type(obj).__name__}")
 
 
-def _dec_value(buf, off):
+# container nesting cap: the decoder recurses per list/dict level, so a
+# malformed message of thousands of nested "l"/"d" tags would otherwise
+# raise RecursionError inside the connection thread. No protocol message
+# nests beyond a handful of levels.
+_MAX_NESTING = 32
+
+
+def _dec_value(buf, off, depth=0):
+    if depth > _MAX_NESTING:
+        raise ValueError(
+            f"wire container nesting exceeds {_MAX_NESTING} levels")
     tag = buf[off:off + 1]
     off += 1
     if tag == b"N":
@@ -150,7 +160,7 @@ def _dec_value(buf, off):
         off += 4
         items = []
         for _ in range(n):
-            v, off = _dec_value(buf, off)
+            v, off = _dec_value(buf, off, depth + 1)
             items.append(v)
         return tuple(items), off
     if tag == b"d":
@@ -160,9 +170,11 @@ def _dec_value(buf, off):
         for _ in range(n):
             (klen,) = struct.unpack_from("<I", buf, off)
             off += 4
+            if klen > len(buf) - off:
+                raise ValueError("dict key exceeds message bounds")
             k = bytes(buf[off:off + klen]).decode("utf-8")
             off += klen
-            d[k], off = _dec_value(buf, off)
+            d[k], off = _dec_value(buf, off, depth + 1)
         return d, off
     raise ValueError(f"bad wire tag {tag!r} at offset {off - 1}")
 

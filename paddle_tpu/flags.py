@@ -17,7 +17,8 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
-__all__ = ["define_flag", "get_flags", "set_flags", "flag", "globals_view"]
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "globals_view",
+           "watch_flag"]
 
 
 @dataclass
@@ -33,6 +34,15 @@ class _Flag:
 
 
 _REGISTRY: dict[str, _Flag] = {}
+_WATCHERS: dict[str, list] = {}
+
+
+def watch_flag(name: str, callback):
+    """Invoke ``callback(new_value)`` whenever ``set_flags`` changes the
+    flag — for flags whose consumers must react immediately (e.g. the
+    executor re-syncs jax's persistent compile cache on change) rather
+    than at their next natural read."""
+    _WATCHERS.setdefault(name, []).append(callback)
 
 
 def _coerce(value, typ):
@@ -91,6 +101,8 @@ def set_flags(flags_map: dict):
             raise InvalidArgumentError(
                 f"flag {name!r} expects {f.type.__name__}, got {value!r}"
             ) from e
+        for cb in _WATCHERS.get(name, ()):
+            cb(f.value)
 
 
 def globals_view() -> dict:
@@ -129,3 +141,22 @@ define_flag("call_stack_level", 1,
 # set). Kept behind the flag for future backends/shapes.
 define_flag("use_pallas_pool_bwd", False,
             "fused pallas kernel for max-pool backward on TPU")
+
+# static/executor.py — buffer donation for persistables on the compiled
+# whole-block step: parameters/optimizer state update in place (XLA input/
+# output aliasing) instead of doubling HBM traffic each step, matching the
+# dygraph path's donate_argnums (parallel/train.py). The Scope transfers
+# ownership: after a run, donated scope entries point at the NEW arrays and
+# the old buffers are dead. Opt out for debugging workflows that hold
+# references to pre-step parameter arrays.
+define_flag("executor_buffer_donation", True,
+            "donate written persistables to the compiled step (in-place "
+            "parameter updates); disable to keep pre-step arrays alive")
+
+# static/executor.py — JAX persistent compilation cache directory: repeated
+# process starts skip XLA recompilation of unchanged programs (the role of
+# TVM's ahead-of-time compiled module artifact). Empty string disables.
+# Applied lazily at the first executor compile after the flag is set.
+define_flag("persistent_compile_cache_dir", "",
+            "directory for the XLA persistent compilation cache "
+            "(empty: disabled)")

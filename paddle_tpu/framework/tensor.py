@@ -101,6 +101,12 @@ class Tensor:
     def numpy(self):
         return np.asarray(self._array)
 
+    def __array__(self, dtype=None):
+        # numpy interop for lazily-fetched tensors (Executor.run
+        # return_numpy=False): np.asarray(t) is the explicit sync point
+        a = np.asarray(self._array)
+        return a.astype(dtype, copy=False) if dtype is not None else a
+
     def item(self):
         return self._array.item()
 

@@ -185,7 +185,12 @@ class _MultiprocessIter:
 
 
 class _DevicePrefetcher:
-    """buffered_reader.cc equivalent: keep N batches already on device."""
+    """buffered_reader.cc equivalent: keep N batches already on device.
+
+    jax.device_put enqueues the H2D copy asynchronously, so refilling
+    after each pop puts the NEXT batches' transfers in flight while the
+    consumer's step runs — shared by the DataLoader's buffer reader and
+    Executor.train_from_dataset (via DatasetBase._iter_device_batches)."""
 
     def __init__(self, it, depth=2, to_device=None):
         self.it = it
@@ -195,14 +200,14 @@ class _DevicePrefetcher:
         self._fill()
 
     def _fill(self):
-        import jax
-
         while len(self.buf) < self.depth:
             try:
                 batch = next(self.it)
             except StopIteration:
                 return
             if self.to_device:
+                import jax
+
                 batch = jax.tree_util.tree_map(jax.device_put, batch)
             self.buf.append(batch)
 

@@ -288,6 +288,16 @@ class DatasetBase:
     def _feed_names(self):
         return [getattr(v, "name", str(v)) for v in self._use_vars]
 
+    def _iter_device_batches(self, depth=2):
+        """Device-resident batch stream: keep ``depth`` batches' H2D
+        transfers in flight (buffered_reader.cc's double buffering, via
+        the DataLoader's _DevicePrefetcher) so the executor's dispatch of
+        step N overlaps batch N+1's host->device copy."""
+        from .dataloader import _DevicePrefetcher
+
+        return iter(_DevicePrefetcher(iter(self._iter_batches()),
+                                      depth=depth, to_device=True))
+
     # subclasses provide _iter_batches()
 
 

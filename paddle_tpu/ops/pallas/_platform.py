@@ -1,0 +1,22 @@
+"""Shared platform gate for pallas kernel dispatch.
+
+Every pallas kernel's ``*_supported`` predicate must agree on which jax
+backends count as "TPU" — the local ``tpu`` platform and the remote-TPU
+plugin ``axon`` (the same convention framework/random.py uses for the
+rbg PRNG choice). One predicate here keeps the gates from drifting:
+pool_backward.py admitted ('tpu', 'axon') while flash attention admitted
+only 'tpu' until this was factored out.
+"""
+from __future__ import annotations
+
+import jax
+
+TPU_PLATFORMS = ("tpu", "axon")
+
+
+def on_tpu_platform() -> bool:
+    """True when the default jax backend is a (possibly remote) TPU."""
+    try:
+        return jax.devices()[0].platform in TPU_PLATFORMS
+    except Exception:
+        return False

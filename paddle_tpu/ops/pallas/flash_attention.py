@@ -38,6 +38,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ._platform import on_tpu_platform
+
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
@@ -765,7 +767,7 @@ def _pallas_bwd(q, k, v, bias, seed, causal, scale, rate, out, lse, g,
 
 
 def _supported(q, k, v, bias):
-    if jax.devices()[0].platform not in ("tpu",):
+    if not on_tpu_platform():
         return False
     b, h, lq, d = q.shape
     lk = k.shape[2]

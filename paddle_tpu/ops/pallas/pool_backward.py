@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._platform import on_tpu_platform
+
 __all__ = ["max_pool2d_backward", "max_pool_backward_supported"]
 
 
@@ -175,11 +177,7 @@ def max_pool_backward_supported(x_shape, dtype, ks, st, p, ceil_extra,
                                 data_format):
     """Gate for the pallas path: TPU backend, NCHW 4D floating input,
     symmetric padding (no ceil_mode tail), spatial dims known."""
-    try:
-        platform = jax.devices()[0].platform
-    except Exception:
-        return False
-    if platform not in ("tpu", "axon"):
+    if not on_tpu_platform():
         return False
     if data_format != "NCHW" or len(x_shape) != 4:
         return False

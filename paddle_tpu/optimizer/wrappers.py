@@ -131,7 +131,10 @@ class ExponentialMovingAverage(_ParamSwap):
 
     def _target_values(self):
         if self._step == 0:
-            return list(self._ema)
+            # no update() yet: the shadow is still zero-init, so the
+            # averaged weights ARE the live weights (ModelAverage's
+            # total == 0 path behaves the same way)
+            return [p._array for p in self._parameters]
         denom = 1.0 - self._decay_prod
         return [e / denom for e in self._ema]
 

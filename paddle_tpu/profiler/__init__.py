@@ -26,6 +26,9 @@ __all__ = [
     "profiler",
     "reset_profiler",
     "export_chrome_tracing",
+    "bump_counter",
+    "counters",
+    "reset_counters",
 ]
 
 _state = threading.local()
@@ -33,6 +36,32 @@ _events = []
 _events_lock = threading.Lock()
 _enabled = [False]
 _device_trace_dir = [None]
+
+# -- dispatch counters --------------------------------------------------------
+# Always-on monotonic counters (unlike timed events, which only record while
+# the profiler is enabled): the executor's plan-cache hit/miss, jit-cache
+# hit/miss, and donation accounting are cheap integer bumps that tests and
+# bench.py read directly — the role of the reference's STAT_* registry
+# (platform/monitor.h) rather than the timeline.
+_counters: dict[str, int] = {}
+_counters_lock = threading.Lock()
+
+
+def bump_counter(name: str, n: int = 1) -> None:
+    """Increment a named monotonic counter."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def counters() -> dict:
+    """Snapshot of all counters."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    with _counters_lock:
+        _counters.clear()
 
 
 def _now_us():
@@ -157,6 +186,8 @@ def print_summary(sorted_key="total", file=None):
     agg = summary_records()
     if not agg:
         print("No profiler events recorded.", file=file)
+        # counters are always-on (no start_profiler needed): still show them
+        _print_counters(file)
         return
     grand_total = sum(r["total"] for r in agg.values()) or 1.0
     key = _SORT_KEYS[sorted_key]
@@ -185,6 +216,18 @@ def print_summary(sorted_key="total", file=None):
             file=file,
         )
     print(bar, file=file)
+    _print_counters(file, name_w, footer_bar=bar)
+
+
+def _print_counters(file=None, name_w=40, footer_bar=None):
+    snap = counters()
+    if not snap:
+        return
+    print("Counters:", file=file)
+    for name in sorted(snap):
+        print(f"  {name:<{name_w}}  {snap[name]:>10}", file=file)
+    if footer_bar:
+        print(footer_bar, file=file)
 
 
 def reset_profiler():

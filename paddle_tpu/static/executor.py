@@ -30,11 +30,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..flags import flag, watch_flag
 from ..framework import random as _random
 from ..framework.place import Place, _default_place
 from ..framework.tensor import Tensor
 from ..ops.registry import kernel
-from ..profiler import RecordEvent
+from ..profiler import RecordEvent, bump_counter
 from .program import Program, default_main_program, default_startup_program
 
 
@@ -117,6 +118,208 @@ def op_out_names(op):
     if slots:
         return [n for s in slots for n in op.outputs.get(s, [])]
     return op.outputs.get("Out", [])
+
+
+class _LazyFetchList(list):
+    """``run()`` fetch result: a list whose elements materialize to numpy
+    on first access.
+
+    ``return_numpy=True`` used to force a blocking ``np.asarray`` on every
+    fetch every step; now the device->host sync happens at first element
+    access, so a training loop that only inspects the loss every
+    ``print_period`` steps dispatches the intervening steps without ever
+    blocking on a transfer, and ``train_from_dataset`` overlaps batch
+    N+1's H2D copy with step N's dispatch.
+    """
+
+    def _materialize(self, i):
+        v = list.__getitem__(self, i)
+        if not isinstance(v, np.ndarray):
+            v = np.asarray(v)
+            list.__setitem__(self, i, v)
+        return v
+
+    def _materialize_all(self):
+        for i in range(len(self)):
+            self._materialize(i)
+        return self
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self._materialize(j)
+                    for j in range(*i.indices(len(self)))]
+        return self._materialize(i)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self._materialize(i)
+
+    # C-level list paths that bypass __getitem__ must not leak raw device
+    # arrays: materialize everything first, then defer to list
+    def pop(self, i=-1):
+        self._materialize_all()
+        return list.pop(self, i)
+
+    def copy(self):
+        return list(self._materialize_all())
+
+    def index(self, *a):
+        return list.index(self._materialize_all(), *a)
+
+    def count(self, v):
+        return list.count(self._materialize_all(), v)
+
+    def __contains__(self, v):
+        return list.__contains__(self._materialize_all(), v)
+
+    def __eq__(self, other):
+        return list.__eq__(self._materialize_all(), other)
+
+    __hash__ = None
+
+    def __add__(self, other):
+        return list(self._materialize_all()) + list(other)
+
+    def __radd__(self, other):
+        return list(other) + list(self._materialize_all())
+
+    def __mul__(self, n):
+        return list.__mul__(self._materialize_all(), n)
+
+    __rmul__ = __mul__
+
+    def __repr__(self):
+        return list.__repr__(self._materialize_all())
+
+    def __reduce__(self):  # pickle ships numpy, never device handles
+        return (list, (list(self._materialize_all()),))
+
+
+# last FLAGS_persistent_compile_cache_dir value applied to jax.config
+# (None = never applied), and the ambient jax cache settings saved before
+# the first override so clearing the flag restores them all (a host app —
+# or the test suite's conftest — may have configured its own cache)
+_persistent_cache_applied = [None]
+_ambient_cache_config = [None]
+
+_CACHE_CONFIG_KEYS = (
+    "jax_compilation_cache_dir",
+    "jax_persistent_cache_min_compile_time_secs",
+)
+
+
+def _sync_persistent_cache():
+    """Apply FLAGS_persistent_compile_cache_dir to jax's persistent
+    compilation cache so repeated process starts skip XLA recompilation.
+    Checked only on jit-entry misses — zero cost in the dispatch loop.
+    An unset flag never touches ambient jax config."""
+    d = flag("persistent_compile_cache_dir")
+    if d == _persistent_cache_applied[0]:
+        return
+    if not d and _persistent_cache_applied[0] is None:
+        _persistent_cache_applied[0] = d  # flag never set: hands off
+        return
+    try:
+        if not _persistent_cache_applied[0]:
+            _ambient_cache_config[0] = {
+                k: getattr(jax.config, k) for k in _CACHE_CONFIG_KEYS}
+        if d:
+            jax.config.update("jax_compilation_cache_dir", d)
+            # modest floor: low enough to capture every whole-block
+            # executor compile, high enough that the process's tiny
+            # per-op eager jits don't each pay a disk write (jax.config
+            # is global — this affects ALL compiles in the process)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              0.1)
+        else:  # flag cleared: hand the whole cache config back untouched
+            for k, v in _ambient_cache_config[0].items():
+                jax.config.update(k, v)
+        # jax latches its cache handle at the first compile; re-pointing
+        # the dir after any compile has happened needs an explicit reset
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception as e:  # older jax without the persistent-cache config
+        import warnings
+
+        warnings.warn(
+            f"persistent_compile_cache_dir={d!r} could not be applied to "
+            f"this jax ({type(e).__name__}: {e}); compiles will not be "
+            "cached across process starts", RuntimeWarning, stacklevel=2)
+    _persistent_cache_applied[0] = d
+
+
+# set_flags must take effect immediately — clearing the flag restores the
+# ambient jax cache config right away, not at the next jit-cache miss
+watch_flag("persistent_compile_cache_dir", lambda _v: _sync_persistent_cache())
+
+
+def _plan_key(program):
+    tok = getattr(program, "_identity_token", None)
+    if tok is None:
+        tok = id(program)
+    return (tok, program._version)
+
+
+class RunPlan:
+    """Static dispatch plan for one (program identity, version), computed
+    once and reused by every ``run()`` on that program state.
+
+    Everything the executor used to re-derive per call by walking all ops
+    — the referenced-persistable analysis, the statically-written
+    persistable set (the donation candidates), rng-id assignment, the
+    captured-constant list — lives here, so the steady-state hot path
+    reduces to dict lookups plus the jitted call (TVM's split of one-time
+    compilation from cheap repeated dispatch, arXiv 1802.04799). Any
+    program mutation that matters goes through ``append_op``/
+    ``_new_block``, which bump ``_version`` and so key a fresh plan;
+    flipping a var's ``persistable`` flag without adding ops is the one
+    mutation this cache cannot see.
+    """
+
+    __slots__ = ("key", "block", "op_list", "persist_candidates",
+                 "written_names", "constants")
+
+    def __init__(self, program):
+        self.key = _plan_key(program)
+        block = program.global_block()
+        self.block = block
+        self.op_list = list(block.ops)
+        self.constants = list(getattr(program, "_constants", {}).items())
+
+        # ONE walk over all ops (incl. nested control-flow blocks) collects
+        # what three walks used to: referenced names, written persistables,
+        # and rng-id assignment state.
+        referenced = {}  # name -> owning block for persistable lookup
+        written = set()
+        next_id = 0
+        rng_missing = []
+        for blk, op in _walk_ops(program, 0):
+            for names in list(op.inputs.values()) + list(op.outputs.values()):
+                for n in names:
+                    referenced.setdefault(n, blk)
+            for n in op_out_names(op):
+                if n and blk.has_var(n) and blk.var(n).persistable:
+                    written.add(n)
+            rid = op.attrs.get("__rng_id__")
+            if rid is not None:
+                next_id = max(next_id, rid + 1)
+            elif op.attrs.get("__rng__"):
+                rng_missing.append(op)
+        # rng ids are assigned at build time (op_append.py) so grad ops
+        # share their forward op's id; assign here only for ops that
+        # predate that (e.g. hand-built/deserialized programs)
+        for op in rng_missing:
+            op.attrs["__rng_id__"] = next_id
+            next_id += 1
+
+        # persistable vars any op touches: the per-run persist_in is this
+        # list filtered by scope membership — no op traversal at dispatch
+        self.persist_candidates = tuple(sorted(
+            n for n, blk in referenced.items()
+            if blk.has_var(n) and blk.var(n).persistable
+        ))
+        self.written_names = frozenset(written)
 
 
 class _BlockRunner:
@@ -419,31 +622,68 @@ class _BlockRunner:
                     written_persist[name] = value
 
 
-def _trace_block(program, block, op_list, feed_names, fetch_names, persist_in):
+def _trace_block(program, block, op_list, feed_names, fetch_names,
+                 donate_names, hold_names):
     """Build the pure function for the top block. Returns
-    fn(feeds, persists, key) -> (fetches, updated_persists)."""
-    runner = _BlockRunner(program)
+    fn(feeds, donated, held, key) -> (fetches, donated_out, extra_written).
 
-    def fn(feed_arrays, persist_arrays, base_key):
+    ``donated`` carries the persistable inputs the jit donates (the
+    statically-written ones): their updated values ALWAYS come back,
+    positionally, in ``donated_out``, so XLA aliases each update into its
+    now-dead input buffer — parameters and optimizer state update in place
+    instead of doubling HBM traffic each step. ``held`` carries read-only
+    persistables (never donated, never returned). ``extra_written`` holds
+    persistable writes outside the donated set (vars the run creates that
+    were absent from the scope, or all writes when donation is off)."""
+    runner = _BlockRunner(program)
+    donate_set = frozenset(donate_names)
+
+    def fn(feed_arrays, donated, held, base_key):
         env = {}
-        env.update(dict(zip(feed_names, feed_arrays)))
-        env.update(dict(zip(persist_in, persist_arrays)))
+        env.update(zip(feed_names, feed_arrays))
+        env.update(zip(donate_names, donated))
+        env.update(zip(hold_names, held))
         written_persist = {}
         runner.exec_ops(op_list, env, base_key, written_persist, block=block)
         fetches = [env[n] for n in fetch_names]
-        return fetches, written_persist
+        # env[n] is the var's final value whether or not the op that
+        # writes it ran this trace (grad ops may emit None): a donated
+        # input must always have an output aliased onto it
+        donated_out = [env[n] for n in donate_names]
+        extra = {n: v for n, v in written_persist.items()
+                 if n not in donate_set}
+        return fetches, donated_out, extra
 
     return fn
 
 
 class Executor:
-    """fluid.Executor equivalent. Compiles blocks with jax.jit, caches by
-    (program version, feed signature)."""
+    """fluid.Executor equivalent. Two-level cache: a RunPlan per (program
+    identity, version) holds the one-time op-walk analysis; compiled
+    jax.jit entries are keyed separately by (plan key, fetch/feed/persist
+    signature) so re-feeding new shapes recompiles without re-planning."""
 
     def __init__(self, place: Place | None = None):
         self.place = place or _default_place()
         self._cache = {}
         self._cache_limit = 128  # compiled-block LRU bound
+        self._plans = {}
+        self._plan_cache_limit = 64  # RunPlan LRU bound
+
+    def _plan_for(self, program):
+        """RunPlan cache lookup (LRU, counter-instrumented)."""
+        key = _plan_key(program)
+        plan = self._plans.get(key)
+        if plan is not None:
+            self._plans[key] = self._plans.pop(key)  # refresh LRU order
+            bump_counter("executor::plan_cache_hit")
+            return plan
+        bump_counter("executor::plan_cache_miss")
+        plan = RunPlan(program)
+        self._plans[key] = plan
+        while len(self._plans) > self._plan_cache_limit:
+            self._plans.pop(next(iter(self._plans)))
+        return plan
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True):
@@ -453,60 +693,67 @@ class Executor:
         scope = scope or global_scope()
 
         fetch_names = [v if isinstance(v, str) else v.name for v in fetch_list]
-        block = program.global_block()
-        op_list = block.ops
 
-        # init captured constants
-        for cname, cval in getattr(program, "_constants", {}).items():
-            if not scope.has(cname):
-                scope.set(cname, cval)
+        with RecordEvent("executor::plan"):
+            plan = self._plan_for(program)
+            block = plan.block
 
-        feed_names = sorted(feed.keys())
-        feed_arrays = []
-        for n in feed_names:
-            v = feed[n]
-            arr = v._array if isinstance(v, Tensor) else jnp.asarray(
-                np.asarray(v, dtype=block.var(n).dtype if block.has_var(n) else None))
-            feed_arrays.append(arr)
+            # init captured constants
+            for cname, cval in plan.constants:
+                if not scope.has(cname):
+                    scope.set(cname, cval)
 
-        # persistable inputs: every persistable var referenced by any op
-        # (incl. nested control-flow blocks) & present in scope
-        referenced = {}  # name -> owning block for persistable lookup
-        for blk, op in _walk_ops(program, 0):
-            for names in list(op.inputs.values()) + list(op.outputs.values()):
-                for n in names:
-                    referenced.setdefault(n, blk)
-        persist_in = sorted(
-            n for n, blk in referenced.items()
-            if blk.has_var(n) and blk.var(n).persistable and scope.has(n)
-            and n not in feed_names
-        )
+            feed_names = sorted(feed.keys())
+            feed_arrays = []
+            for n in feed_names:
+                v = feed[n]
+                if isinstance(v, Tensor):
+                    arr = v._array
+                elif isinstance(v, jax.Array):
+                    arr = v  # device-resident feed (prefetch path): as is
+                else:
+                    arr = jnp.asarray(np.asarray(
+                        v,
+                        dtype=block.var(n).dtype if block.has_var(n) else None,
+                    ))
+                feed_arrays.append(arr)
 
-        # rng ids are assigned at build time (op_append.py) so grad ops
-        # share their forward op's id; assign here only for ops that
-        # predate that (e.g. hand-built/deserialized programs)
-        next_id = 1 + max(
-            (op.attrs.get("__rng_id__", -1) for _, op in _walk_ops(program, 0)),
-            default=-1,
-        )
-        for _, op in _walk_ops(program, 0):
-            if op.attrs.get("__rng__") and "__rng_id__" not in op.attrs:
-                op.attrs["__rng_id__"] = next_id
-                next_id += 1
+            # persistable inputs: the plan's candidates filtered by scope
+            # membership — dict lookups only, no op traversal
+            persist_in = tuple(
+                n for n in plan.persist_candidates
+                if n not in feed and scope.has(n)
+            )
 
-        sig = (
-            getattr(program, "_identity_token", id(program)),
-            program._version, tuple(fetch_names), tuple(feed_names),
-            tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
-            tuple(persist_in),
-        )
+            # the donation flag is part of the key: toggling it at runtime
+            # (the documented debugging workflow) must not silently reuse
+            # an entry compiled with the other donation mode
+            donate_enabled = bool(flag("executor_buffer_donation"))
+            sig = (
+                plan.key, tuple(fetch_names), tuple(feed_names),
+                tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
+                persist_in, donate_enabled,
+            )
         entry = self._cache.get(sig)
         first_run = entry is None
         if entry is None:
-            traced = _trace_block(program, block, list(op_list), feed_names,
-                                  fetch_names, persist_in)
-            jitted = jax.jit(traced)
-            entry = (jitted, persist_in)
+            bump_counter("executor::jit_cache_miss")
+            _sync_persistent_cache()
+            # donate the persistables the program statically writes
+            # (params, optimizer state): XLA aliases each update into the
+            # input buffer. Read-only persistables are held undonated.
+            if donate_enabled:
+                donate_names = tuple(
+                    n for n in persist_in if n in plan.written_names)
+            else:
+                donate_names = ()
+            hold_names = tuple(
+                n for n in persist_in if n not in donate_names)
+            traced = _trace_block(program, block, plan.op_list, feed_names,
+                                  fetch_names, donate_names, hold_names)
+            jitted = jax.jit(
+                traced, donate_argnums=(1,) if donate_names else ())
+            entry = (jitted, donate_names, hold_names)
             self._cache[sig] = entry
             # LRU-style eviction: a long-lived Executor fed many program
             # versions (notebooks, unit-test loops) must not grow the
@@ -514,31 +761,76 @@ class Executor:
             while len(self._cache) > self._cache_limit:
                 self._cache.pop(next(iter(self._cache)))
         else:
+            bump_counter("executor::jit_cache_hit")
             self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
-        jitted, persist_in = entry
+        jitted, donate_names, hold_names = entry
 
-        persist_arrays = [scope.get(n) for n in persist_in]
+        donated = [scope.get(n) for n in donate_names]
+        held = [scope.get(n) for n in hold_names]
         base_key = _random.split_key()
         # first run per signature traces + compiles (the per-op events fire
         # inside the trace); later runs are pure dispatch
         phase = "executor::compile_and_run" if first_run else "executor::run"
-        with RecordEvent(phase):
-            fetches, written = jitted(feed_arrays, persist_arrays, base_key)
+        try:
+            with RecordEvent(phase), RecordEvent("executor::dispatch"):
+                fetches, donated_out, extra = jitted(
+                    feed_arrays, donated, held, base_key)
+        except Exception as e:
+            if donate_names:
+                # the donated scope buffers may already be consumed and
+                # cannot be restored; say so instead of letting the next
+                # scope.get surface a bare 'Array has been deleted'
+                note = (
+                    f"run() failed after donating {len(donate_names)} "
+                    "persistable buffer(s); their scope state may be "
+                    "invalidated. Re-run startup/state loading before "
+                    "continuing, or set FLAGS_executor_buffer_donation=0 "
+                    "to debug with donation off."
+                )
+                head = e.args[0] if e.args else ""
+                e.args = (f"{head}\n  {note}",) + tuple(e.args[1:])
+            raise
+        if donate_names:
+            bump_counter("executor::donated_buffers", len(donate_names))
+            # a fetch may share its buffer with a value the scope holds and
+            # donates NEXT run — directly (fetching a written persistable)
+            # or via XLA output aliasing (fetching a no-op transform of
+            # one). Sever every alias so fetch results survive and host
+            # views of them stay stable; training fetches are small
+            # (losses/metrics), so the copies are noise next to the step.
+            fetches = [jnp.copy(f) for f in fetches]
 
-        from ..flags import flag
+        nan_scan = flag("check_nan_inf")
+        if nan_scan and not donate_names:
+            # nothing was donated: scan BEFORE writeback so a NaN abort
+            # preserves the pre-step scope state for inspection (the
+            # historical debugging behavior; with donation the pre-step
+            # buffers are already dead, so writeback must come first)
+            self._scan_nan_inf(program, fetch_names, fetches, extra)
 
-        if flag("check_nan_inf"):
+        with RecordEvent("executor::writeback"):
+            # Scope ownership transfer: the donated inputs are dead after
+            # the call (XLA reused their buffers); the scope now owns the
+            # returned arrays, so no stale reference survives for a later
+            # read.
+            for name, value in zip(donate_names, donated_out):
+                scope.set(name, value)
+            for name, value in extra.items():
+                scope.set(name, value)
+
+        if nan_scan and donate_names:
             # FLAGS_check_nan_inf: post-run scan of everything the block
             # produced, naming the first non-finite variable (the
             # variable-level analog of nan_inf_utils_detail.cc's per-op
             # output scan; the op is identified by its output var name)
-            self._scan_nan_inf(program, fetch_names, fetches, written)
-
-        for name, value in written.items():
-            scope.set(name, value)
+            written_all = dict(zip(donate_names, donated_out))
+            written_all.update(extra)
+            self._scan_nan_inf(program, fetch_names, fetches, written_all)
 
         if return_numpy:
-            return [np.asarray(f) for f in fetches]
+            # lazy: the device->host sync happens at first element access,
+            # so the caller can enqueue the next step first
+            return _LazyFetchList(fetches)
         return [Tensor._from_array(f) for f in fetches]
 
     def train_from_dataset(self, program=None, dataset=None, scope=None,
@@ -550,8 +842,11 @@ class Executor:
         Where the reference hands the whole Dataset to C++ trainer threads
         (MultiTrainer), here the Dataset's parse workers stream fixed-shape
         batches (io/feed.py) and each batch runs through the jitted
-        whole-block step — one compile, N dispatches. Returns the number
-        of batches consumed.
+        whole-block step — one compile, N dispatches. Batches are
+        device-prefetched (DatasetBase._iter_device_batches) so batch
+        N+1's H2D transfer overlaps step N's dispatch, and the lazy
+        fetches only sync at print_period. Returns the number of batches
+        consumed.
         """
         if dataset is None:
             raise ValueError("train_from_dataset needs a dataset")
@@ -565,7 +860,10 @@ class Executor:
         labels = fetch_info or fetch_names
         feed_names = dataset._feed_names()
         n = 0
-        for batch in dataset._iter_batches():
+        batches = (dataset._iter_device_batches()
+                   if hasattr(dataset, "_iter_device_batches")
+                   else dataset._iter_batches())
+        for batch in batches:
             feed = dict(zip(feed_names, batch))
             fetches = self.run(program, feed=feed, fetch_list=fetch_list,
                                scope=scope)

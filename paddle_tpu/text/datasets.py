@@ -399,6 +399,7 @@ class Sentiment(Dataset):
     def __init__(self, data_file=None, mode="train", seed=None):
         self.mode = mode
         self.synthetic = False
+        self._seed = seed
         data_file = data_file or os.path.join(DATA_HOME, "corpora",
                                               "movie_reviews")
         if os.path.isdir(data_file):
@@ -449,7 +450,9 @@ class Sentiment(Dataset):
     def _synthesize(self, mode):
         _warn_synthetic(self)
         self.synthetic = True
-        rng = np.random.RandomState(31)
+        # seed=None keeps the historical fixed corpus (RandomState(31))
+        rng = np.random.RandomState(31 if self._seed is None
+                                    else self._seed)
         docs, labels = [], []
         for i in range(200):  # scaled-down corpus, same structure
             for lab, bank in ((0, _NEG_WORDS), (1, _POS_WORDS)):

@@ -98,7 +98,10 @@ def test_batchnorm_buffers_update_in_jit():
 
     step = pjit.train_step(m, o, loss_fn)
     x, _ = _batch()
-    before = np.asarray(step.state["buffers"]["bn._mean"])
+    # .copy(): np.asarray of a CPU jax array is a zero-copy VIEW, and the
+    # donating step reuses the buffer in place — the snapshot must own its
+    # data (same guard as the unused.weight snapshot below)
+    before = np.asarray(step.state["buffers"]["bn._mean"]).copy()
     step(x)
     after = np.asarray(step.state["buffers"]["bn._mean"])
     assert not np.allclose(before, after)

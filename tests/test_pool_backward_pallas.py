@@ -13,6 +13,29 @@ from jax import lax
 from paddle_tpu.ops.pallas.pool_backward import max_pool2d_backward
 
 
+def test_platform_gate_shared_across_pallas_kernels():
+    """Both pallas dispatch gates consume the ONE shared platform
+    predicate (ops/pallas/_platform.py) so they cannot drift: pool
+    backward admitted ('tpu', 'axon') while flash attention admitted only
+    'tpu' before it was factored out."""
+    import importlib
+
+    from paddle_tpu.ops.pallas import _platform
+    from paddle_tpu.ops.pallas import pool_backward as pb
+
+    # the package re-exports the flash_attention FUNCTION; get the module
+    fa = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+    assert pb.on_tpu_platform is _platform.on_tpu_platform
+    assert fa.on_tpu_platform is _platform.on_tpu_platform
+    assert "axon" in _platform.TPU_PLATFORMS  # remote-TPU plugin included
+    # on the CPU test backend both gates reject the pallas path
+    if jax.devices()[0].platform == "cpu":
+        assert _platform.on_tpu_platform() is False
+        assert pb.max_pool_backward_supported(
+            (2, 3, 8, 8), jnp.float32, (2, 2), (2, 2), (0, 0), (0, 0),
+            "NCHW") is False
+
+
 def _xla_pool_vjp(x, dy, ks, st, p):
     window = (1, 1) + ks
     strides = (1, 1) + st

@@ -316,6 +316,40 @@ def test_ps_ckpt_path_confinement(tmp_path):
         srv2.stop()
 
 
+def test_wire_codec_rejects_oversized_dict_key():
+    """A dict key length claiming more bytes than the message holds must
+    raise, not silently decode a truncated key."""
+    import struct as _s
+
+    from paddle_tpu.distributed.ps.server import _dec_value
+
+    evil = b"d" + _s.pack("<I", 1) + _s.pack("<I", 1 << 30) + b"ab"
+    with pytest.raises(ValueError, match="key exceeds message bounds"):
+        _dec_value(evil, 0)
+
+
+def test_wire_codec_caps_container_nesting():
+    """Deeply nested containers raise ValueError in the decoder, never
+    RecursionError in the connection thread."""
+    import struct as _s
+
+    from paddle_tpu.distributed.ps.server import (_MAX_NESTING, _dec_value,
+                                                  _enc_value)
+
+    evil = b"l" + _s.pack("<I", 1)
+    evil = evil * 10000 + b"N"
+    with pytest.raises(ValueError, match="nesting"):
+        _dec_value(evil, 0)
+
+    # legitimate shallow nesting still decodes
+    ok = ("a", ("b", ("c", {"d": (1, 2)})))
+    out = []
+    _enc_value(ok, out)
+    got, _ = _dec_value(b"".join(out), 0)
+    assert got[1][1][1]["d"] == (1, 2)
+    assert _MAX_NESTING >= 8
+
+
 def test_wire_codec_rejects_negative_dims():
     """A hostile negative array dim must raise, not move the decode
     offset backwards (amplification DoS)."""

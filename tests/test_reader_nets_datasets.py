@@ -257,6 +257,22 @@ def test_sentiment_dataset():
     assert 0.3 < np.mean(labs) < 0.7
 
 
+def test_sentiment_seed_controls_synthesis():
+    """The ``seed`` parameter drives the synthetic corpus RNG: same seed
+    -> identical data, different seed -> different corpus, and the
+    default (seed=None) keeps the historical fixed corpus."""
+    a = paddle.text.Sentiment(mode="train", seed=7)
+    b = paddle.text.Sentiment(mode="train", seed=7)
+    c = paddle.text.Sentiment(mode="train", seed=8)
+    default = paddle.text.Sentiment(mode="train")
+    legacy = paddle.text.Sentiment(mode="train", seed=31)
+
+    np.testing.assert_array_equal(a[0][0], b[0][0])
+    assert any(not np.array_equal(a[i][0], c[i][0]) for i in range(10))
+    for i in range(10):
+        np.testing.assert_array_equal(default[i][0], legacy[i][0])
+
+
 def test_mq2007_formats():
     pw = paddle.text.MQ2007(format="pairwise")
     fi, fj = pw[0]

@@ -134,11 +134,13 @@ def test_gradient_merge_only_updates_every_k():
     m = _make()
     o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
     s = fjit.train_step(m, o, _loss_fn, grad_accum_steps=3)
-    p0 = {n: np.asarray(a) for n, a in s.state["params"].items()}
+    # .copy(): np.asarray of a CPU jax array is a zero-copy VIEW and the
+    # donating step reuses the buffers in place — snapshots must own data
+    p0 = {n: np.asarray(a).copy() for n, a in s.state["params"].items()}
     X, Y = _data(16)
     s(X, Y)
     s(X, Y)
-    p2 = {n: np.asarray(a) for n, a in s.state["params"].items()}
+    p2 = {n: np.asarray(a).copy() for n, a in s.state["params"].items()}
     for n in p0:  # first two calls only accumulate
         np.testing.assert_array_equal(p0[n], p2[n], err_msg=n)
     assert int(s.state["gm"]["count"]) == 2
@@ -300,7 +302,8 @@ def test_localsgd_diverges_then_syncs():
     s = parallel.LocalSGDTrainStep(m, o, _loss_fn, mesh, k_steps=2)
 
     s(X, Y)  # step 1: no sync — replicas diverge (distinct batch shards)
-    w = np.asarray(s.state["params"]["fc1.weight"])
+    # .copy(): the next donating step reuses this buffer (view hazard)
+    w = np.asarray(s.state["params"]["fc1.weight"]).copy()
     assert w.shape[0] == 8
     assert not np.allclose(w[0], w[1])
 

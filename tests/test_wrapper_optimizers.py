@@ -49,6 +49,16 @@ def test_ema_matches_numpy_shadow():
     np.testing.assert_allclose(p.numpy(), raw, rtol=1e-6)
 
 
+def test_ema_apply_before_first_update_keeps_live_params():
+    """At step 0 the shadow is still zero-init: apply() must install the
+    LIVE parameter values (ModelAverage's total==0 behavior), not zeros."""
+    p = _param([5.0, -3.0])
+    ema = opt.ExponentialMovingAverage(parameters=[p], decay=0.9)
+    with ema.apply():
+        np.testing.assert_allclose(p.numpy(), [5.0, -3.0])
+    np.testing.assert_allclose(p.numpy(), [5.0, -3.0])
+
+
 def test_ema_need_restore_false_then_manual_restore():
     p = _param([1.0])
     ema = opt.ExponentialMovingAverage(parameters=[p], decay=0.5)
