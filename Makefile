@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check
+.PHONY: test test-fast test-seq bench check trace-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -37,6 +37,9 @@ test-seq:  # force sequential (timing baselines)
 
 bench:
 	python bench.py
+
+trace-smoke:  # 3-step train under the monitor; both exporters must work
+	JAX_PLATFORMS=cpu python tools/trace_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

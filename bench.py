@@ -237,6 +237,69 @@ def bench_bert(on_tpu, phase=1):
     })
 
 
+def bench_monitor_overhead(iters=300):
+    """Instrumentation overhead on the executor_dispatch micro-bench.
+
+    The whole-stack spans (RecordEvent around plan/feed/dispatch/
+    writeback) ride the dispatch hot path even when nobody profiles —
+    with the profiler DISABLED each span is two perf_counter_ns calls
+    and a no-op end(). This row measures exactly that cost: the same
+    steady-state loop with the spans live vs. with RecordEvent stubbed
+    to a literal no-op, profiler off in both. Target: < 2% overhead
+    (the always-on price of observability must be noise).
+    """
+    import paddle_tpu.static.executor as executor_mod
+
+    class _NullEvent:
+        __slots__ = ("name",)
+
+        def __init__(self, name):
+            self.name = name
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        def begin(self):
+            return self
+
+        def end(self):
+            pass
+
+    real_event = executor_mod.RecordEvent
+    live, stubbed = [], []
+    # alternate modes so slow drift (thermal, competing load) hits both;
+    # compare BEST-of-5 rates: scheduler/GC noise only ever slows a pass,
+    # so the max of each mode is the least-contaminated estimate of its
+    # true dispatch rate (medians of overlapping noisy distributions
+    # routinely fabricate multi-percent "overheads" here)
+    for _ in range(5):
+        live.append(bench_executor_dispatch(iters=iters)["value"])
+        executor_mod.RecordEvent = _NullEvent
+        try:
+            stubbed.append(bench_executor_dispatch(iters=iters)["value"])
+        finally:
+            executor_mod.RecordEvent = real_event
+    live_best = float(max(live))
+    stub_best = float(max(stubbed))
+    # overhead of the live spans relative to the stubbed loop; negative
+    # means the difference drowned in run-to-run noise (good)
+    overhead = (stub_best - live_best) / stub_best
+    return {
+        "metric": "executor_dispatch_instrumentation_overhead",
+        "value": round(overhead * 100, 2),
+        "unit": "percent",
+        "target_pct": 2.0,
+        "within_target": bool(overhead < 0.02),
+        "instrumented_runs_per_sec": live_best,
+        "stubbed_runs_per_sec": stub_best,
+        "best_of": 5,
+        "samples": {"instrumented": live, "stubbed": stubbed},
+    }
+
+
 def bench_executor_dispatch(iters=200):
     """Static-graph Executor steady-state dispatch micro-bench.
 
@@ -302,6 +365,8 @@ def main():
     result["secondary2"] = bench_bert(on_tpu, phase=2)
     # host-side dispatch health: plan-cache hit rate + donation counters
     result["executor_dispatch"] = bench_executor_dispatch()
+    # always-on span cost with the profiler disabled (target < 2%)
+    result["monitor_overhead"] = bench_monitor_overhead()
     print(json.dumps(result))
 
 

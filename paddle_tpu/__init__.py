@@ -205,6 +205,7 @@ from . import device  # noqa: E402  (memory facade: paddle.device surface)
 from . import vision  # noqa: E402
 from . import text  # noqa: E402  (text datasets: imdb/imikolov/wmt/conll05)
 from . import profiler  # noqa: E402
+from . import monitor  # noqa: E402  (metrics registry + training monitor)
 from . import distribution  # noqa: E402
 from . import errors  # noqa: E402  (platform/enforce.h error taxonomy)
 from . import incubate  # noqa: E402  (auto-checkpoint)

@@ -23,6 +23,8 @@ raise a descriptive error under tracing.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 import jax
@@ -30,7 +32,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.tensor import Tensor
+from ..monitor import registry as _mon
 from ..parallel.mesh import get_mesh
+from ..profiler import RecordEvent
 
 __all__ = [
     "ReduceOp", "new_group", "all_reduce", "broadcast", "reduce",
@@ -94,6 +98,57 @@ def _in_trace(arr) -> bool:
     return isinstance(arr, jax.core.Tracer)
 
 
+def _nbytes(arr) -> int:
+    """Payload size of an array or tracer (0 if unknowable)."""
+    try:
+        shape = arr.shape
+        itemsize = np.dtype(arr.dtype).itemsize
+    except Exception:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * itemsize
+
+
+class _account:
+    """Per-primitive byte/latency accounting + host span.
+
+    Every collective call bumps ``collective/<name>/calls`` and
+    ``collective/<name>/bytes`` (input payload size — the comms volume a
+    quantized all-reduce would shrink, the precondition for measuring
+    EQuARX-style wins) and observes ``collective/<name>/latency_ms``.
+    Under tracing the latency is trace-time, so only the call/byte
+    counters are recorded (suffixed ``traced_``: one trace stands for N
+    executions, counting it as live traffic would lie).
+    """
+
+    def __init__(self, name, arr):
+        self.name = name
+        self.traced = _in_trace(arr)
+        self.bytes = _nbytes(arr)
+        self.span = None
+        self.t0 = 0.0
+
+    def __enter__(self):
+        prefix = "traced_" if self.traced else ""
+        _mon.counter(f"collective/{self.name}/{prefix}calls").inc()
+        if self.bytes:
+            _mon.counter(
+                f"collective/{self.name}/{prefix}bytes").inc(self.bytes)
+        if not self.traced:
+            self.span = RecordEvent(f"collective::{self.name}").begin()
+            self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if not self.traced:
+            _mon.histogram(f"collective/{self.name}/latency_ms").observe(
+                (time.perf_counter() - self.t0) * 1e3)
+            self.span.end()
+        return False
+
+
 def _valid_axes(axes):
     """Keep only axes present in the current mesh (size>1 not required)."""
     mesh = get_mesh()
@@ -106,21 +161,22 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In traced code: psum/pmax/pmin/pprod over the group's mesh axes.
     Eager: identity (single-controller holds the global view already)."""
     arr = _unwrap(tensor)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        if axes:
-            if op == ReduceOp.SUM:
-                arr = lax.psum(arr, axes)
-            elif op == ReduceOp.MAX:
-                arr = lax.pmax(arr, axes)
-            elif op == ReduceOp.MIN:
-                arr = lax.pmin(arr, axes)
-            elif op == ReduceOp.PROD:
-                arr = jnp.exp(lax.psum(jnp.log(arr), axes))
-            elif op == ReduceOp.AVG:
-                arr = lax.pmean(arr, axes)
-            else:
-                raise ValueError(f"unknown reduce op {op}")
+    with _account("all_reduce", arr):
+        if _in_trace(arr):
+            axes = _valid_axes(_axes(group))
+            if axes:
+                if op == ReduceOp.SUM:
+                    arr = lax.psum(arr, axes)
+                elif op == ReduceOp.MAX:
+                    arr = lax.pmax(arr, axes)
+                elif op == ReduceOp.MIN:
+                    arr = lax.pmin(arr, axes)
+                elif op == ReduceOp.PROD:
+                    arr = jnp.exp(lax.psum(jnp.log(arr), axes))
+                elif op == ReduceOp.AVG:
+                    arr = lax.pmean(arr, axes)
+                else:
+                    raise ValueError(f"unknown reduce op {op}")
     return _rewrap(arr, tensor)
 
 
@@ -132,14 +188,21 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     """Traced: take the value from index ``src`` along the group axis.
     Eager: identity."""
     arr = _unwrap(tensor)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        for ax in axes:
-            # one-hot select of src's shard, summed to all members
-            idx = lax.axis_index(ax)
-            mask = (idx == src).astype(arr.dtype)
-            arr = lax.psum(arr * mask, ax)
+    with _account("broadcast", arr):
+        if _in_trace(arr):
+            for ax in _valid_axes(_axes(group)):
+                arr = _broadcast_on_axis(arr, src, ax)
     return _rewrap(arr, tensor)
+
+
+def _broadcast_on_axis(arr, src, ax):
+    """Uninstrumented traced broadcast core: one-hot select of src's
+    shard, summed to all members. Shared with scatter so a scatter's
+    payload is accounted once under scatter, never also as a
+    broadcast."""
+    idx = lax.axis_index(ax)
+    mask = (idx == src).astype(arr.dtype)
+    return lax.psum(arr * mask, ax)
 
 
 def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -156,17 +219,18 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
     if tensor is None and not isinstance(tensor_list, list):
         tensor_list, tensor = None, tensor_list
     arr = _unwrap(tensor)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        out = arr
-        for ax in axes:
-            out = lax.all_gather(out, ax)
-            out = out.reshape((-1,) + arr.shape)
-        parts = out
-    else:
-        parts = arr[None]
+    with _account("all_gather", arr):
+        if _in_trace(arr):
+            axes = _valid_axes(_axes(group))
+            out = arr
+            for ax in axes:
+                out = lax.all_gather(out, ax)
+                out = out.reshape((-1,) + arr.shape)
+            parts = out
+        else:
+            parts = arr[None]
     if tensor_list is not None:
-        n = parts.shape[0] if not _in_trace(arr) else parts.shape[0]
+        n = parts.shape[0]
         tensor_list.clear()
         for i in range(n):
             tensor_list.append(
@@ -181,38 +245,42 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_reducescatter equivalent: psum_scatter along the leading dim."""
     arr = _unwrap(tensor)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        for ax in axes:
-            arr = lax.psum_scatter(arr, ax, tiled=True)
+    with _account("reduce_scatter", arr):
+        if _in_trace(arr):
+            axes = _valid_axes(_axes(group))
+            for ax in axes:
+                arr = lax.psum_scatter(arr, ax, tiled=True)
     return _rewrap(arr, tensor) if not isinstance(tensor, Tensor) else Tensor._from_array(arr)
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Traced: each member takes its slice of src's value."""
     arr = _unwrap(tensor)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        for ax in axes:
-            full = broadcast(arr, src=src, group=Group((ax,)))
-            n = get_mesh().shape[ax]
-            idx = lax.axis_index(ax)
-            size = full.shape[0] // n
-            arr = lax.dynamic_slice_in_dim(full, idx * size, size, axis=0)
+    with _account("scatter", arr):
+        if _in_trace(arr):
+            axes = _valid_axes(_axes(group))
+            for ax in axes:
+                full = _broadcast_on_axis(arr, src, ax)
+                n = get_mesh().shape[ax]
+                idx = lax.axis_index(ax)
+                size = full.shape[0] // n
+                arr = lax.dynamic_slice_in_dim(full, idx * size, size,
+                                               axis=0)
     return _rewrap(arr, tensor) if not isinstance(tensor, Tensor) else Tensor._from_array(arr)
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     """All-to-all over the group axis (basis of expert parallelism)."""
     arr = _unwrap(in_tensor_list)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        for ax in axes:
-            n = get_mesh().shape[ax]
-            arr = lax.all_to_all(
-                arr.reshape((n, -1) + arr.shape[1:]),
-                ax, split_axis=0, concat_axis=0, tiled=False,
-            ).reshape((-1,) + arr.shape[1:])
+    with _account("alltoall", arr):
+        if _in_trace(arr):
+            axes = _valid_axes(_axes(group))
+            for ax in axes:
+                n = get_mesh().shape[ax]
+                arr = lax.all_to_all(
+                    arr.reshape((n, -1) + arr.shape[1:]),
+                    ax, split_axis=0, concat_axis=0, tiled=False,
+                ).reshape((-1,) + arr.shape[1:])
     return (
         Tensor._from_array(arr)
         if isinstance(in_tensor_list, Tensor)
@@ -229,11 +297,12 @@ def p2p(tensor, src, dst, group=None):
     program — see parallel.pipeline for the pipeline-parallel use.
     """
     arr = _unwrap(tensor)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        for ax in axes:
-            n = get_mesh().shape[ax]
-            arr = lax.ppermute(arr, ax, [(src % n, dst % n)])
+    with _account("p2p", arr):
+        if _in_trace(arr):
+            axes = _valid_axes(_axes(group))
+            for ax in axes:
+                n = get_mesh().shape[ax]
+                arr = lax.ppermute(arr, ax, [(src % n, dst % n)])
     # never mutate the input: untargeted ranks get zeros, and writing that
     # back would destroy the sender's local copy (paddle.distributed.send
     # leaves the argument intact)
@@ -277,19 +346,21 @@ def shift(tensor, offset=1, group=None):
     """Ring shift (ppermute by offset) — the primitive under ring attention
     and pipeline handoff."""
     arr = _unwrap(tensor)
-    if _in_trace(arr):
-        axes = _valid_axes(_axes(group))
-        for ax in axes:
-            n = get_mesh().shape[ax]
-            perm = [(i, (i + offset) % n) for i in range(n)]
-            arr = lax.ppermute(arr, ax, perm)
+    with _account("shift", arr):
+        if _in_trace(arr):
+            axes = _valid_axes(_axes(group))
+            for ax in axes:
+                n = get_mesh().shape[ax]
+                perm = [(i, (i + offset) % n) for i in range(n)]
+                arr = lax.ppermute(arr, ax, perm)
     return _rewrap(arr, tensor)
 
 
 def barrier(group=None):
     """operators/collective/barrier_op.cc equivalent. Eager single
     controller: block until all pending device work completes."""
-    (jnp.zeros(()) + 0).block_until_ready()
+    with _account("barrier", None):
+        (jnp.zeros(()) + 0).block_until_ready()
 
 
 def wait(tensor, group=None, use_calc_stream=True):
@@ -297,5 +368,6 @@ def wait(tensor, group=None, use_calc_stream=True):
     the value instead."""
     arr = _unwrap(tensor)
     if not _in_trace(arr):
-        jax.block_until_ready(arr)
+        with _account("wait", arr):
+            jax.block_until_ready(arr)
     return tensor

@@ -10,9 +10,12 @@ from __future__ import annotations
 
 import socket
 import threading
+import time
 
 import numpy as np
 
+from ...monitor import registry as _mon
+from ...profiler import RecordEvent
 from .server import _recv_msg, _send_msg
 
 __all__ = ["PSClient", "ShardedTable"]
@@ -31,20 +34,35 @@ class PSClient:
         self._lock = threading.Lock()
 
     def request(self, *msg, timeout="default"):
-        with self._lock:
-            if timeout != "default":
-                self._sock.settimeout(timeout)
-            try:
-                _send_msg(self._sock, msg)
-                reply = _recv_msg(self._sock)
-            finally:
+        # trainer-side RPC accounting: round-trip latency per op (the
+        # whole pull/push cost a trainer pays, wire + serve). The
+        # histogram/error accounting must survive the WIRE failing —
+        # a hung server (socket timeout) or dropped connection is the
+        # production failure these metrics exist to diagnose.
+        op = str(msg[0])
+        t0 = time.perf_counter()
+        try:
+            with RecordEvent(f"ps::rpc::{op}"), self._lock:
                 if timeout != "default":
-                    self._sock.settimeout(self._timeout)
-        if reply is None:
-            raise ConnectionError(f"PS {self.endpoint} closed connection")
-        status, payload = reply
-        if status != "ok":
-            raise RuntimeError(f"PS {self.endpoint}: {payload}")
+                    self._sock.settimeout(timeout)
+                try:
+                    _send_msg(self._sock, msg)
+                    reply = _recv_msg(self._sock)
+                finally:
+                    if timeout != "default":
+                        self._sock.settimeout(self._timeout)
+            if reply is None:
+                raise ConnectionError(
+                    f"PS {self.endpoint} closed connection")
+            status, payload = reply
+            if status != "ok":
+                raise RuntimeError(f"PS {self.endpoint}: {payload}")
+        except Exception:
+            _mon.counter(f"ps/rpc/{op}/errors").inc()
+            raise
+        finally:
+            _mon.histogram(f"ps/rpc/{op}/ms").observe(
+                (time.perf_counter() - t0) * 1e3)
         return payload
 
     def create_table(self, name, dim, init_std=0.01, optimizer="sgd"):

@@ -42,6 +42,9 @@ import time
 
 import numpy as np
 
+from ...monitor import registry as _mon
+from ...profiler import RecordEvent
+
 __all__ = ["TableServer", "serve_forever"]
 
 
@@ -300,6 +303,13 @@ class _Table:
             }
 
 
+# the _handle dispatch set; anything else is metric-bucketed as "unknown"
+_KNOWN_OPS = frozenset((
+    "create_table", "pull", "push_grad", "push_delta", "dump", "barrier",
+    "stats", "save", "load", "shutdown",
+))
+
+
 class TableServer:
     """listen_and_serv_op equivalent: a threaded TCP table service."""
 
@@ -361,12 +371,30 @@ class TableServer:
                 msg = _recv_msg(conn)
                 if msg is None:
                     return
+                # serve/apply accounting: per-op span + latency histogram
+                # + error counter (the server-side half of the trainer's
+                # ps/rpc stats — a slow or erroring table op shows up on
+                # BOTH sides of the wire, or the wire itself is the cost).
+                # A message that is not an (op, ...) tuple still gets the
+                # structured error reply (never a bare connection drop).
+                # Metric names are NEVER taken from the wire verbatim —
+                # unknown/malformed ops share fixed buckets, so a hostile
+                # peer cannot grow the registry unboundedly.
+                op = (str(msg[0]) if isinstance(msg, tuple) and msg
+                      else "malformed")
+                metric_op = op if op in _KNOWN_OPS else (
+                    "malformed" if op == "malformed" else "unknown")
+                t0 = time.perf_counter()
                 try:
-                    reply = self._handle(msg)
+                    with RecordEvent(f"ps::serve::{metric_op}"):
+                        reply = self._handle(msg)
                 except Exception as e:  # structured error back to client
+                    _mon.counter(f"ps/serve/{metric_op}/errors").inc()
                     reply = ("err", f"{type(e).__name__}: {e}")
+                _mon.histogram(f"ps/serve/{metric_op}/ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
                 _send_msg(conn, reply)
-                if msg[0] == "shutdown":
+                if op == "shutdown":
                     return
         finally:
             conn.close()

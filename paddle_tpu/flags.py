@@ -153,6 +153,13 @@ define_flag("executor_buffer_donation", True,
             "donate written persistables to the compiled step (in-place "
             "parameter updates); disable to keep pre-step arrays alive")
 
+# monitor/training_monitor.py — steps between TrainingMonitor periodic
+# log lines (step wall time, examples/sec, input-wait ratio, cache hit
+# rates, HBM watermark). 0 disables the line; aggregation always runs
+# (it is a handful of float adds per step).
+define_flag("monitor_interval", 100,
+            "steps between TrainingMonitor log lines (0: silent)")
+
 # static/executor.py — JAX persistent compilation cache directory: repeated
 # process starts skip XLA recompilation of unchanged programs (the role of
 # TVM's ahead-of-time compiled module artifact). Empty string disables.

@@ -101,11 +101,14 @@ class Tensor:
     def numpy(self):
         return np.asarray(self._array)
 
-    def __array__(self, dtype=None):
+    def __array__(self, dtype=None, copy=None):
         # numpy interop for lazily-fetched tensors (Executor.run
-        # return_numpy=False): np.asarray(t) is the explicit sync point
+        # return_numpy=False): np.asarray(t) is the explicit sync point.
+        # numpy>=2 passes copy= and hard-errors on signatures without it
         a = np.asarray(self._array)
-        return a.astype(dtype, copy=False) if dtype is not None else a
+        if dtype is not None:
+            a = a.astype(dtype, copy=False)
+        return a.copy() if copy else a
 
     def item(self):
         return self._array.item()

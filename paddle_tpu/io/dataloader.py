@@ -19,9 +19,12 @@ import multiprocessing as mp
 import os
 import queue as queue_mod
 import threading
+import time
 
 import numpy as np
 
+from ..monitor import record_input_wait_ms, registry as _mon
+from ..profiler import RecordEvent
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
@@ -150,16 +153,23 @@ class _MultiprocessIter:
         if self._recv >= len(self.batches):
             self.shutdown()
             raise StopIteration
-        while self._recv not in self._reorder:
-            seq, batch, err = self.data_queue.get()
-            if err is not None:
-                self.shutdown()
-                raise err
-            if isinstance(batch, str) and batch in self.rings:
-                # ready-signal: the payload sits in that worker's shm ring
-                rseq, batch = self.rings[batch].get()
-                seq = rseq
-            self._reorder[seq] = batch
+        if self._recv not in self._reorder:
+            # the main process is BLOCKED on workers here — the span/stat
+            # that tells an input-bound run from a compute-bound one
+            with RecordEvent("dataloader::worker_wait"):
+                t0 = time.perf_counter()
+                while self._recv not in self._reorder:
+                    seq, batch, err = self.data_queue.get()
+                    if err is not None:
+                        self.shutdown()
+                        raise err
+                    if isinstance(batch, str) and batch in self.rings:
+                        # ready-signal: payload sits in that worker's ring
+                        rseq, batch = self.rings[batch].get()
+                        seq = rseq
+                    self._reorder[seq] = batch
+                _mon.histogram("io/worker_wait_ms").observe(
+                    (time.perf_counter() - t0) * 1e3)
         batch = self._reorder.pop(self._recv)
         self._recv += 1
         self._dispatch()
@@ -202,24 +212,57 @@ class _DevicePrefetcher:
     def _fill(self):
         while len(self.buf) < self.depth:
             try:
-                batch = next(self.it)
+                with RecordEvent("dataloader::prefetch_fill"):
+                    batch = next(self.it)
             except StopIteration:
                 return
             if self.to_device:
                 import jax
 
-                batch = jax.tree_util.tree_map(jax.device_put, batch)
+                # async enqueue of the H2D copy (the actual transfer
+                # overlaps the consumer's step; the span shows enqueue
+                # stalls when the transfer queue backs up)
+                with RecordEvent("dataloader::h2d"):
+                    batch = jax.tree_util.tree_map(jax.device_put, batch)
             self.buf.append(batch)
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        # consumer-side wall time in here is input wait: the refill after
+        # the pop is where an underrun blocks on upstream parse/collate
+        t0 = time.perf_counter()
         if not self.buf:
             raise StopIteration
         batch = self.buf.pop(0)
         self._fill()
+        _mon.counter("io/batches").inc()
+        record_input_wait_ms((time.perf_counter() - t0) * 1e3)
         return batch
+
+
+class _AccountedIter:
+    """Input-wait accounting for the unbuffered path (the buffered path
+    accounts inside _DevicePrefetcher.__next__). Attribute access
+    proxies to the wrapped iterator so callers still reach the
+    multiprocess machinery (rings, shutdown) underneath."""
+
+    def __init__(self, it):
+        self._it = it
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        t0 = time.perf_counter()
+        batch = next(self._it)
+        _mon.counter("io/batches").inc()
+        record_input_wait_ms((time.perf_counter() - t0) * 1e3)
+        return batch
+
+    def __getattr__(self, name):
+        return getattr(self._it, name)
 
 
 class DataLoader:
@@ -279,4 +322,4 @@ class DataLoader:
                 _DevicePrefetcher(it, depth=self.prefetch_factor,
                                   to_device=True)
             )
-        return it
+        return _AccountedIter(it)

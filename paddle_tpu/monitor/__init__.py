@@ -1,0 +1,65 @@
+"""Run telemetry: metrics registry, whole-stack spans, training monitor.
+
+Reference parity: paddle/fluid/platform/monitor.h (the STAT_INT/
+STAT_FLOAT registry), the profiler's CostInfo summaries, and the device
+tracer's chrome-trace export — unified here on top of
+``paddle_tpu.profiler`` (which owns the RAII spans and the always-on
+dispatch counters from PR 1).
+
+Three layers:
+
+- :mod:`monitor.registry` — counters, gauges, bucketed histograms;
+  STAT_INT/STAT_FLOAT parity helpers; HBM gauges from the PJRT arena
+  counters; jax.monitoring listeners turning XLA compile/retrace events
+  into metrics.
+- :mod:`monitor.training_monitor` — step-level aggregation (wall time,
+  examples/sec, input-wait ratio, executor cache hit rates, HBM
+  watermark) with a periodic log line behind ``FLAGS_monitor_interval``.
+- :mod:`monitor.export` — Prometheus text dump + merged chrome trace
+  (host spans and jax device trace in one JSON); summarize either with
+  ``tools/trace_summary.py``.
+
+The span side is ambient: the executor, DataLoader, collectives, sharded
+train steps, and PS client/server already wrap their hot phases in
+``profiler.RecordEvent`` — enable with ``profiler.start_profiler()``,
+then export the merged picture here.
+"""
+from __future__ import annotations
+
+from .registry import (  # noqa: F401
+    STAT_FLOAT,
+    STAT_INT,
+    Counter,
+    Gauge,
+    Histogram,
+    all_metrics,
+    collect_hbm_gauges,
+    counter,
+    gauge,
+    hbm_watermark_bytes,
+    histogram,
+    install_jax_listeners,
+    registry_snapshot,
+    reset_registry,
+    stat_add,
+    stat_reset,
+)
+from .export import (  # noqa: F401
+    export_merged_chrome_trace,
+    export_prometheus,
+    prometheus_text,
+)
+from .training_monitor import (  # noqa: F401
+    TrainingMonitor,
+    record_input_wait_ms,
+)
+
+__all__ = [
+    "Counter", "Gauge", "Histogram",
+    "counter", "gauge", "histogram",
+    "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
+    "registry_snapshot", "reset_registry", "all_metrics",
+    "collect_hbm_gauges", "hbm_watermark_bytes", "install_jax_listeners",
+    "export_prometheus", "prometheus_text", "export_merged_chrome_trace",
+    "TrainingMonitor", "record_input_wait_ms",
+]

@@ -1,0 +1,165 @@
+"""Telemetry exporters: Prometheus text dump + merged chrome trace.
+
+The reference exposed its StatRegistry through VLOG lines and its
+profiler through a chrome trace built from profiler.proto
+(device_tracer.cc GenProfile); the two never met in one artifact. Here
+both exporters walk the same registry/profiler state:
+
+- :func:`export_prometheus` — text exposition format (the de-facto
+  fleet-metrics wire format) over every registered counter/gauge/
+  histogram plus the profiler's always-on dispatch counters.
+- :func:`export_merged_chrome_trace` — ONE chrome-trace JSON holding the
+  host-side RecordEvent spans and the jax device trace (the
+  ``*.trace.json.gz`` files jax.profiler writes), so host dispatch gaps
+  line up against device kernel occupancy in the same timeline view.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import math
+import os
+import re
+
+from .. import profiler
+from . import registry as _reg
+
+__all__ = ["export_prometheus", "export_merged_chrome_trace",
+           "prometheus_text"]
+
+# ':' is legal in prometheus names but reserved for recording rules by
+# convention — sanitize it away along with '/' and '::'
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    """Sanitize a registry name into a prometheus metric name."""
+    n = _NAME_RE.sub("_", name)
+    if n and n[0].isdigit():
+        n = "_" + n
+    return n
+
+
+def _fmt(v) -> str:
+    # the exposition format defines +Inf/-Inf/NaN literals — a single
+    # inf loss-scale sentinel must not crash every later export
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "NaN"
+        if math.isinf(v):
+            return "+Inf" if v > 0 else "-Inf"
+        if v != int(v):
+            return repr(v)
+    return str(int(v))
+
+
+def prometheus_text() -> str:
+    """Render the registry + profiler counters in the Prometheus text
+    exposition format (one # TYPE line per family)."""
+    lines = []
+    for name, m in _reg.all_metrics().items():
+        pname = _prom_name(name)
+        # one snapshot() = one lock acquisition: buckets/sum/count come
+        # from the same instant, so a concurrent observe() can never
+        # yield a dump where _count disagrees with the +Inf bucket
+        snap = m.snapshot()
+        if snap["kind"] == "histogram":
+            lines.append(f"# TYPE {pname} histogram")
+            acc = 0
+            for le, c in zip(snap["bounds"] + ["+Inf"], snap["buckets"]):
+                acc += c
+                le_s = le if isinstance(le, str) else repr(float(le))
+                lines.append(f'{pname}_bucket{{le="{le_s}"}} {acc}')
+            lines.append(f"{pname}_sum {_fmt(snap['sum'])}")
+            lines.append(f"{pname}_count {snap['count']}")
+        else:
+            lines.append(f"# TYPE {pname} {snap['kind']}")
+            lines.append(f"{pname} {_fmt(snap['value'])}")
+    # the profiler's always-on dispatch counters live outside the
+    # registry (PR 1 predates it); export them under the same roof
+    for name, v in sorted(profiler.counters().items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} counter")
+        lines.append(f"{pname} {_fmt(v)}")
+    return "\n".join(lines) + "\n"
+
+
+def export_prometheus(path=None) -> str:
+    """Write (optional) and return the Prometheus text dump."""
+    text = prometheus_text()
+    if path:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
+    return text
+
+
+def _device_trace_events(trace_dir):
+    """traceEvents from the jax device trace under ``trace_dir``.
+
+    jax.profiler.start_trace writes TensorBoard-layout runs:
+    ``<dir>/plugins/profile/<run>/<host>.trace.json.gz`` — each already a
+    chrome-trace JSON. Collect every run's events; missing/partial files
+    are skipped (the tracer may be unsupported on this backend).
+    """
+    events = []
+    if not trace_dir:
+        return events
+    pattern = os.path.join(trace_dir, "**", "*.trace.json.gz")
+    for fn in sorted(glob.glob(pattern, recursive=True)):
+        try:
+            with gzip.open(fn, "rt") as f:
+                trace = json.load(f)
+        except Exception:
+            continue
+        events.extend(trace.get("traceEvents", []))
+    return events
+
+
+def _align_clock_bases(host, device):
+    """Shift device events onto the host span clock.
+
+    Host spans stamp time.perf_counter_ns (arbitrary monotonic epoch);
+    the XLA profiler stamps its own base — merged raw, the two tracks
+    land as disjoint clusters an enormous offset apart. Both recordings
+    start at (approximately) the same instant — start_profiler() starts
+    the device trace — so anchoring earliest-to-earliest puts host
+    dispatch gaps against device kernel occupancy to within the
+    start_trace call latency. Returns the device events shifted in
+    place; events without a ts (metadata) pass through untouched.
+    """
+    host_ts = [e["ts"] for e in host if "ts" in e]
+    dev_ts = [e["ts"] for e in device if "ts" in e]
+    if not host_ts or not dev_ts:
+        return device
+    offset = min(host_ts) - min(dev_ts)
+    for e in device:
+        if "ts" in e:
+            e["ts"] = e["ts"] + offset
+    return device
+
+
+def export_merged_chrome_trace(path, device_trace_dir=None) -> str:
+    """Write host RecordEvent spans + jax device trace as one
+    chrome://tracing JSON (device clock re-based onto the host track —
+    see _align_clock_bases). ``device_trace_dir`` defaults to the
+    directory of the most recent device trace
+    (profiler.device_trace_dir())."""
+    if device_trace_dir is None:
+        device_trace_dir = profiler.device_trace_dir()
+    host = profiler.host_events()
+    # label the host track so the merged view reads unambiguously
+    events = [{"name": "process_name", "ph": "M", "pid": os.getpid(),
+               "args": {"name": "paddle_tpu host"}}]
+    events.extend(host)
+    events.extend(_align_clock_bases(
+        host, _device_trace_events(device_trace_dir)))
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path

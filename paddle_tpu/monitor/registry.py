@@ -1,0 +1,334 @@
+"""Metrics registry: counters, gauges, bucketed histograms.
+
+Reference parity: paddle/fluid/platform/monitor.h — the STAT_INT /
+STAT_FLOAT registry (DEFINE_INT_STATUS / StatRegistry::Instance) that
+every subsystem bumps and the exporters walk. The reference keys stats
+by string name in a global singleton; so does this module, guarded by
+one lock (stat updates are rare relative to the work they measure).
+
+TPU-native additions the reference's registry never needed:
+- HBM gauges fed from the PJRT arena counters
+  (``jax.local_devices()[i].memory_stats()``) — the reference polled its
+  own allocator, XLA owns ours.
+- jax.monitoring listeners: XLA compile/retrace events arrive as named
+  monitoring events; they land here as counters + duration histograms so
+  a retrace storm is visible in the same dump as everything else.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+
+__all__ = [
+    "Counter", "Gauge", "Histogram",
+    "counter", "gauge", "histogram",
+    "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
+    "registry_snapshot", "reset_registry", "all_metrics",
+    "collect_hbm_gauges", "hbm_watermark_bytes",
+    "install_jax_listeners",
+]
+
+_lock = threading.Lock()
+_metrics: dict[str, "_Metric"] = {}
+
+# default latency-ish buckets (ms): sub-ms to minutes, roughly 4x apart
+DEFAULT_BUCKETS = (0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+                   1000.0, 5000.0, 30000.0)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name, help=""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    """Monotonic counter (STAT_INT's common use: only ever added to)."""
+
+    kind = "counter"
+
+    def __init__(self, name, help=""):
+        super().__init__(name, help)
+        self._value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        return {"kind": self.kind, "value": self.value}
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0
+
+
+class Gauge(_Metric):
+    """Set-to-current-value stat (HBM in use, queue depth, lr)."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help=""):
+        super().__init__(name, help)
+        self._value = 0.0
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def add(self, v):
+        with self._lock:
+            self._value += v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def snapshot(self):
+        return {"kind": self.kind, "value": self.value}
+
+    def _reset(self):
+        with self._lock:
+            self._value = 0.0
+
+
+class Histogram(_Metric):
+    """Cumulative bucketed histogram (prometheus semantics: bucket i
+    counts observations <= bounds[i]; +Inf bucket is implicit)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, buckets=None, help=""):
+        super().__init__(name, help)
+        bounds = tuple(sorted(buckets if buckets is not None
+                              else DEFAULT_BUCKETS))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last = > max bound (+Inf)
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self):
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self):
+        with self._lock:
+            return self._sum
+
+    def bucket_counts(self):
+        """Per-bucket (non-cumulative) counts, +Inf bucket last."""
+        with self._lock:
+            return list(self._counts)
+
+    def cumulative_counts(self):
+        """Prometheus-style cumulative counts per le bound, +Inf last."""
+        out, acc = [], 0
+        with self._lock:
+            for c in self._counts:
+                acc += c
+                out.append(acc)
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return {
+                "kind": self.kind, "sum": self._sum, "count": self._count,
+                "bounds": list(self.bounds), "buckets": list(self._counts),
+            }
+
+    def _reset(self):
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._count = 0
+
+
+def _get(name, cls, **kwargs):
+    with _lock:
+        m = _metrics.get(name)
+        if m is None:
+            m = cls(name, **kwargs)
+            _metrics[name] = m
+        elif not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"requested {cls.kind}")
+        return m
+
+
+def counter(name, help="") -> Counter:
+    """Get-or-create the named counter."""
+    return _get(name, Counter, help=help)
+
+
+def gauge(name, help="") -> Gauge:
+    return _get(name, Gauge, help=help)
+
+
+def histogram(name, buckets=None, help="") -> Histogram:
+    h = _get(name, Histogram, buckets=buckets, help=help)
+    # explicit bounds that disagree with the registered metric must fail
+    # loudly — silently observing into someone else's buckets corrupts
+    # both callers' data (same contract as the kind-collision TypeError)
+    if buckets is not None and tuple(sorted(buckets)) != h.bounds:
+        raise ValueError(
+            f"histogram {name!r} already registered with bounds "
+            f"{h.bounds}, requested {tuple(sorted(buckets))}")
+    return h
+
+
+# -- STAT_INT / STAT_FLOAT parity -------------------------------------------
+# The reference macros (platform/monitor.h DEFINE_INT_STATUS) define a
+# named stat once and bump it anywhere via STAT_ADD/STAT_RESET; both int
+# and float stats are gauges with add semantics here.
+
+def STAT_INT(name) -> Gauge:
+    """DEFINE_INT_STATUS equivalent: named integer stat (gauge w/ add)."""
+    return gauge(f"stat/int/{name}")
+
+
+def STAT_FLOAT(name) -> Gauge:
+    return gauge(f"stat/float/{name}")
+
+
+def stat_add(name, v=1):
+    """STAT_ADD(name, v) — int stat add by name."""
+    STAT_INT(name).add(v)
+
+
+def stat_reset(name):
+    """STAT_RESET(name)."""
+    STAT_INT(name).set(0)
+
+
+def all_metrics() -> dict:
+    """Live metric objects by name (ordered by registration)."""
+    with _lock:
+        return dict(_metrics)
+
+
+def registry_snapshot() -> dict:
+    """Plain-data snapshot of every metric (JSON-safe)."""
+    return {name: m.snapshot() for name, m in all_metrics().items()}
+
+
+def reset_registry(unregister=False):
+    """Zero every metric; ``unregister=True`` also drops the definitions
+    (tests use this so registrations can't leak across files)."""
+    with _lock:
+        if unregister:
+            _metrics.clear()
+            return
+        metrics = list(_metrics.values())
+    for m in metrics:
+        m._reset()
+
+
+# -- HBM gauges --------------------------------------------------------------
+
+_HBM_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_free_block_bytes")
+
+
+def collect_hbm_gauges(devices=None) -> dict:
+    """Populate per-device HBM gauges from PJRT arena counters.
+
+    Sets ``hbm/device<i>/<key>`` gauges for every counter the backend
+    publishes and returns the values set. Backends that publish none
+    (CPU; tunneled TPU proxies) contribute nothing rather than zeros —
+    a zero gauge would read as "no memory in use", which is a lie.
+    ``devices`` is injectable for tests; defaults to jax.local_devices().
+    """
+    if devices is None:
+        import jax
+
+        devices = jax.local_devices()
+    out = {}
+    for i, d in enumerate(devices):
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for key in _HBM_KEYS:
+            if key in stats:
+                name = f"hbm/device{i}/{key}"
+                gauge(name).set(int(stats[key]))
+                out[name] = int(stats[key])
+    return out
+
+
+def hbm_watermark_bytes(devices=None) -> int:
+    """Max peak_bytes_in_use across local devices (0 if unpublished)."""
+    vals = collect_hbm_gauges(devices)
+    peaks = [v for k, v in vals.items() if k.endswith("peak_bytes_in_use")]
+    return max(peaks) if peaks else 0
+
+
+# -- jax.monitoring listeners ------------------------------------------------
+
+_jax_listeners_installed = [False]
+
+
+def install_jax_listeners() -> bool:
+    """Route jax.monitoring events (XLA compile, cache hits, retraces)
+    into the registry: every event bumps ``jax/<event>``; duration events
+    also observe ``jax/<event>/duration_ms``. Idempotent; returns whether
+    the listeners are active (False on a jax without jax.monitoring).
+
+    jax emits keys like ``/jax/core/compile`` — each fresh compile of a
+    jitted function is one event, so a retrace storm (unstable shapes or
+    hash-unstable static args) shows up as this counter racing the step
+    counter.
+    """
+    if _jax_listeners_installed[0]:
+        return True
+    try:
+        from jax import monitoring as jmon
+    except Exception:
+        return False
+
+    def _on_event(event, **kwargs):
+        counter(f"jax/{event.lstrip('/')}").inc()
+
+    def _on_duration(event, duration_secs, **kwargs):
+        counter(f"jax/{event.lstrip('/')}").inc()
+        histogram(f"jax/{event.lstrip('/')}/duration_ms").observe(
+            duration_secs * 1e3)
+
+    # mark installed as soon as the FIRST registration lands: there is no
+    # public unregister, so a retry after a partial failure must never
+    # re-register _on_event (duplicate listeners would double-count every
+    # compile). A jax missing the duration API degrades to counters-only.
+    try:
+        jmon.register_event_listener(_on_event)
+    except Exception:
+        return False
+    _jax_listeners_installed[0] = True
+    try:
+        jmon.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass
+    return True

@@ -1,0 +1,215 @@
+"""Step-level training monitor.
+
+The reference surfaced per-step health through trainer VLOG lines fed by
+the monitor.h stats and the profiler's CostInfo summaries; serving-scale
+tuning (BASELINE.md's roofline work, the Gemma TPU fine-tuning/serving
+recipe) starts from exactly these numbers: where did the step's wall
+time go — compute, input wait, or retrace?
+
+:class:`TrainingMonitor` wraps each step (context manager or
+begin/end pair), aggregates a window of steps, and every
+``FLAGS_monitor_interval`` steps emits one parseable log line:
+
+    [monitor:train] step=300 step_ms=12.41 examples_per_sec=10312.9
+    input_wait_ratio=0.031 plan_cache_hit_rate=1.000
+    jit_cache_hit_rate=1.000 compiles=0 hbm_peak_bytes=123456
+
+Every field also lands in the metrics registry (histograms/gauges), so
+the Prometheus dump and the periodic line can never disagree.
+"""
+from __future__ import annotations
+
+import time
+
+from .. import profiler
+from ..flags import flag
+from . import registry as _reg
+
+__all__ = ["TrainingMonitor", "record_input_wait_ms"]
+
+
+def record_input_wait_ms(ms: float):
+    """Account time a consumer spent blocked waiting on input (called by
+    the DataLoader/prefetcher wait paths); feeds the monitor's
+    input-wait ratio."""
+    _reg.gauge("io/input_wait_ms").add(float(ms))
+
+
+def _cache_rate(hits, misses):
+    total = hits + misses
+    return hits / total if total else 1.0
+
+
+class _StepSpan:
+    def __init__(self, mon, examples):
+        self._mon = mon
+        self._examples = examples
+
+    def __enter__(self):
+        self._mon.step_begin()
+        return self._mon
+
+    def __exit__(self, *exc):
+        if exc[0] is None:
+            self._mon.step_end(examples=self._examples)
+        else:
+            # a failed step must not pollute the aggregates OR leave the
+            # begun-state armed (a stale _t_begin would let a later bare
+            # step_end() "succeed" with a bogus wall time)
+            self._mon.step_abort()
+        return False
+
+
+class TrainingMonitor:
+    """Aggregate per-step wall time, examples/sec, input-wait ratio,
+    executor cache hit rates, compile events, and the HBM watermark.
+
+    Usage::
+
+        mon = monitor.TrainingMonitor("train")
+        for batch in loader:
+            with mon.step(examples=len(batch)):
+                train_step(batch)
+
+    ``interval`` defaults to ``FLAGS_monitor_interval`` read at each
+    step-end (so set_flags takes effect mid-run); 0 silences the line
+    but aggregation continues.
+    """
+
+    def __init__(self, name="train", interval=None, devices=None,
+                 log_fn=None):
+        self.name = name
+        self._interval = interval
+        self._devices = devices
+        self._log_fn = log_fn or print
+        self.step_count = 0
+        self.last_line = None
+        self._step_ms = _reg.histogram(f"monitor/{name}/step_ms")
+        self._examples = _reg.counter(f"monitor/{name}/examples")
+        self._steps = _reg.counter(f"monitor/{name}/steps")
+        # jax compile events (registry-fed by the jax.monitoring
+        # listeners) expose retrace storms in the periodic line
+        _reg.install_jax_listeners()
+        self._t_begin = None
+        self._span = None
+        self._reset_window()
+
+    # -- window bookkeeping -------------------------------------------------
+
+    def _counter_basis(self):
+        c = profiler.counters()
+        return {
+            "plan_hit": c.get("executor::plan_cache_hit", 0),
+            "plan_miss": c.get("executor::plan_cache_miss", 0),
+            "jit_hit": c.get("executor::jit_cache_hit", 0),
+            "jit_miss": c.get("executor::jit_cache_miss", 0),
+            "compiles": self._compile_events(),
+            "input_wait_ms": _reg.gauge("io/input_wait_ms").value,
+        }
+
+    @staticmethod
+    def _compile_events():
+        total = 0
+        for name, m in _reg.all_metrics().items():
+            if name.startswith("jax/") and "compile" in name \
+                    and m.kind == "counter":
+                total += m.value
+        return total
+
+    def _reset_window(self):
+        self._win_t0 = time.perf_counter()
+        self._win_steps = 0
+        self._win_examples = 0
+        self._win_step_ms = 0.0
+        self._win_basis = self._counter_basis()
+
+    # -- step API -----------------------------------------------------------
+
+    def step(self, examples=None):
+        """Context manager wrapping one training step."""
+        return _StepSpan(self, examples)
+
+    def step_begin(self):
+        self._span = profiler.RecordEvent(
+            f"monitor::{self.name}::step").begin()
+        self._t_begin = time.perf_counter()
+        return self
+
+    def step_abort(self):
+        """Discard an in-flight step (the body raised): drop its span,
+        disarm the begin-state, and count it separately."""
+        self._t_begin = None
+        if self._span is not None:
+            self._span = None  # never end()ed: the span is not recorded
+        _reg.counter(f"monitor/{self.name}/aborted_steps").inc()
+
+    def step_end(self, examples=None):
+        """Close the step; returns the log line if this step emitted one
+        (None otherwise)."""
+        if self._t_begin is None:
+            raise RuntimeError("step_end() without step_begin()")
+        dt_ms = (time.perf_counter() - self._t_begin) * 1e3
+        self._t_begin = None
+        if self._span is not None:
+            self._span.end()
+            self._span = None
+        self.step_count += 1
+        self._steps.inc()
+        self._step_ms.observe(dt_ms)
+        self._win_steps += 1
+        self._win_step_ms += dt_ms
+        if examples:
+            self._examples.inc(int(examples))
+            self._win_examples += int(examples)
+        interval = (self._interval if self._interval is not None
+                    else flag("monitor_interval"))
+        if interval and self.step_count % interval == 0:
+            return self._emit()
+        return None
+
+    # -- reporting ----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Current-window aggregates as plain data (the line's fields)."""
+        now = time.perf_counter()
+        wall_s = max(now - self._win_t0, 1e-9)
+        basis = self._win_basis
+        cur = self._counter_basis()
+        input_wait_ms = cur["input_wait_ms"] - basis["input_wait_ms"]
+        steps = self._win_steps
+        return {
+            "step": self.step_count,
+            "step_ms": (self._win_step_ms / steps) if steps else 0.0,
+            "steps_per_sec": steps / wall_s,
+            "examples_per_sec": self._win_examples / wall_s,
+            "input_wait_ratio": min(input_wait_ms / (wall_s * 1e3), 1.0),
+            "plan_cache_hit_rate": _cache_rate(
+                cur["plan_hit"] - basis["plan_hit"],
+                cur["plan_miss"] - basis["plan_miss"]),
+            "jit_cache_hit_rate": _cache_rate(
+                cur["jit_hit"] - basis["jit_hit"],
+                cur["jit_miss"] - basis["jit_miss"]),
+            "compiles": cur["compiles"] - basis["compiles"],
+            "hbm_peak_bytes": _reg.hbm_watermark_bytes(self._devices),
+        }
+
+    def _emit(self):
+        s = self.snapshot()
+        _reg.gauge(f"monitor/{self.name}/examples_per_sec").set(
+            s["examples_per_sec"])
+        _reg.gauge(f"monitor/{self.name}/input_wait_ratio").set(
+            s["input_wait_ratio"])
+        line = (
+            f"[monitor:{self.name}] step={s['step']} "
+            f"step_ms={s['step_ms']:.2f} "
+            f"examples_per_sec={s['examples_per_sec']:.1f} "
+            f"input_wait_ratio={s['input_wait_ratio']:.3f} "
+            f"plan_cache_hit_rate={s['plan_cache_hit_rate']:.3f} "
+            f"jit_cache_hit_rate={s['jit_cache_hit_rate']:.3f} "
+            f"compiles={s['compiles']} "
+            f"hbm_peak_bytes={s['hbm_peak_bytes']}"
+        )
+        self.last_line = line
+        self._log_fn(line)
+        self._reset_window()
+        return line

@@ -32,6 +32,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..framework import jit as fjit
 from ..framework.random import default_generator
 from ..framework.tensor import Tensor
+from ..monitor import registry as _mon
+from ..profiler import RecordEvent
 from .mesh import mesh_scope
 from .sharding import DEFAULT_RULES, shard_batch, shard_state, zero1_shard_opt
 
@@ -211,15 +213,21 @@ class ShardedTrainStep(fjit.TrainStepFn):
         self._rng = default_generator().split()
 
     def __call__(self, *batch):
-        arrs = tuple(
-            b._array if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
-        )
-        with mesh_scope(self.mesh):
-            shardings = shard_batch(arrs, self.mesh, self.batch_axes)
-            arrs = jax.tree_util.tree_map(jax.device_put, arrs, shardings)
+        with RecordEvent("train::step"), mesh_scope(self.mesh):
+            with RecordEvent("train::shard_batch"):  # H2D + layout
+                arrs = tuple(
+                    b._array if isinstance(b, Tensor) else jnp.asarray(b)
+                    for b in batch
+                )
+                shardings = shard_batch(arrs, self.mesh, self.batch_axes)
+                arrs = jax.tree_util.tree_map(
+                    jax.device_put, arrs, shardings)
             lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
             self._rng, sub = jax.random.split(self._rng)
-            self.state, metrics = self.compiled(self.state, arrs, lr, sub)
+            with RecordEvent("train::step_dispatch"):
+                self.state, metrics = self.compiled(
+                    self.state, arrs, lr, sub)
+            _mon.counter("train/sharded_steps").inc()
         return metrics
 
 
@@ -344,17 +352,22 @@ class LocalSGDTrainStep:
         self._rng = default_generator().split()
 
     def __call__(self, *batch):
-        arrs = tuple(
-            b._array if isinstance(b, Tensor) else jnp.asarray(b) for b in batch
-        )
-        with mesh_scope(self.mesh):
-            shardings = shard_batch(arrs, self.mesh, ("dp",))
-            arrs = jax.tree_util.tree_map(jax.device_put, arrs, shardings)
+        with RecordEvent("train::step"), mesh_scope(self.mesh):
+            with RecordEvent("train::shard_batch"):
+                arrs = tuple(
+                    b._array if isinstance(b, Tensor) else jnp.asarray(b)
+                    for b in batch
+                )
+                shardings = shard_batch(arrs, self.mesh, ("dp",))
+                arrs = jax.tree_util.tree_map(
+                    jax.device_put, arrs, shardings)
             lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
             self._rng, sub = jax.random.split(self._rng)
-            self.state, self._count, metrics = self.compiled(
-                self.state, self._count, arrs, lr, sub
-            )
+            with RecordEvent("train::step_dispatch"):
+                self.state, self._count, metrics = self.compiled(
+                    self.state, self._count, arrs, lr, sub
+                )
+            _mon.counter("train/localsgd_steps").inc()
         return metrics
 
     def sync(self, gather=True):

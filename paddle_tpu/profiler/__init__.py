@@ -29,6 +29,8 @@ __all__ = [
     "bump_counter",
     "counters",
     "reset_counters",
+    "device_trace_dir",
+    "host_events",
 ]
 
 _state = threading.local()
@@ -36,6 +38,15 @@ _events = []
 _events_lock = threading.Lock()
 _enabled = [False]
 _device_trace_dir = [None]
+# survives stop_profiler so monitor.export_merged_chrome_trace can find
+# the device-side files the run just wrote
+_last_device_trace_dir = [None]
+
+
+def device_trace_dir():
+    """Directory of the most recent jax device trace (None if the run
+    never started one — e.g. state='CPU' profiling)."""
+    return _last_device_trace_dir[0]
 
 # -- dispatch counters --------------------------------------------------------
 # Always-on monotonic counters (unlike timed events, which only record while
@@ -75,13 +86,22 @@ class RecordEvent:
     def __init__(self, name):
         self.name = name
         self._begin = None
+        self._began_enabled = False
 
     def begin(self):
-        self._begin = _now_us()
+        # capture enabled-state NOW: the span's fate is decided here, so
+        # (a) a span in flight when stop_profiler() lands (the executor's
+        # last dispatch, a dataloader wait) is still recorded — losing
+        # boundary spans silently skews stop-adjacent aggregates — and
+        # (b) the disabled path never touches the clock: spans ride every
+        # dispatch hot path always-on, so the off cost must be a boolean
+        self._began_enabled = _enabled[0]
+        if self._began_enabled:
+            self._begin = _now_us()
         return self
 
     def end(self):
-        if self._begin is None or not _enabled[0]:
+        if not self._began_enabled or self._begin is None:
             return
         ev = {
             "name": self.name,
@@ -121,6 +141,7 @@ def start_profiler(state="All", tracer_option="Default", trace_dir=None):
         try:
             jax.profiler.start_trace(d)
             _device_trace_dir[0] = d
+            _last_device_trace_dir[0] = d
         except Exception:
             _device_trace_dir[0] = None  # already tracing / unsupported
 
@@ -191,9 +212,12 @@ def print_summary(sorted_key="total", file=None):
         return
     grand_total = sum(r["total"] for r in agg.values()) or 1.0
     key = _SORT_KEYS[sorted_key]
+    # "min" sorts ascending (reference profiler.py: the cheapest events
+    # lead); every other key leads with the most expensive/most called
+    ascending = key == "min"
     items = sorted(
         agg.items(), key=(lambda kv: kv[1][key]) if key else (lambda kv: kv[0]),
-        reverse=key is not None,
+        reverse=key is not None and not ascending,
     )
     name_w = max(10, min(50, max(len(n) for n in agg)))
     header = (
@@ -203,7 +227,8 @@ def print_summary(sorted_key="total", file=None):
     bar = "-" * len(header)
     print("\n------------------------->     Profiling Report     "
           "<-------------------------\n", file=file)
-    print(f"Sorted by {sorted_key} in descending order"
+    order = "ascending" if ascending else "descending"
+    print(f"Sorted by {sorted_key} in {order} order"
           if key else "Sorted by event name", file=file)
     print(bar, file=file)
     print(header, file=file)
@@ -228,6 +253,12 @@ def _print_counters(file=None, name_w=40, footer_bar=None):
         print(f"  {name:<{name_w}}  {snap[name]:>10}", file=file)
     if footer_bar:
         print(footer_bar, file=file)
+
+
+def host_events():
+    """Snapshot of the collected host spans (chrome-trace dict events)."""
+    with _events_lock:
+        return list(_events)
 
 
 def reset_profiler():

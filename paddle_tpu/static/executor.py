@@ -22,6 +22,7 @@ construction; lax.while_loop is not (use scan for trainable loops).
 """
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 
 import numpy as np
@@ -72,6 +73,10 @@ def global_scope() -> Scope:
 
 
 _BLOCK_OPS = ("while", "cond", "scan")
+
+# nullcontext is stateless — one shared instance keeps the steady-state
+# dispatch path allocation-free
+_NULL_CTX = contextlib.nullcontext()
 
 
 def _walk_ops(program, block_idx, seen=None):
@@ -135,7 +140,10 @@ class _LazyFetchList(list):
     def _materialize(self, i):
         v = list.__getitem__(self, i)
         if not isinstance(v, np.ndarray):
-            v = np.asarray(v)
+            # the device->host sync the laziness deferred happens HERE —
+            # span it so a trace shows exactly which access paid it
+            with RecordEvent("executor::fetch_sync"):
+                v = np.asarray(v)
             list.__setitem__(self, i, v)
         return v
 
@@ -165,6 +173,12 @@ class _LazyFetchList(list):
 
     def index(self, *a):
         return list.index(self._materialize_all(), *a)
+
+    def remove(self, v):
+        return list.remove(self._materialize_all(), v)
+
+    def __reversed__(self):
+        return list.__reversed__(self._materialize_all())
 
     def count(self, v):
         return list.count(self._materialize_all(), v)
@@ -704,6 +718,8 @@ class Executor:
                     scope.set(cname, cval)
 
             feed_names = sorted(feed.keys())
+
+        with RecordEvent("executor::feed"):  # H2D feed staging
             feed_arrays = []
             for n in feed_names:
                 v = feed[n]
@@ -718,6 +734,7 @@ class Executor:
                     ))
                 feed_arrays.append(arr)
 
+        with RecordEvent("executor::dispatch_prep"):
             # persistable inputs: the plan's candidates filtered by scope
             # membership — dict lookups only, no op traversal
             persist_in = tuple(
@@ -769,10 +786,19 @@ class Executor:
         held = [scope.get(n) for n in hold_names]
         base_key = _random.split_key()
         # first run per signature traces + compiles (the per-op events fire
-        # inside the trace); later runs are pure dispatch
+        # inside the trace); later runs are pure dispatch. The nested
+        # jit_compile span isolates the XLA trace+compile cost from the
+        # steady-state device step in the exported timeline.
         phase = "executor::compile_and_run" if first_run else "executor::run"
+        # the dispatch span is steady-state ONLY: on first_run the same
+        # interval is the jit_compile span, and letting dispatch wrap the
+        # compile would skew its max/ave aggregates by orders of magnitude
+        compile_span = (RecordEvent("executor::jit_compile") if first_run
+                        else _NULL_CTX)
+        dispatch_span = (_NULL_CTX if first_run
+                         else RecordEvent("executor::dispatch"))
         try:
-            with RecordEvent(phase), RecordEvent("executor::dispatch"):
+            with RecordEvent(phase), compile_span, dispatch_span:
                 fetches, donated_out, extra = jitted(
                     feed_arrays, donated, held, base_key)
         except Exception as e:

@@ -49,3 +49,15 @@ def _seed_rng():
     paddle_tpu.seed(2024)
     np.random.seed(2024)
     yield
+
+
+@pytest.fixture(autouse=True)
+def _reset_telemetry():
+    """Telemetry state is process-global (profiler counters, monitor
+    registry): zero it after every test so bump_counter/metric state
+    cannot leak across test files and order-couple assertions."""
+    yield
+    from paddle_tpu import monitor, profiler
+
+    profiler.reset_counters()
+    monitor.reset_registry(unregister=True)

@@ -214,6 +214,9 @@ def test_lazy_fetch_list_c_level_paths_materialize():
     combined = res + [np.zeros(1)]
     assert all(isinstance(a, np.ndarray) for a in combined)
     assert not any(isinstance(a, jax.Array) for a in res.copy())
+    res2 = exe.run(feed={"x": X, "y": Y}, fetch_list=[loss, loss])
+    # reversed() reads backing storage directly — must not leak handles
+    assert all(isinstance(a, np.ndarray) for a in reversed(res2))
 
 
 def test_read_only_persistables_not_donated():

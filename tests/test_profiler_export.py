@@ -1,0 +1,169 @@
+"""Profiler export + summary satellites (ISSUE 2).
+
+Pins: chrome-trace JSON round-trips through json.load; the summary
+table renders with and without events; sorted_key="min" sorts ASCENDING
+(the reference leads with the cheapest events); spans in flight across
+the stop_profiler() boundary are recorded; and the conftest autouse
+fixture really does reset bump_counter state between tests.
+"""
+import io
+import json
+
+import pytest
+
+from paddle_tpu import profiler
+
+
+def _span(name, n=1):
+    for _ in range(n):
+        with profiler.RecordEvent(name):
+            pass
+
+
+def test_chrome_trace_round_trips_json(tmp_path):
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    _span("alpha", 2)
+    _span("beta")
+    path = str(tmp_path / "t.json")
+    profiler.stop_profiler(profile_path=path)
+    trace = json.load(open(path))  # valid JSON by construction
+    evs = trace["traceEvents"]
+    assert [e["name"] for e in evs].count("alpha") == 2
+    for e in evs:
+        assert set(e) == {"name", "ph", "ts", "dur", "pid", "tid"}
+        assert e["ph"] == "X" and e["dur"] >= 0
+    # a second export of the same state is byte-identical modulo load
+    path2 = str(tmp_path / "t2.json")
+    profiler.export_chrome_tracing(path2)
+    assert json.load(open(path2)) == trace
+    profiler.reset_profiler()
+
+
+def test_summary_renders_without_events(capsys):
+    profiler.reset_profiler()
+    profiler.print_summary()
+    out = capsys.readouterr().out
+    assert "No profiler events recorded." in out
+
+
+def test_summary_renders_without_events_but_with_counters(capsys):
+    profiler.reset_profiler()
+    profiler.bump_counter("only::counter", 3)
+    profiler.print_summary()
+    out = capsys.readouterr().out
+    assert "No profiler events recorded." in out
+    assert "only::counter" in out and "3" in out
+
+
+def test_summary_renders_with_events(capsys):
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    _span("ev")
+    profiler.stop_profiler(sorted_key="total")
+    out = capsys.readouterr().out
+    assert "Profiling Report" in out and "ev" in out
+    assert "descending" in out
+    profiler.reset_profiler()
+
+
+def test_summary_min_sorts_ascending(capsys):
+    """sorted_key='min': cheapest events lead (reference semantics)."""
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    with profiler.RecordEvent("slowest"):
+        total = 0
+        for i in range(200000):
+            total += i
+    _span("cheapest")
+    profiler.stop_profiler()
+    recs = profiler.summary_records()
+    assert recs["cheapest"]["min"] < recs["slowest"]["min"]
+    buf = io.StringIO()
+    profiler.print_summary(sorted_key="min", file=buf)
+    out = buf.getvalue()
+    assert "ascending" in out
+    assert out.index("cheapest") < out.index("slowest")
+    # every other key still leads with the most expensive
+    buf2 = io.StringIO()
+    profiler.print_summary(sorted_key="max", file=buf2)
+    out2 = buf2.getvalue()
+    assert "descending" in out2
+    assert out2.index("slowest") < out2.index("cheapest")
+    profiler.reset_profiler()
+
+
+def test_span_straddling_stop_is_recorded():
+    """A span that began while enabled but ends after stop_profiler()
+    must not be silently dropped (enabled-state captured at begin)."""
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    ev = profiler.RecordEvent("straddler").begin()
+    profiler.stop_profiler()
+    ev.end()
+    assert "straddler" in profiler.summary_records()
+    profiler.reset_profiler()
+
+
+def test_span_beginning_while_disabled_is_not_recorded():
+    """Symmetric rule: fate decided at begin() — a span that began
+    disabled stays unrecorded even if the profiler starts before end."""
+    profiler.reset_profiler()
+    ev = profiler.RecordEvent("pre-start").begin()
+    profiler.start_profiler(state="CPU")
+    ev.end()
+    profiler.stop_profiler()
+    assert "pre-start" not in profiler.summary_records()
+    profiler.reset_profiler()
+
+
+def test_bad_sorted_key_raises():
+    with pytest.raises(ValueError):
+        profiler.print_summary(sorted_key="nope")
+
+
+# -- counter isolation (conftest _reset_telemetry) ---------------------------
+# Order matters within this file (pytest runs top to bottom): the first
+# test plants a uniquely-named counter, the second proves the autouse
+# fixture cleared it — bump_counter state cannot leak across tests or
+# test files.
+
+def test_counter_reset_fixture_plant():
+    profiler.bump_counter("leak::canary", 41)
+    assert profiler.counters()["leak::canary"] == 41
+
+
+def test_counter_reset_fixture_observe():
+    assert "leak::canary" not in profiler.counters()
+
+
+# -- trace_summary CLI --------------------------------------------------------
+
+def _load_trace_summary():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "trace_summary.py")
+    spec = importlib.util.spec_from_file_location("trace_summary", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_summary_cli_aggregates_exported_trace(tmp_path, capsys):
+    profiler.reset_profiler()
+    profiler.start_profiler(state="CPU")
+    _span("executor::dispatch", 3)
+    _span("other")
+    path = str(tmp_path / "t.json")
+    profiler.stop_profiler(profile_path=path)
+    ts = _load_trace_summary()
+    assert ts.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "executor::dispatch" in out and "other" in out
+    # --prefix filters; aggregate() counts calls
+    agg = ts.aggregate(ts.load_trace(path), prefix="executor::")
+    assert list(agg) == ["executor::dispatch"]
+    assert agg["executor::dispatch"]["calls"] == 3
+    profiler.reset_profiler()

@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""CI smoke for the observability stack (`make trace-smoke`).
+
+Runs a 3-step static-graph train under the profiler + TrainingMonitor,
+exports BOTH telemetry formats, and asserts:
+- the merged chrome trace is non-empty valid JSON with executor spans,
+- the Prometheus dump renders and contains the step histogram,
+- the monitor emitted its periodic line with every documented field.
+
+Exit 0 on success; any assertion failing the smoke is a real regression
+in the telemetry path, not flake — nothing here depends on timing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu import monitor, ops, profiler
+
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        x = static.data("x", [8, 4], "float32")
+        y = static.data("y", [8, 1], "float32")
+        w = static.nn.create_parameter([4, 1], "float32")
+        loss = ops.mean(ops.square(ops.subtract(ops.matmul(x, w), y)))
+        opt = static.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run_startup()
+
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 4).astype("float32")
+        Y = rng.randn(8, 1).astype("float32")
+
+        profiler.reset_profiler()
+        profiler.start_profiler(state="CPU")
+        mon = monitor.TrainingMonitor("smoke", interval=3)
+        for _ in range(3):
+            with mon.step(examples=8):
+                exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+        profiler.stop_profiler()
+
+        out_dir = tempfile.mkdtemp(prefix="ptpu_trace_smoke_")
+        trace_path = os.path.join(out_dir, "merged_trace.json")
+        prom_path = os.path.join(out_dir, "metrics.prom")
+        monitor.export_merged_chrome_trace(trace_path)
+        monitor.export_prometheus(prom_path)
+
+        with open(trace_path) as f:
+            trace = json.load(f)
+        events = trace["traceEvents"]
+        names = {e.get("name") for e in events}
+        assert events, "merged chrome trace has no events"
+        assert any(str(n).startswith("executor::") for n in names), names
+        assert any(str(n).startswith("monitor::") for n in names), names
+
+        prom = open(prom_path).read()
+        assert "# TYPE" in prom and "monitor_smoke_step_ms_bucket" in prom
+
+        line = mon.last_line
+        assert line and "step=3" in line, line
+        for field in ("step_ms=", "examples_per_sec=", "input_wait_ratio=",
+                      "plan_cache_hit_rate=", "jit_cache_hit_rate=",
+                      "hbm_peak_bytes="):
+            assert field in line, (field, line)
+
+        print(f"trace-smoke OK: {len(events)} trace events, "
+              f"{len(prom.splitlines())} prometheus lines -> {out_dir}")
+        return 0
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Summarize a chrome-trace JSON (paddle_tpu profiler / merged export).
+
+The trace-viewer answers "what happened at t=1.23s"; this answers "where
+did the time go" — the per-event aggregate the reference printed from
+DisableProfiler, but over any exported trace file (host spans, the
+merged host+device export, or a .trace.json.gz straight out of the jax
+profiler run directory).
+
+Usage:
+    python tools/trace_summary.py trace.json
+    python tools/trace_summary.py --sort calls --top 20 trace.json
+    python tools/trace_summary.py --prefix executor:: trace.json
+
+Reads complete-duration events (ph=X); sort keys mirror
+profiler.print_summary (total/calls/max/ave descending, min ascending).
+"""
+from __future__ import annotations
+
+import argparse
+import gzip
+import json
+import sys
+
+
+def load_trace(path):
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        trace = json.load(f)
+    if isinstance(trace, list):  # bare traceEvents array is also legal
+        return trace
+    return trace.get("traceEvents", [])
+
+
+def aggregate(events, prefix=None):
+    agg = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if prefix and not name.startswith(prefix):
+            continue
+        dur_ms = float(ev.get("dur", 0)) / 1e3  # chrome trace us -> ms
+        rec = agg.setdefault(
+            name, {"calls": 0, "total": 0.0, "min": float("inf"),
+                   "max": 0.0})
+        rec["calls"] += 1
+        rec["total"] += dur_ms
+        rec["min"] = min(rec["min"], dur_ms)
+        rec["max"] = max(rec["max"], dur_ms)
+    for rec in agg.values():
+        rec["ave"] = rec["total"] / rec["calls"]
+    return agg
+
+
+def render(agg, sort="total", top=0, file=sys.stdout):
+    if not agg:
+        print("No duration (ph=X) events in trace.", file=file)
+        return
+    ascending = sort == "min"
+    items = sorted(agg.items(), key=lambda kv: kv[1][sort],
+                   reverse=not ascending)
+    if top:
+        items = items[:top]
+    grand = sum(r["total"] for r in agg.values()) or 1.0
+    name_w = max(10, min(60, max(len(n) for n, _ in items)))
+    header = (f"{'Event':<{name_w}}  {'Calls':>8}  {'Total(ms)':>12}  "
+              f"{'Min(ms)':>10}  {'Max(ms)':>10}  {'Ave(ms)':>10}  "
+              f"{'Ratio':>7}")
+    print(header, file=file)
+    print("-" * len(header), file=file)
+    for name, r in items:
+        print(f"{name[:name_w]:<{name_w}}  {r['calls']:>8}  "
+              f"{r['total']:>12.4f}  {r['min']:>10.4f}  {r['max']:>10.4f}  "
+              f"{r['ave']:>10.4f}  {r['total'] / grand:>7.4f}", file=file)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("trace", help="chrome-trace JSON (.json or .json.gz)")
+    p.add_argument("--sort", default="total",
+                   choices=["total", "calls", "min", "max", "ave"])
+    p.add_argument("--top", type=int, default=0,
+                   help="show only the first N rows (0: all)")
+    p.add_argument("--prefix", default=None,
+                   help="only events whose name starts with this "
+                        "(e.g. executor:: / dataloader:: / collective::)")
+    args = p.parse_args(argv)
+    events = load_trace(args.trace)
+    agg = aggregate(events, prefix=args.prefix)
+    render(agg, sort=args.sort, top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
