@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -40,6 +40,9 @@ bench:
 
 trace-smoke:  # 3-step train under the monitor; both exporters must work
 	JAX_PLATFORMS=cpu python tools/trace_smoke.py
+
+debugz-smoke:  # run with the debug server on; curl /healthz + /flightrecorder
+	JAX_PLATFORMS=cpu python tools/debugz_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.tensor import Tensor
+from ..monitor import flight_recorder as _flight
 from ..monitor import registry as _mon
 from ..parallel.mesh import get_mesh
 from ..profiler import RecordEvent
@@ -112,7 +113,7 @@ def _nbytes(arr) -> int:
 
 
 class _account:
-    """Per-primitive byte/latency accounting + host span.
+    """Per-primitive byte/latency accounting + host span + flight record.
 
     Every collective call bumps ``collective/<name>/calls`` and
     ``collective/<name>/bytes`` (input payload size — the comms volume a
@@ -121,12 +122,30 @@ class _account:
     Under tracing the latency is trace-time, so only the call/byte
     counters are recorded (suffixed ``traced_``: one trace stands for N
     executions, counting it as live traffic would lie).
+
+    Each call is also recorded in the flight recorder with the group's
+    next monotonic sequence number and a shape/dtype/reduce-op
+    fingerprint — the per-rank evidence the desync exchange compares
+    when a mismatched collective would otherwise just deadlock dark.
+    A completed (non-traced) call feeds the hang watchdog's progress
+    clock.
     """
 
-    def __init__(self, name, arr):
+    def __init__(self, name, arr, group=None, reduce_op=None):
         self.name = name
         self.traced = _in_trace(arr)
         self.bytes = _nbytes(arr)
+        self.group_name = "+".join(_axes(group))
+        self.reduce_op = reduce_op
+        # wait() is a rank-LOCAL stream sync (c_sync_*_stream compat): a
+        # single rank may legally call it alone, so it must not consume
+        # a cross-rank desync sequence number
+        self.sequenced = name != "wait"
+        try:
+            self.shape = tuple(arr.shape)
+            self.dtype = str(arr.dtype)
+        except Exception:  # barrier (arr None) / non-array payloads
+            self.shape, self.dtype = (), ""
         self.span = None
         self.t0 = 0.0
 
@@ -136,6 +155,10 @@ class _account:
         if self.bytes:
             _mon.counter(
                 f"collective/{self.name}/{prefix}bytes").inc(self.bytes)
+        _flight.record_collective(
+            self.name, self.group_name, shape=self.shape, dtype=self.dtype,
+            reduce_op=self.reduce_op, traced=self.traced, nbytes=self.bytes,
+            sequenced=self.sequenced)
         if not self.traced:
             self.span = RecordEvent(f"collective::{self.name}").begin()
             self.t0 = time.perf_counter()
@@ -146,6 +169,8 @@ class _account:
             _mon.histogram(f"collective/{self.name}/latency_ms").observe(
                 (time.perf_counter() - self.t0) * 1e3)
             self.span.end()
+            if exc[0] is None:
+                _flight.notify_progress(f"collective:{self.name}")
         return False
 
 
@@ -161,7 +186,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In traced code: psum/pmax/pmin/pprod over the group's mesh axes.
     Eager: identity (single-controller holds the global view already)."""
     arr = _unwrap(tensor)
-    with _account("all_reduce", arr):
+    with _account("all_reduce", arr, group, op):
         if _in_trace(arr):
             axes = _valid_axes(_axes(group))
             if axes:
@@ -188,7 +213,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     """Traced: take the value from index ``src`` along the group axis.
     Eager: identity."""
     arr = _unwrap(tensor)
-    with _account("broadcast", arr):
+    with _account("broadcast", arr, group):
         if _in_trace(arr):
             for ax in _valid_axes(_axes(group)):
                 arr = _broadcast_on_axis(arr, src, ax)
@@ -219,7 +244,7 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
     if tensor is None and not isinstance(tensor_list, list):
         tensor_list, tensor = None, tensor_list
     arr = _unwrap(tensor)
-    with _account("all_gather", arr):
+    with _account("all_gather", arr, group):
         if _in_trace(arr):
             axes = _valid_axes(_axes(group))
             out = arr
@@ -245,7 +270,7 @@ def all_gather(tensor_list, tensor=None, group=None, sync_op=True):
 def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """c_reducescatter equivalent: psum_scatter along the leading dim."""
     arr = _unwrap(tensor)
-    with _account("reduce_scatter", arr):
+    with _account("reduce_scatter", arr, group, op):
         if _in_trace(arr):
             axes = _valid_axes(_axes(group))
             for ax in axes:
@@ -256,7 +281,7 @@ def reduce_scatter(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     """Traced: each member takes its slice of src's value."""
     arr = _unwrap(tensor)
-    with _account("scatter", arr):
+    with _account("scatter", arr, group):
         if _in_trace(arr):
             axes = _valid_axes(_axes(group))
             for ax in axes:
@@ -272,7 +297,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
     """All-to-all over the group axis (basis of expert parallelism)."""
     arr = _unwrap(in_tensor_list)
-    with _account("alltoall", arr):
+    with _account("alltoall", arr, group):
         if _in_trace(arr):
             axes = _valid_axes(_axes(group))
             for ax in axes:
@@ -297,7 +322,7 @@ def p2p(tensor, src, dst, group=None):
     program — see parallel.pipeline for the pipeline-parallel use.
     """
     arr = _unwrap(tensor)
-    with _account("p2p", arr):
+    with _account("p2p", arr, group):
         if _in_trace(arr):
             axes = _valid_axes(_axes(group))
             for ax in axes:
@@ -346,7 +371,7 @@ def shift(tensor, offset=1, group=None):
     """Ring shift (ppermute by offset) — the primitive under ring attention
     and pipeline handoff."""
     arr = _unwrap(tensor)
-    with _account("shift", arr):
+    with _account("shift", arr, group):
         if _in_trace(arr):
             axes = _valid_axes(_axes(group))
             for ax in axes:
@@ -359,7 +384,7 @@ def shift(tensor, offset=1, group=None):
 def barrier(group=None):
     """operators/collective/barrier_op.cc equivalent. Eager single
     controller: block until all pending device work completes."""
-    with _account("barrier", None):
+    with _account("barrier", None, group):
         (jnp.zeros(()) + 0).block_until_ready()
 
 
@@ -368,6 +393,6 @@ def wait(tensor, group=None, use_calc_stream=True):
     the value instead."""
     arr = _unwrap(tensor)
     if not _in_trace(arr):
-        with _account("wait", arr):
+        with _account("wait", arr, group):
             jax.block_until_ready(arr)
     return tensor

@@ -95,6 +95,23 @@ def init_parallel_env():
             process_id=env.rank,
         )
     _initialized = True
+    # fault-diagnosis wiring rides the same entry point the reference
+    # hung c_comm_init on: every initialized process records the world it
+    # joined and arms whatever FLAGS ask for (crash/SIGUSR1 dumps always;
+    # hang watchdog behind FLAGS_watchdog_timeout_s; /debugz endpoint
+    # behind FLAGS_debug_port, bound at port+rank)
+    from ..monitor import flight_recorder as _flight
+
+    _flight.record_event("init_parallel_env", rank=env.rank,
+                         world=env.world_size,
+                         coordinator=coordinator or None)
+    try:
+        _flight.install_from_flags()
+    except Exception as e:  # diagnosis must never block training startup
+        import warnings
+
+        warnings.warn(f"fault-diagnosis install failed: "
+                      f"{type(e).__name__}: {e}", RuntimeWarning)
     return env
 
 

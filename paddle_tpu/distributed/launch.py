@@ -10,6 +10,13 @@ and CPU-mesh testing (SURVEY.md §4: subprocess tests on localhost); on a
 real pod each host runs the same script and jax.distributed coordinates.
 
 Usage: python -m paddle_tpu.distributed.launch --nproc 2 train.py
+
+Fault diagnosis: ``--debug-port 8080`` hands every rank a live debug
+endpoint (rank r serves /healthz /metrics /flightrecorder /threadz
+/flagz on 127.0.0.1:8080+r via FLAGS_debug_port), and
+``--watchdog-timeout 300`` arms each rank's hang watchdog
+(FLAGS_watchdog_timeout_s) so a stalled fleet dumps its flight recorder
++ cross-rank desync report instead of hanging silently.
 """
 from __future__ import annotations
 
@@ -40,12 +47,23 @@ def _build_env(rank: int, nproc: int, coordinator: str, base_env=None):
     return env
 
 
-def launch_procs(script_args, nproc: int = 1, env=None):
-    """Spawn nproc copies of `python script args...`; returns Popen list."""
+def launch_procs(script_args, nproc: int = 1, env=None, debug_port=0,
+                 watchdog_timeout=0.0):
+    """Spawn nproc copies of `python script args...`; returns Popen list.
+
+    ``debug_port``/``watchdog_timeout`` wire the fault-diagnosis flags
+    into every rank's environment (rank r's debug server binds
+    ``debug_port + r`` — the +rank offset happens inside
+    monitor.flight_recorder.install_from_flags).
+    """
     coordinator = f"127.0.0.1:{_free_port()}"
     procs = []
     for rank in range(nproc):
         penv = _build_env(rank, nproc, coordinator, env)
+        if debug_port:
+            penv["FLAGS_debug_port"] = str(int(debug_port))
+        if watchdog_timeout:
+            penv["FLAGS_watchdog_timeout_s"] = str(float(watchdog_timeout))
         procs.append(
             subprocess.Popen([sys.executable] + list(script_args), env=penv)
         )
@@ -76,9 +94,16 @@ def main():
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nproc", type=int, default=1)
+    p.add_argument("--debug-port", type=int, default=0,
+                   help="base port for per-rank /debugz endpoints "
+                        "(rank r serves on port+r; 0: off)")
+    p.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   help="per-rank hang-watchdog deadline in seconds "
+                        "(0: off)")
     p.add_argument("script", nargs=argparse.REMAINDER)
     ns = p.parse_args()
-    procs = launch_procs(ns.script, ns.nproc)
+    procs = launch_procs(ns.script, ns.nproc, debug_port=ns.debug_port,
+                         watchdog_timeout=ns.watchdog_timeout)
     code = 0
     for proc in procs:
         code |= proc.wait()

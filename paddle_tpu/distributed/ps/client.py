@@ -14,6 +14,7 @@ import time
 
 import numpy as np
 
+from ...monitor import flight_recorder as _flight
 from ...monitor import registry as _mon
 from ...profiler import RecordEvent
 from .server import _recv_msg, _send_msg
@@ -41,6 +42,11 @@ class PSClient:
         # production failure these metrics exist to diagnose.
         op = str(msg[0])
         t0 = time.perf_counter()
+        # send/recv flight-record pair: a dump taken mid-hang shows which
+        # RPC is in flight to which endpoint (a send with no matching
+        # recv IS the stalled call), and a completed reply feeds the
+        # watchdog's progress clock
+        _flight.record_event("ps_rpc_send", op=op, endpoint=self.endpoint)
         try:
             with RecordEvent(f"ps::rpc::{op}"), self._lock:
                 if timeout != "default":
@@ -57,12 +63,18 @@ class PSClient:
             status, payload = reply
             if status != "ok":
                 raise RuntimeError(f"PS {self.endpoint}: {payload}")
-        except Exception:
+        except Exception as e:
             _mon.counter(f"ps/rpc/{op}/errors").inc()
+            _flight.record_event(
+                "ps_rpc_recv", op=op, endpoint=self.endpoint, ok=False,
+                error=f"{type(e).__name__}: {e}"[:300])
             raise
         finally:
             _mon.histogram(f"ps/rpc/{op}/ms").observe(
                 (time.perf_counter() - t0) * 1e3)
+        _flight.record_event("ps_rpc_recv", op=op, endpoint=self.endpoint,
+                             ok=True)
+        _flight.notify_progress(f"ps_rpc:{op}")
         return payload
 
     def create_table(self, name, dim, init_std=0.01, optimizer="sgd"):
@@ -89,7 +101,26 @@ class PSClient:
     def barrier(self, token, n, timeout=None):
         # a fence legitimately outwaits stragglers (first-step compiles,
         # preemptions) — never bound it by the ordinary RPC timeout
-        return self.request("barrier", token, n, timeout=timeout)
+        try:
+            return self.request("barrier", token, n, timeout=timeout)
+        except Exception as e:
+            # a failed fence is the PS-mode flavor of a collective
+            # desync: dump the flight recorder (with the cross-rank tail
+            # exchange when a side channel exists) so the post-mortem
+            # names what this worker was doing when the fleet diverged
+            _flight.record_event("ps_barrier_failed", token=str(token),
+                                 error=f"{type(e).__name__}: {e}"[:300])
+            try:
+                desync = _flight.exchange_and_diagnose(
+                    tag=f"barrier:{token}")
+            except Exception:
+                desync = None
+            try:
+                _flight.dump_now(reason=f"ps_barrier_failed:{token}",
+                                 desync=desync)
+            except Exception:
+                pass
+            raise
 
     def stats(self):
         return self.request("stats")

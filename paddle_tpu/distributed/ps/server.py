@@ -367,10 +367,33 @@ class TableServer:
 
     def _serve_conn(self, conn):
         try:
+            peer = "%s:%d" % conn.getpeername()[:2]
+        except OSError:
+            peer = "?"
+        # a connection only counts as a protocol peer once it has decoded
+        # one valid message — so a port-scanner's garbage can never abort
+        # a live training fence, but a real worker whose thread dies
+        # mid-session releases everyone it would otherwise strand.
+        # is_barrier_peer additionally marks connections that have joined
+        # at least one fence: a SIGKILLed worker produces a CLEAN EOF
+        # (recv -> None), and if that worker was a fence participant the
+        # waiters must be released on EOF too — but a short-lived stats
+        # probe disconnecting normally must not abort anything.
+        is_protocol_peer = False
+        is_barrier_peer = False
+        try:
             while not self._stop.is_set():
                 msg = _recv_msg(conn)
                 if msg is None:
+                    if is_barrier_peer:
+                        self._fail_pending_barriers(
+                            f"peer {peer} (a fence participant) "
+                            f"disconnected")
                     return
+                is_protocol_peer = True
+                if (isinstance(msg, tuple) and msg
+                        and msg[0] == "barrier"):
+                    is_barrier_peer = True
                 # serve/apply accounting: per-op span + latency histogram
                 # + error counter (the server-side half of the trainer's
                 # ps/rpc stats — a slow or erroring table op shows up on
@@ -396,6 +419,24 @@ class TableServer:
                 _send_msg(conn, reply)
                 if op == "shutdown":
                     return
+        except Exception as e:
+            # the conn thread is dying mid-session (wire/decode error on
+            # recv, or the reply send hit a dead socket); a barrier party
+            # may be parked waiting for THIS peer's next arrival — fail
+            # the fence with a diagnostic naming the dead peer instead of
+            # stranding the waiters until the 600s timeout. Only fence
+            # PARTICIPANTS release fences: a stats probe or scanner dying
+            # (however abnormally) must never abort a live training sync.
+            if is_barrier_peer:
+                self._fail_pending_barriers(
+                    f"peer {peer} connection died "
+                    f"({type(e).__name__}: {e})")
+            from ...monitor import flight_recorder as _flight
+
+            _flight.record_event(
+                "ps_conn_died", peer=peer,
+                protocol_peer=is_protocol_peer,
+                error=f"{type(e).__name__}: {e}"[:300])
         finally:
             conn.close()
 
@@ -503,6 +544,23 @@ class TableServer:
             raise PermissionError(
                 f"checkpoint path {dirname!r} escapes ckpt_root")
         return resolved
+
+    def _fail_pending_barriers(self, reason):
+        """Abort every in-flight fence (a peer's connection thread died:
+        its future arrivals will never come). Parked waiters wake with an
+        error naming the dead peer — the sync-mode guarantee fails loudly
+        instead of stranding the fleet until the timeout."""
+        with self._barrier_lock:
+            for token, ent in list(self._barriers.items()):
+                if ent["state"] != "waiting":
+                    continue
+                ent["state"] = "aborted"
+                ent["error"] = (
+                    f"barrier {token!r} aborted: {reason}; "
+                    f"{ent['count']} part(ies) were waiting on the fence"
+                )
+                self._barriers.pop(token, None)
+                ent["cond"].notify_all()
 
     def _barrier(self, token, n):
         """Named n-party barrier (sync-mode per-step fence).

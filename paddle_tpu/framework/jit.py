@@ -429,10 +429,20 @@ class TrainStepFn:
         try:
             err.throw()
         except Exception as e:  # checkify.JaxRuntimeError
-            raise FatalError(
-                f"check_nan_inf: non-finite value produced inside the "
-                f"train step: {e}"
-            ) from e
+            # FLAGS_check_nan_inf_action, shared policy with the executor
+            # scan (flight_recorder.nan_event_action): warn counts + logs
+            # and keeps training, dump writes the flight-recorder
+            # snapshot before raising, raise is the default
+            from ..monitor import flight_recorder as _flight
+
+            if _flight.nan_event_action(
+                    "train_step",
+                    f"non-finite value produced inside the train step: "
+                    f"{e}") is not None:
+                raise FatalError(
+                    f"check_nan_inf: non-finite value produced inside the "
+                    f"train step: {e}"
+                ) from e
         self.state = new_state
         return metrics
 

@@ -24,6 +24,7 @@ import time
 import numpy as np
 
 from ..monitor import record_input_wait_ms, registry as _mon
+from ..monitor import flight_recorder as _flight
 from ..profiler import RecordEvent
 from .dataset import IterableDataset
 from .sampler import BatchSampler
@@ -133,6 +134,13 @@ class _MultiprocessIter:
         ]
         for w in self.workers:
             w.start()
+        # worker-lifecycle breadcrumb: a dump taken while the main thread
+        # is parked in worker_wait shows exactly which worker pids were
+        # supposed to be feeding it (and whether shm rings were in play)
+        _flight.record_event(
+            "dataloader_workers_start", workers=len(self.workers),
+            pids=[w.pid for w in self.workers],
+            batches=len(self.batches), shm_rings=len(self.rings))
         atexit.register(self.shutdown)
         self._send = 0
         self._recv = 0
@@ -176,6 +184,11 @@ class _MultiprocessIter:
         return batch
 
     def shutdown(self):
+        if self.workers:
+            _flight.record_event(
+                "dataloader_workers_stop", workers=len(self.workers),
+                delivered=getattr(self, "_recv", 0),
+                dispatched=getattr(self, "_send", 0))
         for _ in self.workers:
             try:
                 self.index_queue.put(_MP_STOP)
@@ -313,6 +326,13 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        # one event per epoch: correlates "which epoch / which mode" with
+        # whatever the rest of the ring shows hanging
+        _flight.record_event(
+            "dataloader_epoch",
+            workers=self.num_workers if not self._iterable_mode else 0,
+            iterable=self._iterable_mode,
+            buffered=self.use_buffer_reader)
         if self.num_workers > 0 and not self._iterable_mode:
             it = iter(_MultiprocessIter(self))
         else:

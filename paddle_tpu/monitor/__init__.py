@@ -18,6 +18,15 @@ Three layers:
 - :mod:`monitor.export` — Prometheus text dump + merged chrome trace
   (host spans and jax device trace in one JSON); summarize either with
   ``tools/trace_summary.py``.
+- :mod:`monitor.flight_recorder` — fault diagnosis: ring-buffer flight
+  recorder (executor runs, collectives with per-group sequence numbers
+  and fingerprints, PS RPCs, dataloader lifecycle, flag changes, XLA
+  compiles), hang watchdog (``FLAGS_watchdog_timeout_s``), cross-rank
+  collective desync detection; dumps on crash/SIGUSR1/watchdog trip.
+- :mod:`monitor.debug_server` — live ``/healthz`` ``/metrics``
+  ``/flightrecorder`` ``/threadz`` ``/flagz`` HTTP endpoint behind
+  ``FLAGS_debug_port``; inspect dumps offline with
+  ``tools/debug_dump.py``.
 
 The span side is ambient: the executor, DataLoader, collectives, sharded
 train steps, and PS client/server already wrap their hot phases in
@@ -53,6 +62,19 @@ from .training_monitor import (  # noqa: F401
     TrainingMonitor,
     record_input_wait_ms,
 )
+from . import flight_recorder  # noqa: F401
+from . import debug_server  # noqa: F401
+from .flight_recorder import (  # noqa: F401
+    FlightRecorder,
+    HangWatchdog,
+    dump_now,
+    install_from_flags,
+)
+from .debug_server import (  # noqa: F401
+    DebugServer,
+    start_debug_server,
+    stop_debug_server,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram",
@@ -62,4 +84,7 @@ __all__ = [
     "collect_hbm_gauges", "hbm_watermark_bytes", "install_jax_listeners",
     "export_prometheus", "prometheus_text", "export_merged_chrome_trace",
     "TrainingMonitor", "record_input_wait_ms",
+    "flight_recorder", "debug_server",
+    "FlightRecorder", "HangWatchdog", "dump_now", "install_from_flags",
+    "DebugServer", "start_debug_server", "stop_debug_server",
 ]

@@ -41,6 +41,14 @@ def _prom_name(name: str) -> str:
     return n
 
 
+def _escape_help(s: str) -> str:
+    """Escape a # HELP docstring per the exposition format: backslash
+    and newline are the two characters with wire meaning there — an
+    unescaped newline would split the help text into a garbage sample
+    line that kills the whole scrape."""
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt(v) -> str:
     # the exposition format defines +Inf/-Inf/NaN literals — a single
     # inf loss-scale sentinel must not crash every later export
@@ -56,14 +64,39 @@ def _fmt(v) -> str:
 
 def prometheus_text() -> str:
     """Render the registry + profiler counters in the Prometheus text
-    exposition format (one # TYPE line per family)."""
+    exposition format (one # TYPE line per family, # HELP when the
+    metric carries help text).
+
+    Name-collision safety: ``_prom_name`` is lossy ('/' and ':' both
+    become '_'), so two distinct registry names can sanitize to the same
+    series — emitting both would silently corrupt whichever the scraper
+    keeps. That is an error here, naming both originals.
+    """
     lines = []
+    # sanitized -> source-qualified origin: names are unique within each
+    # source, so ANY repeat claim is a duplicate family — including the
+    # same raw name living in both the registry and the profiler
+    # counters (two '# TYPE x' blocks kill the scrape just as dead as a
+    # sanitization clash)
+    seen: dict[str, str] = {}
+
+    def _claim(pname, origin):
+        prior = seen.get(pname)
+        if prior is not None:
+            raise ValueError(
+                f"prometheus name collision: {origin} and {prior} both "
+                f"emit the series {pname!r}; rename one metric")
+        seen[pname] = origin
+
     for name, m in _reg.all_metrics().items():
         pname = _prom_name(name)
+        _claim(pname, f"registry metric {name!r}")
         # one snapshot() = one lock acquisition: buckets/sum/count come
         # from the same instant, so a concurrent observe() can never
         # yield a dump where _count disagrees with the +Inf bucket
         snap = m.snapshot()
+        if m.help:
+            lines.append(f"# HELP {pname} {_escape_help(m.help)}")
         if snap["kind"] == "histogram":
             lines.append(f"# TYPE {pname} histogram")
             acc = 0
@@ -77,9 +110,11 @@ def prometheus_text() -> str:
             lines.append(f"# TYPE {pname} {snap['kind']}")
             lines.append(f"{pname} {_fmt(snap['value'])}")
     # the profiler's always-on dispatch counters live outside the
-    # registry (PR 1 predates it); export them under the same roof
+    # registry (PR 1 predates it); export them under the same roof —
+    # collisions with registry names are just as fatal for the scraper
     for name, v in sorted(profiler.counters().items()):
         pname = _prom_name(name)
+        _claim(pname, f"profiler counter {name!r}")
         lines.append(f"# TYPE {pname} counter")
         lines.append(f"{pname} {_fmt(v)}")
     return "\n".join(lines) + "\n"

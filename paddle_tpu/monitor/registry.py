@@ -310,13 +310,26 @@ def install_jax_listeners() -> bool:
     except Exception:
         return False
 
+    def _flight_record(event, **fields):
+        # XLA compile events land in the flight recorder too: a dump of a
+        # hung/dying run shows whether a retrace storm preceded the stall
+        # (lazy import: flight_recorder must stay importable first)
+        try:
+            from . import flight_recorder as _flight
+
+            _flight.record_event("xla_event", event=event, **fields)
+        except Exception:
+            pass
+
     def _on_event(event, **kwargs):
         counter(f"jax/{event.lstrip('/')}").inc()
+        _flight_record(event)
 
     def _on_duration(event, duration_secs, **kwargs):
         counter(f"jax/{event.lstrip('/')}").inc()
         histogram(f"jax/{event.lstrip('/')}/duration_ms").observe(
             duration_secs * 1e3)
+        _flight_record(event, duration_ms=round(duration_secs * 1e3, 3))
 
     # mark installed as soon as the FIRST registration lands: there is no
     # public unregister, so a retry after a partial failure must never

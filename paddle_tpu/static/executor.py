@@ -33,6 +33,7 @@ from jax import lax
 
 from ..flags import flag, watch_flag
 from ..framework import random as _random
+from ..monitor import flight_recorder as _flight
 from ..framework.place import Place, _default_place
 from ..framework.tensor import Tensor
 from ..ops.registry import kernel
@@ -685,19 +686,21 @@ class Executor:
         self._plan_cache_limit = 64  # RunPlan LRU bound
 
     def _plan_for(self, program):
-        """RunPlan cache lookup (LRU, counter-instrumented)."""
+        """RunPlan cache lookup (LRU, counter-instrumented). Returns
+        (plan, "hit"|"miss") so run() can put the cache disposition in
+        the flight-recorder event without re-deriving it."""
         key = _plan_key(program)
         plan = self._plans.get(key)
         if plan is not None:
             self._plans[key] = self._plans.pop(key)  # refresh LRU order
             bump_counter("executor::plan_cache_hit")
-            return plan
+            return plan, "hit"
         bump_counter("executor::plan_cache_miss")
         plan = RunPlan(program)
         self._plans[key] = plan
         while len(self._plans) > self._plan_cache_limit:
             self._plans.pop(next(iter(self._plans)))
-        return plan
+        return plan, "miss"
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True):
@@ -709,7 +712,7 @@ class Executor:
         fetch_names = [v if isinstance(v, str) else v.name for v in fetch_list]
 
         with RecordEvent("executor::plan"):
-            plan = self._plan_for(program)
+            plan, plan_disposition = self._plan_for(program)
             block = plan.block
 
             # init captured constants
@@ -782,6 +785,17 @@ class Executor:
             self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
         jitted, donate_names, hold_names = entry
 
+        # flight-recorder breadcrumb: which program ran, and whether the
+        # caches served it — a post-mortem can see a retrace storm (jit
+        # misses racing run counts) or an unexpected re-plan at a glance
+        program_id = f"{plan.key[0]}@v{plan.key[1]}"
+        _flight.record_event(
+            "executor_run_begin", program=program_id,
+            plan_cache=plan_disposition,
+            jit_cache="miss" if first_run else "hit",
+            feeds=len(feed_names), fetches=len(fetch_names),
+            donated=len(donate_names))
+
         donated = [scope.get(n) for n in donate_names]
         held = [scope.get(n) for n in hold_names]
         base_key = _random.split_key()
@@ -802,6 +816,9 @@ class Executor:
                 fetches, donated_out, extra = jitted(
                     feed_arrays, donated, held, base_key)
         except Exception as e:
+            _flight.record_event(
+                "executor_run_error", program=program_id,
+                error=f"{type(e).__name__}: {e}"[:500])
             if donate_names:
                 # the donated scope buffers may already be consumed and
                 # cannot be restored; say so instead of letting the next
@@ -852,6 +869,9 @@ class Executor:
             written_all = dict(zip(donate_names, donated_out))
             written_all.update(extra)
             self._scan_nan_inf(program, fetch_names, fetches, written_all)
+
+        _flight.record_event("executor_run_end", program=program_id, ok=True)
+        _flight.notify_progress("executor_run")
 
         if return_numpy:
             # lazy: the device->host sync happens at first element access,
@@ -931,6 +951,14 @@ class Executor:
         )
         if bad is None:
             return
+        # FLAGS_check_nan_inf_action decides what detection does (raise /
+        # warn-and-continue / dump-then-raise) — shared policy with the
+        # checkify train-step path, see flight_recorder.nan_event_action
+        if _flight.nan_event_action(
+                f"var:{bad}",
+                f"variable {bad!r} contains NaN/Inf after the block ran",
+        ) is None:
+            return  # warn: the run continues
         producer = None
         for _, op in _walk_ops(program, 0):
             if bad in [n for ns in op.outputs.values() for n in ns]:

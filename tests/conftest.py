@@ -61,3 +61,5 @@ def _reset_telemetry():
 
     profiler.reset_counters()
     monitor.reset_registry(unregister=True)
+    monitor.flight_recorder.reset_recorder()
+    monitor.flight_recorder.stop_watchdog()

@@ -1,0 +1,53 @@
+"""2-process collective-desync fixture (PyTorch c10d flight-recorder
+parity scenario): rank 1 deliberately SKIPS one ``all_reduce``, then both
+ranks exchange their per-group (seq, fingerprint) tails over the
+jax.distributed KV side channel and dump a flight-recorder report naming
+the first mismatched call — instead of a real mismatched fleet's silent
+deadlock.
+
+Prints one JSON line: {"rank", "dump", "divergences"}.
+"""
+import json
+import os
+import sys
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    from paddle_tpu.distributed import fleet
+
+    fleet.fleet.init(is_collective=True)  # rendezvous first
+
+    import jax.numpy as jnp
+
+    from paddle_tpu import distributed as dist
+    from paddle_tpu.monitor import flight_recorder as fr
+
+    rank = fleet.fleet.worker_index()
+
+    x = jnp.ones((4,), jnp.float32)
+    # eager collectives: each call lands in the flight recorder with the
+    # group's next monotonic seq + shape/dtype/op fingerprint
+    dist.all_reduce(x)                       # seq 0: both ranks, in sync
+    if rank == 0:
+        dist.all_reduce(x)                   # seq 1: rank 1 SKIPS this one
+    dist.all_gather(None, x)                 # divergence lands at seq 1
+    dist.all_reduce(jnp.zeros((2, 2), jnp.float32))  # life goes on after
+
+    report = fr.exchange_and_diagnose(tag="fixture", timeout_s=60.0)
+    dump_path = fr.dump_now(reason="fixture_desync", desync=report)
+
+    print(json.dumps({
+        "rank": rank,
+        "dump": dump_path,
+        "divergences": report["divergences"] if report else None,
+    }))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -19,7 +19,7 @@ FIXTURE = os.path.join(REPO, "tests", "fixtures", "dist_dp_trainer.py")
 
 
 def _run_world(nproc: int, devices_per_proc: int, timeout=240,
-               fixture=FIXTURE):
+               fixture=FIXTURE, extra_env=None):
     """Launch the fixture in an nproc world; returns list of result dicts."""
     from paddle_tpu.distributed.launch import _build_env, _free_port
 
@@ -31,6 +31,7 @@ def _run_world(nproc: int, devices_per_proc: int, timeout=240,
     )
     base["JAX_ENABLE_X64"] = "true"
     base["PYTHONPATH"] = REPO + os.pathsep + base.get("PYTHONPATH", "")
+    base.update(extra_env or {})
 
     coordinator = f"127.0.0.1:{_free_port()}"
     procs = []
@@ -97,6 +98,44 @@ def test_two_process_collective_ops():
         assert r["allgather"] == [1.0, 2.0, 3.0, 4.0]
         # reduce_scatter of tile(x, n): every shard holds the sum
         assert all(v == want_sum for v in r["reducescatter"])
+
+
+FIXTURE_DESYNC = os.path.join(REPO, "tests", "fixtures", "dist_desync.py")
+
+
+@pytest.mark.slow
+def test_two_process_collective_desync_detection(tmp_path):
+    """Flight-recorder desync detection, c10d-flight-recorder style: a
+    2-process run where rank 1 skips one all_reduce must produce — on
+    BOTH ranks — a dump naming the first diverging collective (its
+    per-group sequence number, primitive, and shape fingerprint) instead
+    of hanging silently."""
+    outs = _run_world(
+        nproc=2, devices_per_proc=1, fixture=FIXTURE_DESYNC,
+        extra_env={"FLAGS_flight_recorder_dump_dir": str(tmp_path)})
+    assert sorted(r["rank"] for r in outs) == [0, 1]
+    for r in outs:
+        divs = r["divergences"]
+        assert divs, f"rank {r['rank']} saw no divergence: {r}"
+        d = divs[0]
+        # the skipped all_reduce was the group's 2nd call → seq 1
+        assert d["group"] == "dp"
+        assert d["seq"] == 1
+        # both the primitive and the shape fingerprint are named per rank
+        assert d["fingerprints"]["0"] == "all_reduce|(4,)|float32|sum"
+        assert d["fingerprints"]["1"].startswith("all_gather|(4,)|")
+        assert "all_reduce" in d["summary"] and "seq 1" in d["summary"]
+        # the dump file on disk carries the same diagnosis + the evidence
+        with open(r["dump"]) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "fixture_desync"
+        assert dump["desync"]["divergences"][0]["seq"] == 1
+        assert dump["desync"]["missing_ranks"] == []
+        tails = dump["collective_tails"]["dp"]
+        assert [s for s, _ in tails] == list(range(len(tails)))
+        assert dump["threads"], "thread stacks missing from the dump"
+        recorded = {e["kind"] for e in dump["events"]}
+        assert "collective" in recorded and "desync_report" in recorded
 
 
 @pytest.mark.slow

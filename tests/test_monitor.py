@@ -429,6 +429,8 @@ def test_prometheus_dump_parseable(tmp_path):
     assert open(path).read() == text
     families = {}
     for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            continue  # free-form docstring (escaped), not a sample line
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split()
             assert kind in ("counter", "gauge", "histogram")

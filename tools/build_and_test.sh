@@ -11,6 +11,7 @@
 #           sharding gives near-linear speedup)
 #   bench - bench.py smoke on the current backend
 #   check - static gates: op coverage + API spec + graft entry self-test
+#           + debugz smoke (debug server endpoints + flight-recorder dump)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -72,6 +73,8 @@ case "$MODE" in
     python tools/check_op_coverage.py --min-pct 90
     python tools/print_signatures.py --check
     JAX_PLATFORMS=cpu python __graft_entry__.py
+    # fault-diagnosis smoke: debug server up, endpoints valid, dump CLI works
+    JAX_PLATFORMS=cpu python tools/debugz_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Pretty-print / filter a flight-recorder dump.
+
+A dump (written on unhandled exception, SIGUSR1, watchdog trip, NaN
+action=dump, or served live at /flightrecorder) is one JSON object; this
+CLI turns it into the post-mortem views you actually read:
+
+    python tools/debug_dump.py dump.json                 # header + events
+    python tools/debug_dump.py dump.json --kind collective --group dp
+    python tools/debug_dump.py dump.json --last 50       # tail only
+    python tools/debug_dump.py dump.json --threads       # stack dump
+    python tools/debug_dump.py dump.json --desync        # divergence report
+    python tools/debug_dump.py dump.json --json          # filtered JSON out
+
+Stdlib-only on purpose: it must run on the box that just crashed.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+import time
+
+
+def _fmt_event(ev, t0):
+    rel = ev.get("t", t0) - t0
+    extras = " ".join(
+        f"{k}={v}" for k, v in ev.items()
+        if k not in ("i", "t", "kind"))
+    return f"  [{ev.get('i', '?'):>6}] +{rel:9.3f}s {ev.get('kind'):<24} {extras}"
+
+
+def _print_header(dump, out):
+    when = time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(dump.get("time", 0)))
+    print(f"flight-recorder dump — reason: {dump.get('reason')!r}",
+          file=out)
+    print(f"  at {when}  pid={dump.get('pid')}  rank={dump.get('rank')}"
+          f"/{dump.get('world')}  uptime={dump.get('uptime_s')}s",
+          file=out)
+    evs = dump.get("events", [])
+    print(f"  events: {len(evs)} in ring (recorded "
+          f"{dump.get('events_recorded', len(evs))}, dropped "
+          f"{dump.get('dropped', 0)})", file=out)
+    by_kind = collections.Counter(e.get("kind") for e in evs)
+    for kind, n in by_kind.most_common():
+        print(f"    {kind:<24} {n}", file=out)
+    tails = dump.get("collective_tails", {})
+    if tails:
+        print("  collective groups:", file=out)
+        for g, t in sorted(tails.items()):
+            last = t[-1] if t else None
+            print(f"    {g:<12} {len(t)} calls in tail, last: {last}",
+                  file=out)
+    desync = dump.get("desync")
+    if desync:
+        divs = desync.get("divergences") or []
+        missing = desync.get("missing_ranks") or []
+        verdict = (f"{len(divs)} diverging group(s)" if divs
+                   else "no divergence found")
+        print(f"  desync exchange (tag {desync.get('tag')!r}): {verdict}"
+              + (f"; ranks never answered: {missing}" if missing else ""),
+              file=out)
+        for d in divs:
+            print(f"    !! {d.get('summary')}", file=out)
+
+
+def _filter_events(dump, ns):
+    evs = dump.get("events", [])
+    if ns.kind:
+        evs = [e for e in evs if e.get("kind") == ns.kind]
+    if ns.group:
+        evs = [e for e in evs if e.get("group") == ns.group]
+    if ns.last:
+        evs = evs[-ns.last:]
+    return evs
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("dump", help="flight-recorder dump JSON file")
+    p.add_argument("--kind", help="only events of this kind "
+                                  "(e.g. collective, executor_run_begin)")
+    p.add_argument("--group", help="only collective events of this group")
+    p.add_argument("--last", type=int, default=0,
+                   help="only the last N (after filtering)")
+    p.add_argument("--threads", action="store_true",
+                   help="print the thread stacks instead of events")
+    p.add_argument("--desync", action="store_true",
+                   help="print the full desync report instead of events")
+    p.add_argument("--json", action="store_true",
+                   help="emit the filtered events as JSON")
+    ns = p.parse_args(argv)
+
+    try:
+        with open(ns.dump) as f:
+            dump = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read dump {ns.dump!r}: {e}", file=sys.stderr)
+        return 2
+
+    out = sys.stdout
+    if ns.threads:
+        for name, frames in sorted(dump.get("threads", {}).items()):
+            print(f"--- thread {name} ---", file=out)
+            for line in frames:
+                print(line, file=out)
+            print(file=out)
+        return 0
+    if ns.desync:
+        json.dump(dump.get("desync"), out, indent=1)
+        print(file=out)
+        return 0
+
+    evs = _filter_events(dump, ns)
+    if ns.json:
+        json.dump(evs, out, indent=1)
+        print(file=out)
+        return 0
+
+    _print_header(dump, out)
+    if ns.kind or ns.group or ns.last:
+        label = " ".join(filter(None, (
+            f"kind={ns.kind}" if ns.kind else "",
+            f"group={ns.group}" if ns.group else "",
+            f"last={ns.last}" if ns.last else "")))
+        print(f"\nevents ({label}):", file=out)
+    else:
+        print("\nevents:", file=out)
+    t0 = dump.get("events", [{}])[0].get("t", dump.get("time", 0)) \
+        if dump.get("events") else dump.get("time", 0)
+    for ev in evs:
+        print(_fmt_event(ev, t0), file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

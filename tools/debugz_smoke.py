@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""CI smoke for the fault-diagnosis stack (`make debugz-smoke`).
+
+Starts a run with the debug server on, drives a few executor steps,
+curls ``/healthz`` + ``/flightrecorder`` (plus /metrics, /threadz,
+/flagz), validates the JSON, then round-trips a dump file through
+``tools/debug_dump.py``. Exit 0 on success; nothing here depends on
+timing — a failure is a real regression in the diagnosis path.
+"""
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+import tempfile
+from contextlib import redirect_stdout
+from urllib.request import urlopen
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import paddle_tpu.static as static
+    from paddle_tpu import ops
+    from paddle_tpu.monitor import debug_server, flight_recorder as fr
+
+    import debug_dump  # tools/ sibling (sys.path[0] is tools/ when run)
+
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    srv = debug_server.DebugServer(port=0).start()
+    try:
+        # -- a tiny live run for the endpoint to look at -------------------
+        x = static.data("x", [8, 4], "float32")
+        w = static.nn.create_parameter([4, 1], "float32")
+        loss = ops.mean(ops.square(ops.matmul(x, w)))
+        opt = static.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run_startup()
+        X = np.random.RandomState(0).randn(8, 4).astype("float32")
+        for _ in range(3):
+            exe.run(feed={"x": X}, fetch_list=[loss])
+
+        # -- curl the endpoints, validate the JSON -------------------------
+        health = json.loads(urlopen(srv.url + "/healthz").read())
+        assert health["ok"] is True, health
+        assert health["pid"] == os.getpid()
+        assert health["flight_recorder"]["events_recorded"] > 0, health
+        assert health["last_progress"] == "executor_run", health
+
+        snap = json.loads(urlopen(srv.url + "/flightrecorder").read())
+        kinds = [e["kind"] for e in snap["events"]]
+        assert kinds.count("executor_run_begin") == 3, kinds
+        assert kinds.count("executor_run_end") == 3, kinds
+        begins = [e for e in snap["events"]
+                  if e["kind"] == "executor_run_begin"]
+        assert begins[0]["jit_cache"] == "miss"
+        assert begins[-1]["jit_cache"] == "hit"
+
+        prom = urlopen(srv.url + "/metrics").read().decode()
+        assert "# TYPE" in prom and "executor__jit_cache_hit" in prom
+
+        threadz = urlopen(srv.url + "/threadz").read().decode()
+        assert "MainThread" in threadz
+
+        flagz = json.loads(urlopen(srv.url + "/flagz").read())
+        assert "watchdog_timeout_s" in flagz and "debug_port" in flagz
+
+        # -- dump file → debug_dump CLI round trip -------------------------
+        out_dir = tempfile.mkdtemp(prefix="ptpu_debugz_smoke_")
+        dump_path = fr.dump_now(reason="debugz_smoke",
+                                path=os.path.join(out_dir, "dump.json"))
+        with open(dump_path) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "debugz_smoke"
+        assert dump["threads"], "dump must carry thread stacks"
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = debug_dump.main([dump_path])
+        assert rc == 0 and "executor_run_begin" in buf.getvalue()
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = debug_dump.main([dump_path, "--kind", "executor_run_end",
+                                  "--json"])
+        assert rc == 0 and all(
+            e["kind"] == "executor_run_end" for e in json.loads(buf.getvalue()))
+
+        print(f"debugz-smoke OK: {len(snap['events'])} recorder events, "
+              f"{len(prom.splitlines())} prometheus lines, "
+              f"debug server on {srv.url} -> {dump_path}")
+        return 0
+    finally:
+        srv.stop()
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
