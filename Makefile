@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -43,6 +43,9 @@ trace-smoke:  # 3-step train under the monitor; both exporters must work
 
 debugz-smoke:  # run with the debug server on; curl /healthz + /flightrecorder
 	JAX_PLATFORMS=cpu python tools/debugz_smoke.py
+
+mfu-smoke:  # cost-model capture + MFU line + /costz /clusterz endpoints
+	JAX_PLATFORMS=cpu python tools/utilization_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..framework.tensor import Tensor
+from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
 from ..monitor import registry as _mon
 from ..parallel.mesh import get_mesh
@@ -41,6 +42,7 @@ __all__ = [
     "ReduceOp", "new_group", "all_reduce", "broadcast", "reduce",
     "all_gather", "reduce_scatter", "scatter", "alltoall", "barrier",
     "send", "recv", "p2p",
+    "per_execution_algo_bytes", "ici_bus_util",
 ]
 
 
@@ -112,6 +114,88 @@ def _nbytes(arr) -> int:
     return n * itemsize
 
 
+def _group_size(group) -> int:
+    """Number of participants the group's mesh axes span (1 when no mesh
+    is active — eager identity collectives move no bytes)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return 1
+    n = 1
+    for ax in _valid_axes(_axes(group)):
+        n *= int(mesh.shape[ax])
+    return n
+
+
+# Per-link wire-traffic factors over the *input payload* B for an
+# n-member group (ring-algorithm accounting, the nccl-tests "bus
+# bandwidth" convention): what actually crosses each ICI link, i.e. the
+# bytes EQuARX-style compressed collectives would shrink. all_gather's
+# input is the local shard, so its wire traffic is (n-1)·B; the
+# reduce-shaped primitives move fractions of their full-array input.
+_ALGO_FACTORS = {
+    "all_reduce": lambda n: 2.0 * (n - 1) / n,
+    "reduce": lambda n: 2.0 * (n - 1) / n,
+    "all_gather": lambda n: float(n - 1),
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "broadcast": lambda n: (n - 1) / n,
+    "scatter": lambda n: (n - 1) / n,
+    "alltoall": lambda n: (n - 1) / n,
+    "p2p": lambda n: 1.0,
+    "shift": lambda n: 1.0,
+}
+
+
+def _algo_bytes(name, nbytes, n) -> int:
+    """Algorithmic per-link wire bytes of one collective call (0 for a
+    lone participant, unknown primitives, or byte-less calls)."""
+    if n <= 1 or not nbytes:
+        return 0
+    factor = _ALGO_FACTORS.get(name)
+    if factor is None:
+        return 0
+    return int(nbytes * factor(n))
+
+
+def per_execution_algo_bytes() -> dict:
+    """Per-primitive algorithmic ICI wire bytes ONE execution of the
+    traced program(s) moves: the ``collective/<prim>/traced_algo_bytes``
+    counters. Each traced call is recorded once at trace time, and the
+    lowered collective runs once per execution of the compiled step — so
+    this is the per-step wire volume (re-traces of the same step would
+    double-count; reset the registry around a trace if that matters)."""
+    out = {}
+    for name, m in _mon.all_metrics().items():
+        if name.startswith("collective/") and \
+                name.endswith("/traced_algo_bytes"):
+            out[name.split("/")[1]] = m.value
+    return out
+
+
+def ici_bus_util(executions_per_s, peaks=None) -> dict:
+    """Per-primitive ICI bus utilization: algorithmic per-execution wire
+    bytes × how often the compiled step runs, over the device's ICI
+    peak (cost_model.device_peaks). The caller supplies the execution
+    rate (the TrainingMonitor's steps/sec); the result lands in
+    ``collective/<prim>/bus_util`` gauges and is returned, ``"total"``
+    included. Eager collectives contribute nothing — in this
+    single-controller runtime they are identity transforms that move no
+    wire bytes, and timing them would fabricate utilization."""
+    peaks = peaks or _cost.device_peaks()
+    ici = peaks.get("ici_bw") or 0
+    out = {}
+    if not ici or not executions_per_s:
+        return out
+    total = 0.0
+    for prim, nbytes in per_execution_algo_bytes().items():
+        util = nbytes * float(executions_per_s) / ici
+        _mon.gauge(f"collective/{prim}/bus_util").set(util)
+        out[prim] = util
+        total += util
+    if out:
+        out["total"] = total
+    return out
+
+
 class _account:
     """Per-primitive byte/latency accounting + host span + flight record.
 
@@ -122,6 +206,16 @@ class _account:
     Under tracing the latency is trace-time, so only the call/byte
     counters are recorded (suffixed ``traced_``: one trace stands for N
     executions, counting it as live traffic would lie).
+
+    Utilization accounting: a TRACED call additionally records its
+    *algorithmic* wire bytes (payload × the primitive's ring factor over
+    the group's mesh size — ``_algo_bytes``) in
+    ``collective/<name>/traced_algo_bytes`` — the per-execution ICI
+    volume of the compiled program, the EQuARX denominator
+    (:func:`ici_bus_util` turns it into bus utilization at a given step
+    rate). Eager calls record NO algo bytes: in this single-controller
+    runtime they are identity transforms — the global view already holds
+    the result — so no wire traffic exists to account.
 
     Each call is also recorded in the flight recorder with the group's
     next monotonic sequence number and a shape/dtype/reduce-op
@@ -135,6 +229,12 @@ class _account:
         self.name = name
         self.traced = _in_trace(arr)
         self.bytes = _nbytes(arr)
+        # wire-volume accounting is trace-time only: the lowered program
+        # moves these bytes once per execution; an eager identity call
+        # moves none (counting it would fabricate traffic)
+        self.algo_bytes = (_algo_bytes(name, self.bytes,
+                                       _group_size(group))
+                           if self.traced else 0)
         self.group_name = "+".join(_axes(group))
         self.reduce_op = reduce_op
         # wait() is a rank-LOCAL stream sync (c_sync_*_stream compat): a
@@ -155,6 +255,10 @@ class _account:
         if self.bytes:
             _mon.counter(
                 f"collective/{self.name}/{prefix}bytes").inc(self.bytes)
+        if self.algo_bytes:
+            _mon.counter(
+                f"collective/{self.name}/{prefix}algo_bytes").inc(
+                self.algo_bytes)
         _flight.record_collective(
             self.name, self.group_name, shape=self.shape, dtype=self.dtype,
             reduce_op=self.reduce_op, traced=self.traced, nbytes=self.bytes,

@@ -214,6 +214,30 @@ define_flag("check_nan_inf_action", "raise",
             "on NaN/Inf detection: raise | warn (count+log, continue) | "
             "dump (flight-recorder snapshot, then raise)")
 
+# monitor/cost_model.py — override the detected device peak-throughput
+# table (the MFU / HBM-bandwidth / roofline denominators) for new
+# silicon, derated SKUs, or meaningful CPU numbers. Comma-separated
+# k=v floats over {flops, hbm_bw, ici_bw} in FLOP/s and B/s, e.g.
+# "flops=2.75e14,hbm_bw=1.228e12,ici_bw=3e11"; any subset overrides.
+define_flag("device_peaks", "",
+            "override device peak throughputs for utilization accounting:"
+            " 'flops=<FLOP/s>,hbm_bw=<B/s>,ici_bw=<B/s>' (any subset)")
+
+# monitor/cluster.py — a rank is flagged as a straggler on /clusterz when
+# its step time exceeds this multiple of the cluster-median step time;
+# the verdict is also recorded into the flight recorder
+define_flag("straggler_threshold", 1.5,
+            "flag a rank as straggler when its step time exceeds this "
+            "multiple of the cluster median (/clusterz)")
+
+# monitor/cluster.py ClusterPublisher — seconds between per-rank metric-
+# snapshot publishes over the jax.distributed KV side channel (feeds
+# rank-0's /clusterz). 0 disables; single-process worlds never publish.
+# Consumed by install_from_flags (init_parallel_env).
+define_flag("cluster_metrics_interval_s", 15.0,
+            "period for publishing per-rank metric snapshots to the "
+            "cluster aggregator (0: disabled)")
+
 # static/executor.py — JAX persistent compilation cache directory: repeated
 # process starts skip XLA recompilation of unchanged programs (the role of
 # TVM's ahead-of-time compiled module artifact). Empty string disables.

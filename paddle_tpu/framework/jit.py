@@ -281,12 +281,20 @@ class TrainStepFn:
             # stay readable until sync()
             self.state = jax.tree_util.tree_map(jnp.copy, self.state)
         self.pure = self._build_pure()
+        self._jit = bool(jit)
         if jit:
             self.compiled = jax.jit(
                 self.pure, donate_argnums=(0,) if donate else ()
             )
         else:
             self.compiled = self.pure
+        # per-batch-signature AOT executables + their cost-model records:
+        # sig -> [Compiled|None, CostRecord|None, attempted] (see
+        # _dispatch — the compile is captured for utilization accounting).
+        # LRU-bounded like the executor's jit cache: variable-shape
+        # batches must not accumulate compiled executables unboundedly.
+        self._exec = {}
+        self._exec_limit = 16
         self._rng = default_generator().split()
 
     def _build_pure(self):
@@ -404,13 +412,68 @@ class TrainStepFn:
             # operation's source location.
             metrics = self._run_checked(batch, lr, sub)
         else:
-            self.state, metrics = self.compiled(self.state, batch, lr, sub)
+            metrics = self._dispatch(batch, lr, sub)
         if flag("benchmark"):
             # FLAGS_benchmark: synchronous dispatch for exact timings
             jax.block_until_ready(metrics)
         # NOTE: LR schedulers keep eager semantics — the user calls
         # scheduler.step() (per epoch or per batch) exactly as in eager mode;
         # the current value is read and fed in as a traced scalar each step.
+        return metrics
+
+    def _dispatch(self, batch, lr, sub):
+        """Run one step, AOT-compiling per batch signature so the
+        compiled module's own cost_analysis()/memory_analysis() feed the
+        utilization accounting (monitor.cost_model) — the same single
+        XLA compile jax.jit's first call would pay, captured instead of
+        hidden. Falls back to the plain jit path on backends without the
+        AOT/analysis surface."""
+        from ..monitor import cost_model as _cost
+
+        if not self._jit:
+            self.state, metrics = self.compiled(self.state, batch, lr, sub)
+            return metrics
+        # params can migrate to frozen (_freeze_unused_params) and the
+        # gradient-merge slot changes the state pytree — both change the
+        # compiled signature, so they key the executable cache alongside
+        # the batch avals
+        sig = (len(self.state["params"]), "gm" in self.state) + tuple(
+            (tuple(b.shape), str(b.dtype)) for b in batch)
+        slot = self._exec.get(sig)
+        if slot is None:
+            slot = self._exec[sig] = [None, None, False]
+            while len(self._exec) > self._exec_limit:
+                self._exec.pop(next(iter(self._exec)))
+        else:
+            self._exec[sig] = self._exec.pop(sig)  # refresh LRU order
+        if not slot[2]:
+            slot[2] = True
+            try:
+                lowered = self.compiled.lower(self.state, batch, lr, sub)
+                slot[0] = lowered.compile()
+                slot[1] = _cost.capture(
+                    "train_step", lowered=lowered, compiled=slot[0],
+                    key=("train_step", id(self), sig))
+            except Exception:
+                slot[0] = None
+        runner = slot[0] if slot[0] is not None else self.compiled
+        try:
+            new_state, metrics = runner(self.state, batch, lr, sub)
+        except Exception:
+            # AOT is stricter than jax.jit (aval drift raises instead of
+            # recompiling): demote and retry — unless donation already
+            # consumed the state buffers, where a retry cannot be safe
+            if runner is self.compiled or any(
+                    getattr(a, "is_deleted", lambda: False)()
+                    for a in jax.tree_util.tree_leaves(self.state)):
+                raise
+            # the record described the pre-drift program — crediting it
+            # against jax.jit's recompile would corrupt the MFU ledger
+            slot[0] = None
+            slot[1] = None
+            new_state, metrics = self.compiled(self.state, batch, lr, sub)
+        self.state = new_state
+        _cost.note_run(slot[1])
         return metrics
 
     def _run_checked(self, batch, lr, sub):

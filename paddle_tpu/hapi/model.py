@@ -190,25 +190,38 @@ class Model:
         else:
             epoch_iter = iter(range(epochs))
 
-        for epoch in epoch_iter:
-            cbks.on_epoch_begin(epoch)
-            for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                xs, ys = _split_batch(batch)
-                logs = self.train_batch(xs, ys)
-                cbks.on_train_batch_end(step, logs)
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(
-                    eval_loader, batch_size=batch_size, verbose=0,
-                    _prepared=True,
-                )
-                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
-                cbks.on_eval_end(eval_logs)
-            cbks.on_epoch_end(epoch, logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(f"{save_dir}/{epoch}")
-            if self.stop_training:
-                break
+        # step-level utilization telemetry: every fit rides the
+        # TrainingMonitor (periodic line behind FLAGS_monitor_interval;
+        # close() flushes the partial window so short fits still report).
+        # verbose=0 keeps the historical silent-stdout contract —
+        # aggregation still runs, only the line is suppressed.
+        from ..monitor import TrainingMonitor
+
+        mon = TrainingMonitor("fit", interval=None if verbose else 0)
+        try:
+            for epoch in epoch_iter:
+                cbks.on_epoch_begin(epoch)
+                for step, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step)
+                    xs, ys = _split_batch(batch)
+                    with mon.step(examples=_batch_examples(xs)):
+                        logs = self.train_batch(xs, ys)
+                    cbks.on_train_batch_end(step, logs)
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(
+                        eval_loader, batch_size=batch_size, verbose=0,
+                        _prepared=True,
+                    )
+                    logs.update(
+                        {f"eval_{k}": v for k, v in eval_logs.items()})
+                    cbks.on_eval_end(eval_logs)
+                cbks.on_epoch_end(epoch, logs)
+                if save_dir and (epoch + 1) % save_freq == 0:
+                    self.save(f"{save_dir}/{epoch}")
+                if self.stop_training:
+                    break
+        finally:
+            mon.close()
         cbks.on_train_end(logs)
         return logs
 
@@ -300,6 +313,20 @@ def _to_tensor(x):
     return Tensor(np.asarray(x))
 
 
+def _batch_examples(xs):
+    """Leading-dim size of the first input (None when unknowable).
+    Reads ``.shape`` metadata only — never np.asarray, which would force
+    a device sync per batch just to label the monitor line."""
+    x = xs[0] if isinstance(xs, (list, tuple)) and xs else xs
+    shape = getattr(x, "shape", None)
+    if shape is None and isinstance(x, (list, tuple)):
+        shape = (len(x),)
+    try:
+        return int(shape[0]) if shape else None
+    except Exception:
+        return None
+
+
 def _split_batch(batch, labeled=True):
     if isinstance(batch, (list, tuple)) and len(batch) >= 2 and labeled:
         return list(batch[:-1]), batch[-1]
@@ -319,8 +346,11 @@ def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
 
 def _layer_cost(layer, args, kwargs):
     """FLOPs + bytes for one layer call via XLA's HLO cost analysis
-    (no backend compile — client-side analysis of the lowered module)."""
+    (no backend compile — client-side analysis of the lowered module;
+    the None/partial-analysis guard lives in monitor.cost_model)."""
     import jax
+
+    from ..monitor import cost_model
 
     state = fjit.capture_state(layer)
 
@@ -330,12 +360,9 @@ def _layer_cost(layer, args, kwargs):
 
     try:
         lowered = jax.jit(pure).lower(state, args)
-        ca = lowered.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
-        return (float(ca.get("flops", 0.0)),
-                float(ca.get("bytes accessed", 0.0)))
     except Exception:
         return None  # non-traceable layer (dynamic control flow, ...)
+    return cost_model.flops_and_bytes(lowered)
 
 
 def summary(net, input_size=None, dtypes=None, cost=False):

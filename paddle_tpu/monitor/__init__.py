@@ -18,6 +18,15 @@ Three layers:
 - :mod:`monitor.export` — Prometheus text dump + merged chrome trace
   (host spans and jax device trace in one JSON); summarize either with
   ``tools/trace_summary.py``.
+- :mod:`monitor.cost_model` — hardware-utilization accounting: XLA
+  ``cost_analysis``/``memory_analysis`` captured per compiled program
+  (executor RunPlan jits, framework/jit train steps), a per-device-kind
+  peak table (``FLAGS_device_peaks`` override), MFU / HBM-bandwidth /
+  roofline math; served on ``/costz``.
+- :mod:`monitor.cluster` — cluster-wide aggregation: per-rank metric
+  snapshots over the jax.distributed KV side channel, rank-0
+  ``/clusterz`` fleet view with straggler verdicts
+  (``FLAGS_straggler_threshold``).
 - :mod:`monitor.flight_recorder` — fault diagnosis: ring-buffer flight
   recorder (executor runs, collectives with per-group sequence numbers
   and fingerprints, PS RPCs, dataloader lifecycle, flag changes, XLA
@@ -54,14 +63,25 @@ from .registry import (  # noqa: F401
     stat_reset,
 )
 from .export import (  # noqa: F401
+    PROMETHEUS_CONTENT_TYPE,
     export_merged_chrome_trace,
     export_prometheus,
     prometheus_text,
 )
+from . import cost_model  # noqa: F401
+from .cost_model import (  # noqa: F401
+    CostRecord,
+    device_peaks,
+    hbm_bw_util,
+    mfu,
+    roofline_class,
+)
 from .training_monitor import (  # noqa: F401
     TrainingMonitor,
+    active_monitor,
     record_input_wait_ms,
 )
+from . import cluster  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import debug_server  # noqa: F401
 from .flight_recorder import (  # noqa: F401
@@ -83,7 +103,10 @@ __all__ = [
     "registry_snapshot", "reset_registry", "all_metrics",
     "collect_hbm_gauges", "hbm_watermark_bytes", "install_jax_listeners",
     "export_prometheus", "prometheus_text", "export_merged_chrome_trace",
-    "TrainingMonitor", "record_input_wait_ms",
+    "PROMETHEUS_CONTENT_TYPE",
+    "TrainingMonitor", "record_input_wait_ms", "active_monitor",
+    "cost_model", "CostRecord", "device_peaks", "mfu", "hbm_bw_util",
+    "roofline_class", "cluster",
     "flight_recorder", "debug_server",
     "FlightRecorder", "HangWatchdog", "dump_now", "install_from_flags",
     "DebugServer", "start_debug_server", "stop_debug_server",

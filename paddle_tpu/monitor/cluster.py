@@ -1,0 +1,270 @@
+"""Cluster-wide metrics aggregation + straggler detection.
+
+PR 2/3 gave every rank its own telemetry (``/metrics``) and its own black
+box (flight recorder) — but each rank's endpoint is an island: diagnosing
+"the fleet is 20% slower" means curling N ports and eyeballing. This
+module makes rank 0 (or any rank) a cluster window:
+
+- every rank periodically **publishes** a compact metric snapshot (step
+  time, MFU, input-wait ratio, HBM watermark — the TrainingMonitor window
+  plus cost-model utilization) over the jax.distributed coordination-
+  service KV store — the same side channel the desync exchange already
+  rides, so a fleet run needs zero extra transport;
+- ``/clusterz`` on the debug server **collects** every rank's latest
+  snapshot and renders the fleet in one JSON: per-rank step time, MFU,
+  input-wait ratio, and a **straggler verdict** — any rank whose step
+  time exceeds ``FLAGS_straggler_threshold`` × the cluster median is
+  flagged, and the verdict is recorded into the flight recorder so a
+  post-mortem dump carries the same evidence the live endpoint showed.
+
+Single-process worlds degrade to a one-row payload built locally (no
+channel needed) — the endpoint renders everywhere.
+"""
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import threading
+import time
+
+from ..flags import flag
+from . import cost_model as _cost
+from . import flight_recorder as _flight
+from . import registry as _reg
+from . import training_monitor as _tm
+
+__all__ = [
+    "local_snapshot", "publish", "collect", "detect_stragglers",
+    "clusterz_payload",
+    "ClusterPublisher", "start_publisher", "stop_publisher", "publisher",
+]
+
+_KEY_PREFIX = "ptpu/cluster/metrics"
+
+
+def local_snapshot() -> dict:
+    """This rank's metric snapshot (the wire payload): the active
+    TrainingMonitor's current window plus identity/uptime. A rank with no
+    monitor (pure-serving process, pre-training warmup) still publishes
+    identity + HBM so the cluster view has no holes."""
+    mon = _tm.active_monitor()
+    snap = mon.snapshot() if mon is not None else {}
+    return {
+        "rank": _flight._safe_rank(),
+        "world": _flight._safe_world(),
+        "pid": os.getpid(),
+        "time": time.time(),
+        "step": int(snap.get("step", 0)),
+        "step_ms": float(snap.get("step_ms", 0.0)),
+        "steps_per_sec": float(snap.get("steps_per_sec", 0.0)),
+        "examples_per_sec": float(snap.get("examples_per_sec", 0.0)),
+        "input_wait_ratio": float(snap.get("input_wait_ratio", 0.0)),
+        "mfu": float(snap.get("mfu", 0.0)),
+        "hbm_bw_util": float(snap.get("hbm_bw_util", 0.0)),
+        "roofline": snap.get("roofline", "unknown"),
+        "compiles": int(snap.get("compiles", 0)),
+        # don't sweep device memory_stats twice: the monitor snapshot
+        # already paid for the watermark when one is active
+        "hbm_peak_bytes": int(
+            snap["hbm_peak_bytes"] if "hbm_peak_bytes" in snap
+            else _reg.hbm_watermark_bytes()),
+    }
+
+
+def publish(channel=None, rank=None, snapshot=None) -> bool:
+    """Publish this rank's snapshot under a stable per-rank key
+    (overwrite semantics: collectors always read the latest). Returns
+    whether a channel existed to publish on — single-process/eager runs
+    stay harmless no-ops."""
+    channel = channel or _flight._default_channel()
+    if channel is None:
+        return False
+    if rank is None:
+        rank = _flight._safe_rank()
+    snap = snapshot if snapshot is not None else local_snapshot()
+    try:
+        channel.set(f"{_KEY_PREFIX}/{rank}", json.dumps(snap))
+    except Exception as e:
+        _flight.record_event("cluster_publish_failed",
+                             error=f"{type(e).__name__}: {e}"[:200])
+        return False
+    return True
+
+
+def collect(world=None, timeout_s=5.0, channel=None):
+    """Every rank's latest published snapshot: ``(by_rank, missing)``.
+
+    Same sweep discipline as the desync exchange: ONE shared deadline, a
+    quick short-slice pass first so a dead low rank cannot starve reads
+    of higher ranks whose snapshots are already published, then the
+    remaining budget split across stragglers. A rank that never published
+    lands in ``missing`` — absence is evidence, not an error.
+
+    A world of 1 (or no side channel) returns the local snapshot only:
+    the cluster view of a single-process run is that process.
+    """
+    if world is None:
+        world = _flight._safe_world()
+    rank = _flight._safe_rank()
+    if world <= 1:
+        return {rank: local_snapshot()}, []
+    channel = channel or _flight._default_channel()
+    if channel is None:
+        return {rank: local_snapshot()}, sorted(
+            set(range(world)) - {rank})
+    by_rank = {}
+    deadline = time.monotonic() + float(timeout_s)
+
+    def _try_get(r, budget_s):
+        try:
+            raw = channel.get(f"{_KEY_PREFIX}/{r}", max(budget_s, 0.001))
+            if isinstance(raw, bytes):
+                raw = raw.decode("utf-8")
+            by_rank[r] = json.loads(raw)
+            return True
+        except Exception:
+            return False
+
+    stragglers = [r for r in range(world)
+                  if not _try_get(r, min(0.25,
+                                         deadline - time.monotonic()))]
+    for i, r in enumerate(stragglers):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        _try_get(r, remaining / (len(stragglers) - i))
+    missing = sorted(set(range(world)) - set(by_rank))
+    return by_rank, missing
+
+
+def detect_stragglers(by_rank, threshold=None):
+    """Flag ranks whose step time exceeds ``threshold`` × the cluster
+    median (``FLAGS_straggler_threshold`` when None). Ranks reporting no
+    steps yet (step_ms 0) are excluded from the median — a cold rank is
+    "missing evidence", not "infinitely fast". Returns
+    ``(stragglers, median_step_ms)`` where each straggler carries its
+    rank, step_ms, and the ratio to the median."""
+    if threshold is None:
+        threshold = float(flag("straggler_threshold"))
+    times = {r: float(s.get("step_ms", 0.0)) for r, s in by_rank.items()
+             if float(s.get("step_ms", 0.0)) > 0.0}
+    if len(times) < 2:
+        return [], 0.0
+    median = statistics.median(times.values())
+    out = []
+    for r, ms in sorted(times.items()):
+        if median > 0 and ms > threshold * median:
+            out.append({"rank": r, "step_ms": ms,
+                        "ratio_to_median": round(ms / median, 3)})
+    return out, median
+
+
+def clusterz_payload(timeout_s=5.0, channel=None, threshold=None) -> dict:
+    """The ``/clusterz`` endpoint body: publish this rank's snapshot,
+    collect every peer's, run straggler detection, and record the verdict
+    into the flight recorder (a fleet post-mortem must carry the same
+    evidence the live view showed)."""
+    publish(channel=channel)
+    by_rank, missing = collect(timeout_s=timeout_s, channel=channel)
+    stragglers, median = detect_stragglers(by_rank, threshold=threshold)
+    thr = (float(threshold) if threshold is not None
+           else float(flag("straggler_threshold")))
+    payload = {
+        "rank": _flight._safe_rank(),
+        "world": _flight._safe_world(),
+        "time": time.time(),
+        "ranks": [by_rank[r] for r in sorted(by_rank)],
+        "missing_ranks": missing,
+        "median_step_ms": round(median, 3),
+        "straggler_threshold": thr,
+        "stragglers": stragglers,
+    }
+    if stragglers or missing:
+        _flight.record_event(
+            "straggler_verdict",
+            stragglers=[s["rank"] for s in stragglers],
+            missing_ranks=missing,
+            median_step_ms=round(median, 3),
+            threshold=thr)
+    return payload
+
+
+class ClusterPublisher:
+    """Daemon thread publishing this rank's snapshot every ``interval_s``
+    seconds (one KV set — overwrite — per period; the collector side pays
+    the reads). Started by ``install_from_flags`` on multi-process worlds
+    when ``FLAGS_cluster_metrics_interval_s`` > 0."""
+
+    def __init__(self, interval_s, channel=None):
+        self.interval_s = float(interval_s)
+        if self.interval_s <= 0:
+            raise ValueError("publisher interval must be > 0 "
+                             "(0 disables — don't construct one)")
+        self._channel = channel
+        self._stop = threading.Event()
+        self._thread = None
+        self.published = 0
+
+    @property
+    def alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self):
+        if self.alive:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ptpu-cluster-publisher", daemon=True)
+        self._thread.start()
+        _flight.record_event("cluster_publisher_start",
+                             interval_s=self.interval_s)
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=self.interval_s + 1.0)
+        self._thread = None
+
+    def _run(self):
+        # publish immediately so a collector never waits a full period
+        # for the first row, then every interval until stopped
+        while True:
+            try:
+                if publish(channel=self._channel):
+                    self.published += 1
+            except Exception:  # the publisher must never kill the run
+                pass
+            if self._stop.wait(self.interval_s):
+                return
+
+
+_publisher = [None]
+
+
+def publisher() -> ClusterPublisher | None:
+    return _publisher[0]
+
+
+def start_publisher(interval_s=None, channel=None) -> ClusterPublisher | None:
+    """Start the global publisher (idempotent). ``interval_s`` defaults
+    to ``FLAGS_cluster_metrics_interval_s``; <=0 leaves it off."""
+    if interval_s is None:
+        interval_s = flag("cluster_metrics_interval_s")
+    if not interval_s or float(interval_s) <= 0:
+        return None
+    pub = _publisher[0]
+    if pub is not None and pub.alive:
+        return pub
+    pub = ClusterPublisher(float(interval_s), channel=channel).start()
+    _publisher[0] = pub
+    return pub
+
+
+def stop_publisher():
+    pub = _publisher[0]
+    if pub is not None:
+        pub.stop()
+    _publisher[0] = None

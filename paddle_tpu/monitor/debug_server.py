@@ -13,6 +13,10 @@ ports; serving-scale TPU jobs (Gemma-on-Cloud-TPU ops runbooks) expect a
   per-group collective tails, thread stacks, flags) as JSON.
 - ``/threadz``       — every Python thread's stack, plain text.
 - ``/flagz``         — the FLAGS registry (core.globals() view) as JSON.
+- ``/costz``         — per-program XLA cost sheets (FLOPs, bytes, HBM
+  footprint) + the device peak table (monitor.cost_model).
+- ``/clusterz``      — every rank's published metric snapshot (step time,
+  MFU, input-wait) + straggler verdicts (monitor.cluster).
 
 Loopback-bound on purpose: the debug surface exposes run internals, so
 reaching it from outside the host goes through whatever port-forwarding
@@ -82,13 +86,15 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _routes(self):
-        from .export import prometheus_text
+        from . import cluster as _cluster
+        from . import cost_model as _cost
+        from .export import PROMETHEUS_CONTENT_TYPE, prometheus_text
 
         return {
             "/healthz": lambda: (
                 json.dumps(healthz(), indent=1), "application/json"),
             "/metrics": lambda: (
-                prometheus_text(), "text/plain; version=0.0.4"),
+                prometheus_text(), PROMETHEUS_CONTENT_TYPE),
             "/flightrecorder": lambda: (
                 json.dumps(_flight.get_recorder().snapshot(reason="debugz"),
                            indent=1, default=str), "application/json"),
@@ -96,6 +102,16 @@ class _Handler(BaseHTTPRequestHandler):
             "/flagz": lambda: (
                 json.dumps(_flight._safe_flags(), indent=1, default=str),
                 "application/json"),
+            # hardware-utilization accounting: per-program cost sheets +
+            # device peaks, and the rank-aggregated cluster view with
+            # straggler verdicts (rank 0 is the natural place to curl it,
+            # but any rank collects the same published snapshots)
+            "/costz": lambda: (
+                json.dumps(_cost.costz_payload(), indent=1, default=str),
+                "application/json"),
+            "/clusterz": lambda: (
+                json.dumps(_cluster.clusterz_payload(), indent=1,
+                           default=str), "application/json"),
         }
 
     def do_GET(self):

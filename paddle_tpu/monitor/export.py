@@ -7,7 +7,10 @@ both exporters walk the same registry/profiler state:
 
 - :func:`export_prometheus` — text exposition format (the de-facto
   fleet-metrics wire format) over every registered counter/gauge/
-  histogram plus the profiler's always-on dispatch counters.
+  histogram plus the profiler's always-on dispatch counters — including
+  the utilization-accounting series (``monitor/<name>/mfu``,
+  ``monitor/<name>/hbm_bw_util``, ``cost/<label>/*`` program cost
+  gauges, ``cost/executed_*`` ledgers) the cost model feeds.
 - :func:`export_merged_chrome_trace` — ONE chrome-trace JSON holding the
   host-side RecordEvent spans and the jax device trace (the
   ``*.trace.json.gz`` files jax.profiler writes), so host dispatch gaps
@@ -26,7 +29,12 @@ from .. import profiler
 from . import registry as _reg
 
 __all__ = ["export_prometheus", "export_merged_chrome_trace",
-           "prometheus_text"]
+           "prometheus_text", "PROMETHEUS_CONTENT_TYPE"]
+
+# the exposition format's registered media type — scrapers key parsing
+# off it, so every HTTP surface serving prometheus_text() (the debug
+# server's /metrics) must send exactly this
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 # ':' is legal in prometheus names but reserved for recording rules by
 # convention — sanitize it away along with '/' and '::'

@@ -748,4 +748,18 @@ def install_from_flags():
                 f"{port + _safe_rank()}: {e}\n")
             _RECORDER.record("debug_server_bind_failed",
                              port=port + _safe_rank(), error=str(e))
+    # cluster metrics publisher (rank-0 /clusterz aggregation feed):
+    # multi-process worlds only — a lone process IS its own cluster view
+    try:
+        interval = float(flag("cluster_metrics_interval_s"))
+    except Exception:
+        interval = 0.0
+    if interval > 0 and _safe_world() > 1:
+        from . import cluster as _cluster
+
+        try:
+            _cluster.start_publisher(interval)
+        except Exception as e:
+            _RECORDER.record("cluster_publisher_failed",
+                             error=f"{type(e).__name__}: {e}"[:200])
     return wd, server

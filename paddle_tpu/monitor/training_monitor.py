@@ -20,12 +20,25 @@ the Prometheus dump and the periodic line can never disagree.
 from __future__ import annotations
 
 import time
+import weakref
 
 from .. import profiler
 from ..flags import flag
+from . import cost_model as _cost
 from . import registry as _reg
 
-__all__ = ["TrainingMonitor", "record_input_wait_ms"]
+__all__ = ["TrainingMonitor", "record_input_wait_ms", "active_monitor"]
+
+# most recently constructed monitor (weak: a dropped monitor must not be
+# kept alive by telemetry) — the cluster aggregator snapshots it
+_active = [None]
+
+
+def active_monitor():
+    """The live TrainingMonitor the cluster metrics snapshot reads
+    (latest constructed wins), or None."""
+    ref = _active[0]
+    return ref() if ref is not None else None
 
 
 def record_input_wait_ms(ms: float):
@@ -38,6 +51,13 @@ def record_input_wait_ms(ms: float):
 def _cache_rate(hits, misses):
     total = hits + misses
     return hits / total if total else 1.0
+
+
+def _fmt_util(v: float) -> str:
+    """Utilization ratio for the log line: fixed-point in the normal
+    range, scientific below it (a CPU smoke's 4e-5 MFU must not print as
+    an indistinguishable 0.0000)."""
+    return f"{v:.4f}" if (v == 0.0 or v >= 1e-3) else f"{v:.2e}"
 
 
 class _StepSpan:
@@ -92,7 +112,9 @@ class TrainingMonitor:
         _reg.install_jax_listeners()
         self._t_begin = None
         self._span = None
+        self._closed = False
         self._reset_window()
+        _active[0] = weakref.ref(self)
 
     # -- window bookkeeping -------------------------------------------------
 
@@ -105,6 +127,10 @@ class TrainingMonitor:
             "jit_miss": c.get("executor::jit_cache_miss", 0),
             "compiles": self._compile_events(),
             "input_wait_ms": _reg.gauge("io/input_wait_ms").value,
+            # executed-work ledger (cost_model.note_run): differencing it
+            # over the window gives the window's FLOPs/bytes for MFU
+            "flops": _reg.counter("cost/executed_flops").value,
+            "bytes": _reg.counter("cost/executed_bytes").value,
         }
 
     @staticmethod
@@ -177,6 +203,9 @@ class TrainingMonitor:
         cur = self._counter_basis()
         input_wait_ms = cur["input_wait_ms"] - basis["input_wait_ms"]
         steps = self._win_steps
+        flops_d = cur["flops"] - basis["flops"]
+        bytes_d = cur["bytes"] - basis["bytes"]
+        peaks = _cost.device_peaks()
         return {
             "step": self.step_count,
             "step_ms": (self._win_step_ms / steps) if steps else 0.0,
@@ -191,6 +220,12 @@ class TrainingMonitor:
                 cur["jit_miss"] - basis["jit_miss"]),
             "compiles": cur["compiles"] - basis["compiles"],
             "hbm_peak_bytes": _reg.hbm_watermark_bytes(self._devices),
+            # hardware-utilization accounting (cost_model): window FLOPs/
+            # bytes over wall time, normalized by the chip's peaks — 0.0
+            # until a compile was cost-captured (nothing to claim yet)
+            "mfu": _cost.mfu(flops_d / wall_s, peaks),
+            "hbm_bw_util": _cost.hbm_bw_util(bytes_d / wall_s, peaks),
+            "roofline": _cost.roofline_class(flops_d, bytes_d, peaks),
         }
 
     def _emit(self):
@@ -199,6 +234,9 @@ class TrainingMonitor:
             s["examples_per_sec"])
         _reg.gauge(f"monitor/{self.name}/input_wait_ratio").set(
             s["input_wait_ratio"])
+        _reg.gauge(f"monitor/{self.name}/mfu").set(s["mfu"])
+        _reg.gauge(f"monitor/{self.name}/hbm_bw_util").set(
+            s["hbm_bw_util"])
         line = (
             f"[monitor:{self.name}] step={s['step']} "
             f"step_ms={s['step_ms']:.2f} "
@@ -207,9 +245,38 @@ class TrainingMonitor:
             f"plan_cache_hit_rate={s['plan_cache_hit_rate']:.3f} "
             f"jit_cache_hit_rate={s['jit_cache_hit_rate']:.3f} "
             f"compiles={s['compiles']} "
-            f"hbm_peak_bytes={s['hbm_peak_bytes']}"
+            f"hbm_peak_bytes={s['hbm_peak_bytes']} "
+            f"mfu={_fmt_util(s['mfu'])} "
+            f"hbm_bw_util={_fmt_util(s['hbm_bw_util'])} "
+            f"roofline={s['roofline']}"
         )
         self.last_line = line
         self._log_fn(line)
         self._reset_window()
         return line
+
+    def close(self):
+        """Flush a final partial-window line and detach (idempotent).
+
+        A run shorter than ``FLAGS_monitor_interval`` never reaches an
+        emit boundary — without this flush it would end silently, which
+        for a smoke run is exactly when the line matters most. Interval 0
+        still means silent (the documented off switch); an in-flight step
+        (close inside an exception unwind) is aborted, not counted.
+        Returns the emitted line (None when nothing was flushed)."""
+        if self._closed:
+            return None
+        self._closed = True
+        # detach: a closed monitor must stop feeding cluster snapshots
+        # (a later evaluate()'s executed work would silently accrue to
+        # this dead window otherwise)
+        ref = _active[0]
+        if ref is not None and ref() is self:
+            _active[0] = None
+        if self._t_begin is not None:
+            self.step_abort()
+        interval = (self._interval if self._interval is not None
+                    else flag("monitor_interval"))
+        if self._win_steps and interval:
+            return self._emit()
+        return None

@@ -33,6 +33,7 @@ from jax import lax
 
 from ..flags import flag, watch_flag
 from ..framework import random as _random
+from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
 from ..framework.place import Place, _default_place
 from ..framework.tensor import Tensor
@@ -267,6 +268,18 @@ def _sync_persistent_cache():
 # set_flags must take effect immediately — clearing the flag restores the
 # ambient jax cache config right away, not at the next jit-cache miss
 watch_flag("persistent_compile_cache_dir", lambda _v: _sync_persistent_cache())
+
+
+def _any_deleted(arrays) -> bool:
+    """Whether any array's buffer has been consumed (donation): decides
+    if a failed dispatch may be retried on the fallback path."""
+    for a in arrays:
+        try:
+            if a.is_deleted():
+                return True
+        except Exception:
+            continue
+    return False
 
 
 def _plan_key(program):
@@ -773,7 +786,11 @@ class Executor:
                                   fetch_names, donate_names, hold_names)
             jitted = jax.jit(
                 traced, donate_argnums=(1,) if donate_names else ())
-            entry = (jitted, donate_names, hold_names)
+            # [AOT executable, CostRecord, aot-attempted]: filled on the
+            # first run (lower/compile once, cost-captured); a backend
+            # that rejects the AOT path leaves [None, None, True] and the
+            # entry dispatches through jax.jit forever after
+            entry = (jitted, donate_names, hold_names, [None, None, False])
             self._cache[sig] = entry
             # LRU-style eviction: a long-lived Executor fed many program
             # versions (notebooks, unit-test loops) must not grow the
@@ -783,7 +800,7 @@ class Executor:
         else:
             bump_counter("executor::jit_cache_hit")
             self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
-        jitted, donate_names, hold_names = entry
+        jitted, donate_names, hold_names, aot_slot = entry
 
         # flight-recorder breadcrumb: which program ran, and whether the
         # caches served it — a post-mortem can see a retrace storm (jit
@@ -813,8 +830,42 @@ class Executor:
                          else RecordEvent("executor::dispatch"))
         try:
             with RecordEvent(phase), compile_span, dispatch_span:
-                fetches, donated_out, extra = jitted(
-                    feed_arrays, donated, held, base_key)
+                if not aot_slot[2]:
+                    # one-time AOT lower+compile (the same work jax.jit's
+                    # first call would do) so the compiled module's own
+                    # cost_analysis/memory_analysis land in the cost-model
+                    # registry — utilization from what XLA actually built,
+                    # not an estimate
+                    aot_slot[2] = True
+                    try:
+                        lowered = jitted.lower(
+                            feed_arrays, donated, held, base_key)
+                        aot_slot[0] = lowered.compile()
+                        aot_slot[1] = _cost.capture(
+                            "executor", lowered=lowered,
+                            compiled=aot_slot[0], key=sig,
+                            program=program_id)
+                    except Exception:
+                        aot_slot[0] = None  # jax without AOT: jit path
+                runner = aot_slot[0] if aot_slot[0] is not None else jitted
+                try:
+                    fetches, donated_out, extra = runner(
+                        feed_arrays, donated, held, base_key)
+                except Exception:
+                    # the AOT executable is stricter than jax.jit (an
+                    # aval/layout drift raises where jit would silently
+                    # recompile): demote this entry to the jit path and
+                    # retry — but never after a donation consumed buffers
+                    if runner is jitted or _any_deleted(donated):
+                        raise
+                    # drop the cost record too: jax.jit recompiles for
+                    # the drifted avals, so the captured numbers no
+                    # longer describe what runs — crediting them would
+                    # silently corrupt the MFU ledger
+                    aot_slot[0] = None
+                    aot_slot[1] = None
+                    fetches, donated_out, extra = jitted(
+                        feed_arrays, donated, held, base_key)
         except Exception as e:
             _flight.record_event(
                 "executor_run_error", program=program_id,
@@ -833,6 +884,9 @@ class Executor:
                 head = e.args[0] if e.args else ""
                 e.args = (f"{head}\n  {note}",) + tuple(e.args[1:])
             raise
+        # executed-work ledger: this run dispatched the captured program
+        # once (feeds the MFU window math; None record is a free no-op)
+        _cost.note_run(aot_slot[1])
         if donate_names:
             bump_counter("executor::donated_buffers", len(donate_names))
             # a fetch may share its buffer with a value the scope holds and

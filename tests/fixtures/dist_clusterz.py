@@ -1,0 +1,79 @@
+"""2-process cluster-aggregation fixture: both ranks run a monitored
+step loop (rank 1 artificially slowed), publish metric snapshots over
+the jax.distributed KV side channel, and rank 0 serves ``/clusterz`` on
+a real debug server — the endpoint must list BOTH ranks and flag rank 1
+as the straggler, with the verdict recorded in the flight recorder.
+
+Prints one JSON line per rank:
+  rank 0: {"rank", "ranks", "stragglers", "missing", "straggler_event"}
+  rank 1: {"rank", "published"}
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    from paddle_tpu.distributed import fleet
+
+    fleet.fleet.init(is_collective=True)  # rendezvous first
+
+    from urllib.request import urlopen
+
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import cluster, debug_server
+    from paddle_tpu.monitor import flight_recorder as fr
+
+    rank = fleet.fleet.worker_index()
+    channel = fr._default_channel()
+    assert channel is not None, "fixture needs the jax.distributed KV store"
+
+    # interval=0: the window never resets, so snapshot() covers the whole
+    # run — deterministic step_ms evidence for the straggler math
+    mon = monitor.TrainingMonitor("clusterz_fixture", interval=0)
+    delay = 0.12 if rank == 1 else 0.005
+    for _ in range(4):
+        with mon.step(examples=8):
+            time.sleep(delay)
+    cluster.publish(channel=channel)
+    # readiness handshake: the install_from_flags publisher already
+    # published a pre-loop (step 0) snapshot at init; rank 0 must not
+    # collect until rank 1's post-loop snapshot has overwritten it
+    channel.set(f"ptpu/fixture/clusterz_ready/{rank}", "1")
+
+    if rank == 0:
+        channel.get("ptpu/fixture/clusterz_ready/1", 120.0)
+        srv = debug_server.DebugServer(port=0).start()
+        try:
+            # /clusterz re-publishes rank 0's snapshot and collects every
+            # peer's latest published row
+            payload = json.loads(urlopen(
+                srv.url + "/clusterz", timeout=120).read())
+        finally:
+            srv.stop()
+        kinds = {e["kind"] for e in fr.events()}
+        print(json.dumps({
+            "rank": rank,
+            "ranks": payload["ranks"],
+            "stragglers": payload["stragglers"],
+            "missing": payload["missing_ranks"],
+            "median_step_ms": payload["median_step_ms"],
+            "straggler_event": "straggler_verdict" in kinds,
+        }))
+        # release rank 1 (it must stay alive until the collect finished —
+        # and the KV store lives in this process's coordinator anyway)
+        channel.set("ptpu/fixture/clusterz_done", "1")
+    else:
+        channel.get("ptpu/fixture/clusterz_done", 120.0)
+        print(json.dumps({"rank": rank, "published": True}))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -138,6 +138,38 @@ def test_two_process_collective_desync_detection(tmp_path):
         assert "collective" in recorded and "desync_report" in recorded
 
 
+FIXTURE_CLUSTERZ = os.path.join(REPO, "tests", "fixtures",
+                                "dist_clusterz.py")
+
+
+@pytest.mark.slow
+def test_two_process_clusterz_straggler_detection():
+    """Cluster-wide metrics aggregation e2e: both ranks publish metric
+    snapshots over the jax.distributed KV channel; rank 0's real
+    /clusterz HTTP endpoint must list both ranks (with MFU/step-time
+    fields) and flag the artificially slowed rank 1 as a straggler,
+    recording the verdict into the flight recorder."""
+    outs = _run_world(nproc=2, devices_per_proc=1,
+                      fixture=FIXTURE_CLUSTERZ)
+    by_rank = {r["rank"]: r for r in outs}
+    assert sorted(by_rank) == [0, 1]
+    assert by_rank[1]["published"] is True
+    r0 = by_rank[0]
+    assert r0["missing"] == []
+    ranks = {row["rank"]: row for row in r0["ranks"]}
+    assert sorted(ranks) == [0, 1]
+    for row in ranks.values():
+        # the published snapshot carries the utilization fields
+        for key in ("step_ms", "mfu", "hbm_bw_util", "input_wait_ratio"):
+            assert key in row, (key, row)
+        assert row["step"] == 4
+    # rank 1 slept ~24x longer per step: flagged against the median
+    assert ranks[1]["step_ms"] > ranks[0]["step_ms"]
+    assert [s["rank"] for s in r0["stragglers"]] == [1], r0
+    assert r0["stragglers"][0]["ratio_to_median"] > 1.5
+    assert r0["straggler_event"] is True
+
+
 @pytest.mark.slow
 def test_launch_cli_main():
     """python -m paddle_tpu.distributed.launch --nproc 2 <fixture> — the

@@ -260,7 +260,9 @@ def test_training_monitor_periodic_line_fields():
         r"\[monitor:unit\] step=(\d+) step_ms=([\d.]+) "
         r"examples_per_sec=([\d.]+) input_wait_ratio=([\d.]+) "
         r"plan_cache_hit_rate=([\d.]+) jit_cache_hit_rate=([\d.]+) "
-        r"compiles=(\d+) hbm_peak_bytes=(\d+)$", line)
+        r"compiles=(\d+) hbm_peak_bytes=(\d+) "
+        r"mfu=([\d.e+-]+) hbm_bw_util=([\d.e+-]+) "
+        r"roofline=(compute-bound|memory-bound|unknown)$", line)
     assert m, line
     assert int(m.group(1)) == 4
     assert float(m.group(3)) > 0  # examples/sec

@@ -12,6 +12,7 @@
 #   bench - bench.py smoke on the current backend
 #   check - static gates: op coverage + API spec + graft entry self-test
 #           + debugz smoke (debug server endpoints + flight-recorder dump)
+#           + mfu smoke (cost-model capture + utilization endpoints)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -75,6 +76,8 @@ case "$MODE" in
     JAX_PLATFORMS=cpu python __graft_entry__.py
     # fault-diagnosis smoke: debug server up, endpoints valid, dump CLI works
     JAX_PLATFORMS=cpu python tools/debugz_smoke.py
+    # utilization smoke: cost-model capture, MFU monitor line, /costz+/clusterz
+    JAX_PLATFORMS=cpu python tools/utilization_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

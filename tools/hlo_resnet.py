@@ -52,8 +52,10 @@ def main():
     dots = collections.Counter()
     for m in re.finditer(r"(\S+) = (\S+) dot\(", txt):
         dots[m.group(2).split("[")[0]] += 1
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    # shared cost-analysis normalization/guard: monitor.cost_model
+    from paddle_tpu.monitor import cost_model
+
+    ca = cost_model.analyze_cost(compiled) or {}
     flops = ca.get("flops", 0)
     bytes_ = ca.get("bytes accessed", 0)
     print(json.dumps({

@@ -23,12 +23,13 @@ def main():
 
     step, batch_args = build_bert_step(device_put=True)
 
-    # HLO cost stats
+    # HLO cost stats (shared normalization/guard: monitor.cost_model)
+    from paddle_tpu.monitor import cost_model
+
     lr = jax.numpy.asarray(1e-4, jax.numpy.float32)
     key = jax.random.PRNGKey(0)
     compiled = jax.jit(step.pure).lower(step.state, batch_args, lr, key).compile()
-    ca = compiled.cost_analysis()
-    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    ca = cost_model.analyze_cost(compiled) or {}
     txt = compiled.as_text()
     convs = collections.Counter(
         m.group(1).split("[")[0]

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""CI smoke for the hardware-utilization accounting stack (`make mfu-smoke`).
+
+Drives both compile paths that feed the cost model — a static-graph
+Executor train loop and a framework/jit compiled train step — then
+asserts:
+- a CostRecord was captured on each path from XLA's real
+  cost_analysis()/memory_analysis() (FLOPs > 0, not an estimate), and a
+  pure-matmul jit matches the 2·M·N·K hand count;
+- the TrainingMonitor line carries ``mfu=``/``hbm_bw_util=``/
+  ``roofline=`` computed from the executed-work ledger;
+- ``/costz`` and ``/clusterz`` render on the debug server, and
+  ``/metrics`` serves the cost gauges under the Prometheus content type.
+
+Exit 0 on success; nothing here depends on timing — a failure is a real
+regression in the utilization-accounting path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from urllib.request import urlopen
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu.static as static
+    from paddle_tpu import monitor, ops
+    from paddle_tpu.monitor import cost_model, debug_server
+
+    # -- matmul golden: XLA's FLOPs must match the hand count ----------
+    M, K, N = 128, 256, 64
+
+    def matmul(a, b):
+        return a @ b
+
+    lowered = jax.jit(matmul).lower(
+        jnp.zeros((M, K), jnp.float32), jnp.zeros((K, N), jnp.float32))
+    rec = cost_model.capture("smoke_matmul", lowered=lowered,
+                             compiled=lowered.compile())
+    want = 2.0 * M * N * K
+    assert rec.flops and abs(rec.flops - want) / want < 0.05, \
+        (rec.flops, want)
+
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    srv = debug_server.DebugServer(port=0).start()
+    try:
+        # -- executor path under the monitor ---------------------------
+        x = static.data("x", [8, 16], "float32")
+        y = static.data("y", [8, 1], "float32")
+        w = static.nn.create_parameter([16, 1], "float32")
+        loss = ops.mean(ops.square(ops.subtract(ops.matmul(x, w), y)))
+        opt = static.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+        exe = static.Executor()
+        exe.run_startup()
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 16).astype("float32")
+        Y = rng.randn(8, 1).astype("float32")
+
+        mon = monitor.TrainingMonitor("mfu_smoke", interval=100)
+        for _ in range(3):
+            with mon.step(examples=8):
+                exe.run(feed={"x": X, "y": Y}, fetch_list=[loss])
+        # 3 steps < interval: close() must flush the partial window
+        line = mon.close()
+        assert line, "close() flushed no partial-window line"
+        for field in ("mfu=", "hbm_bw_util=", "roofline="):
+            assert field in line, (field, line)
+
+        exec_rec = cost_model.latest_record("executor")
+        assert exec_rec is not None and exec_rec.flops > 0, exec_rec
+        assert exec_rec.runs == 3, exec_rec.runs
+        ledger = monitor.registry_snapshot()["cost/executed_flops"]["value"]
+        assert abs(ledger - 3 * exec_rec.flops) < 1e-6 * ledger + 1.0
+
+        # -- compiled-train-step path ----------------------------------
+        import paddle_tpu as paddle
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as popt
+        from paddle_tpu.framework import jit as fjit
+
+        paddle.seed(0)
+        net = nn.Linear(16, 4)
+        optimizer = popt.SGD(learning_rate=0.1,
+                             parameters=net.parameters())
+
+        def loss_fn(m, a, b):
+            return ((m(a) - b) ** 2).mean()
+
+        step = fjit.train_step(net, optimizer, loss_fn)
+        a = rng.randn(8, 16).astype("float32")
+        b = rng.randn(8, 4).astype("float32")
+        for _ in range(2):
+            step(a, b)
+        jit_rec = cost_model.latest_record("train_step")
+        assert jit_rec is not None and jit_rec.flops > 0, jit_rec
+        assert jit_rec.runs == 2, jit_rec.runs
+
+        # -- debug endpoints -------------------------------------------
+        costz = json.loads(urlopen(srv.url + "/costz").read())
+        labels = {r["label"] for r in costz["records"]}
+        assert {"executor", "train_step", "smoke_matmul"} <= labels, labels
+        assert costz["device_peaks"]["flops"] > 0
+        assert costz["executed_flops"] > 0
+
+        clusterz = json.loads(urlopen(srv.url + "/clusterz").read())
+        assert len(clusterz["ranks"]) == 1  # single-process world view
+        assert "mfu" in clusterz["ranks"][0]
+        assert clusterz["stragglers"] == []
+
+        resp = urlopen(srv.url + "/metrics")
+        ctype = resp.headers.get("Content-Type", "")
+        assert ctype.startswith("text/plain; version=0.0.4"), ctype
+        prom = resp.read().decode()
+        for series in ("cost_executed_flops", "cost_executor_flops",
+                       "monitor_mfu_smoke_mfu"):
+            assert series in prom, series
+
+        print(f"mfu-smoke OK: executor {exec_rec.flops:.0f} FLOPs/step, "
+              f"train_step {jit_rec.flops:.0f} FLOPs/step, "
+              f"matmul golden {rec.flops:.0f}=={want:.0f}, "
+              f"monitor line: {line}")
+        return 0
+    finally:
+        srv.stop()
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
