@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -46,6 +46,9 @@ debugz-smoke:  # run with the debug server on; curl /healthz + /flightrecorder
 
 mfu-smoke:  # cost-model capture + MFU line + /costz /clusterz endpoints
 	JAX_PLATFORMS=cpu python tools/utilization_smoke.py
+
+serve-smoke:  # online serving: readiness gating, bounded compiles, 429, drain
+	JAX_PLATFORMS=cpu python tools/serving_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

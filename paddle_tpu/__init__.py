@@ -206,6 +206,7 @@ from . import vision  # noqa: E402
 from . import text  # noqa: E402  (text datasets: imdb/imikolov/wmt/conll05)
 from . import profiler  # noqa: E402
 from . import monitor  # noqa: E402  (metrics registry + training monitor)
+from . import serving  # noqa: E402  (online inference: batcher/replicas/HTTP)
 from . import distribution  # noqa: E402
 from . import errors  # noqa: E402  (platform/enforce.h error taxonomy)
 from . import incubate  # noqa: E402  (auto-checkpoint)

@@ -238,6 +238,43 @@ define_flag("cluster_metrics_interval_s", 15.0,
             "period for publishing per-rank metric snapshots to the "
             "cluster aggregator (0: disabled)")
 
+# serving/batcher.py — the shape-bucket ladder for the online batcher's
+# batch axis. Every assembled batch is padded up to the smallest bucket
+# that covers its rows, so the steady-state compile count is bounded by
+# the ladder length (asserted after warmup). Powers of two by default:
+# each recompile doubles capacity, log2(max) compiles total.
+define_flag("serving_batch_buckets", "1,2,4,8",
+            "comma-separated ascending batch-axis bucket sizes for the "
+            "online serving batcher; each bucket is one compiled shape")
+
+# serving/batcher.py — bounded admission queue. A full queue REJECTS the
+# request (QueueFullError -> HTTP 429) instead of queueing unboundedly:
+# under sustained overload an unbounded queue converts every request
+# into a deadline miss while memory grows without limit.
+define_flag("serving_queue_capacity", 256,
+            "max requests the serving batcher holds before rejecting "
+            "(backpressure: HTTP 429)")
+
+# serving/batcher.py — how long the batch-assembly loop holds an open
+# batch waiting for more requests after the first one arrives. The
+# latency/throughput knob: 0 dispatches every request immediately.
+define_flag("serving_batch_timeout_ms", 2.0,
+            "max ms the serving batcher waits to fill a batch beyond "
+            "its first request (0: dispatch immediately)")
+
+# serving/replica.py — worker threads in the replica pool; every replica
+# is a Predictor.clone() sharing ONE jit/AOT executable cache, so N
+# replicas serve with zero extra compiles.
+define_flag("serving_replicas", 1,
+            "replica worker threads serving the online batcher")
+
+# serving/batcher.py — default per-request deadline; a request that sits
+# queued past its deadline completes with ExecutionTimeoutError without
+# ever dispatching. 0 disables (requests wait indefinitely).
+define_flag("serving_default_deadline_ms", 0.0,
+            "default per-request serving deadline in ms (0: none); "
+            "expired requests error without dispatch")
+
 # static/executor.py — JAX persistent compilation cache directory: repeated
 # process starts skip XLA recompilation of unchanged programs (the role of
 # TVM's ahead-of-time compiled module artifact). Empty string disables.

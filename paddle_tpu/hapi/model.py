@@ -289,6 +289,37 @@ class Model:
             except FileNotFoundError:
                 pass
 
+    def serve(self, input_spec, host="127.0.0.1", port=0, model_dir=None,
+              warmup=True, **serving_kwargs):
+        """Export the trained network and serve it online.
+
+        Captures the network as a static inference program (``jit.save``
+        over ``input_spec``), loads it into an inference ``Predictor``,
+        and starts an :class:`~paddle_tpu.serving.InferenceServer` on
+        ``host:port`` (``port=0``: ephemeral) — dynamic batching, the
+        replica pool, and warmed-bucket readiness included. Extra
+        keyword args (``replicas``, ``buckets``, ``queue_capacity``,
+        ``batch_timeout_ms``) pass through to the server. Returns the
+        started server; call ``.stop(drain=True)`` to shut down.
+        """
+        import tempfile
+
+        from .. import jit_api
+        from ..inference import Config, create_predictor
+        from ..serving import InferenceServer
+
+        self._sync_from_step()
+        specs = [
+            s if isinstance(s, jit_api.InputSpec) else jit_api.InputSpec(s)
+            for s in input_spec
+        ]
+        dirname = model_dir or tempfile.mkdtemp(prefix="ptpu_serve_")
+        jit_api.save(self.network, dirname, input_spec=specs)
+        predictor = create_predictor(Config(dirname))
+        server = InferenceServer(predictor, port=port, host=host,
+                                 **serving_kwargs)
+        return server.start(warmup=warmup)
+
     def parameters(self):
         return self.network.parameters()
 

@@ -76,7 +76,16 @@ class Tensor:
         self._data = None
 
     def copy_from_cpu(self, arr):
-        self._data = np.asarray(arr)
+        arr = np.asarray(arr)
+        # the device feed path needs native-endian contiguous memory;
+        # sliced views and big-endian arrays (network/file decoders) are
+        # legitimate caller data — copy them into shape instead of
+        # erroring downstream (the "copy" in copy_from_cpu)
+        if not arr.dtype.isnative:
+            arr = arr.astype(arr.dtype.newbyteorder("="))
+        if not arr.flags["C_CONTIGUOUS"]:
+            arr = np.ascontiguousarray(arr)
+        self._data = arr
 
     def copy_to_cpu(self):
         return np.asarray(self._data)
@@ -133,6 +142,28 @@ class Predictor:
         return self._outputs[name]
 
     get_output_tensor = get_output_handle
+
+    def clone(self):
+        """Replica twin sharing the compiled-program caches.
+
+        The clone reuses this predictor's Executor — and with it the
+        RunPlan and jit/AOT executable caches — plus the loaded program
+        and scope-resident weights, so N clones serve with ZERO extra
+        XLA compiles (AnalysisPredictor::Clone's shared-program intent,
+        realized at the executable-cache level). Only the IO tensor
+        handles are per-clone: concurrent worker threads stage inputs
+        and read outputs without racing each other.
+        """
+        new = object.__new__(Predictor)
+        new.config = self.config
+        new._exe = self._exe          # shared: jit/AOT + plan caches
+        new._program = self._program  # shared identity -> shared plans
+        new._feed_names = self._feed_names
+        new._fetch_names = self._fetch_names
+        new.pass_stats = self.pass_stats
+        new._inputs = {n: Tensor(n) for n in self._feed_names}
+        new._outputs = {n: Tensor(n) for n in self._fetch_names}
+        return new
 
     def run(self, inputs=None):
         """Zero-copy style: stage inputs via handles then run(); or pass a

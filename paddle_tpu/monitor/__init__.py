@@ -56,6 +56,7 @@ from .registry import (  # noqa: F401
     gauge,
     hbm_watermark_bytes,
     histogram,
+    histogram_quantile,
     install_jax_listeners,
     registry_snapshot,
     reset_registry,
@@ -101,6 +102,7 @@ __all__ = [
     "counter", "gauge", "histogram",
     "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
     "registry_snapshot", "reset_registry", "all_metrics",
+    "histogram_quantile",
     "collect_hbm_gauges", "hbm_watermark_bytes", "install_jax_listeners",
     "export_prometheus", "prometheus_text", "export_merged_chrome_trace",
     "PROMETHEUS_CONTENT_TYPE",

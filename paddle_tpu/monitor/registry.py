@@ -24,6 +24,7 @@ __all__ = [
     "counter", "gauge", "histogram",
     "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
     "registry_snapshot", "reset_registry", "all_metrics",
+    "histogram_quantile",
     "collect_hbm_gauges", "hbm_watermark_bytes",
     "install_jax_listeners",
 ]
@@ -220,6 +221,27 @@ def stat_add(name, v=1):
 def stat_reset(name):
     """STAT_RESET(name)."""
     STAT_INT(name).set(0)
+
+
+def histogram_quantile(h: Histogram, q: float) -> float:
+    """Approximate quantile from the bucketed counts (prometheus
+    histogram_quantile semantics: linear interpolation inside the
+    matching bucket; observations in the +Inf bucket clamp to the
+    largest finite bound). Returns 0.0 on an empty histogram."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    snap = h.snapshot()
+    total = snap["count"]
+    if total == 0:
+        return 0.0
+    target = q * total
+    acc, lo = 0, 0.0
+    for bound, c in zip(snap["bounds"], snap["buckets"]):
+        if c and acc + c >= target:
+            return lo + (bound - lo) * (target - acc) / c
+        acc += c
+        lo = bound
+    return float(snap["bounds"][-1])
 
 
 def all_metrics() -> dict:

@@ -1,0 +1,84 @@
+"""Online serving subsystem: dynamic batcher, replica pool, HTTP frontend.
+
+Layers (each usable alone) on top of ``paddle_tpu.inference.Predictor``:
+
+- :mod:`serving.batcher` — shape-bucketed dynamic batching with a
+  bounded admission queue, per-request deadlines, and zero-padding up to
+  a configured bucket ladder (``FLAGS_serving_batch_buckets``) so the
+  steady-state XLA compile count is bounded by the ladder length.
+- :mod:`serving.replica` — a replica pool of ``Predictor.clone()``
+  workers sharing ONE jit/AOT executable cache (N threads, zero extra
+  compiles), warmed up bucket-by-bucket before readiness.
+- :mod:`serving.server` — stdlib ThreadingHTTPServer frontend
+  (``/predict``, ``/healthz`` readiness, ``/statz``, ``/metrics``) with
+  429 backpressure on a full queue and graceful drain on shutdown.
+
+Quickstart::
+
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.serving import InferenceServer
+
+    srv = InferenceServer(create_predictor(Config(model_dir)),
+                          port=8500, replicas=4).start()
+    # POST {"inputs": {"x": [[...]]}} to http://127.0.0.1:8500/predict
+    srv.stop(drain=True)
+
+or, from a trained high-level model: ``model.serve(input_spec=[...])``.
+"""
+from __future__ import annotations
+
+import atexit
+import weakref
+
+from .batcher import (  # noqa: F401
+    DeadlineExceededError,
+    DynamicBatcher,
+    QueueFullError,
+    ServingClosedError,
+    parse_buckets,
+)
+from .replica import ReplicaPool, predictor_input_specs  # noqa: F401
+from .server import InferenceServer  # noqa: F401
+
+__all__ = [
+    "DynamicBatcher", "ReplicaPool", "InferenceServer",
+    "QueueFullError", "DeadlineExceededError", "ServingClosedError",
+    "parse_buckets", "predictor_input_specs", "shutdown_all",
+]
+
+# every live batcher/pool/server registers itself here so one call can
+# tear the whole subsystem down (tests must not leak serving threads
+# across the suite — see tests/conftest.py)
+_live = weakref.WeakSet()
+
+
+def _register_live(obj):
+    _live.add(obj)
+
+
+def shutdown_all():
+    """Stop every live server, pool, and batcher (idempotent; exceptions
+    swallowed — this is the test-teardown / atexit path, where a
+    half-constructed object must not mask the real failure)."""
+    # servers first (they drain their own pool+batcher), then bare pools,
+    # then bare batchers — reverse dependency order
+    objs = list(_live)
+    for cls in (InferenceServer, ReplicaPool, DynamicBatcher):
+        for obj in objs:
+            if type(obj) is not cls:
+                continue
+            try:
+                if cls is InferenceServer:
+                    obj.stop(drain=False, timeout=2.0)
+                elif cls is ReplicaPool:
+                    obj.stop(drain=False, timeout=2.0)
+                else:
+                    obj.close(drain=False)
+            except Exception:
+                pass
+
+
+# a replica worker parked inside XLA while the interpreter tears down
+# aborts the process ("terminate called without an active exception");
+# stop the whole subsystem before Python starts dying
+atexit.register(shutdown_all)

@@ -1,0 +1,459 @@
+"""Dynamic batching engine for online inference.
+
+Production TPU serving gets its throughput from batch parallelism, but a
+naive "batch whatever arrived" policy compiles a new XLA program for
+every distinct request count — the compile cache grows with traffic, not
+with the model. This batcher pads every assembled batch up to a small
+ladder of bucketed batch sizes (``FLAGS_serving_batch_buckets``, powers
+of two by default), so the steady-state compile count is bounded by the
+ladder length no matter what the traffic mix looks like — the
+bounded-compile-cache discipline, applied to the batch axis.
+
+Mechanics:
+
+- ``submit()`` validates the request and appends it to a BOUNDED queue;
+  a full queue rejects with :class:`QueueFullError` (the HTTP frontend
+  maps it to 429) instead of queueing unboundedly — under overload the
+  caller learns to back off while memory stays flat.
+- Replica workers call ``next_batch()``: it blocks for the first live
+  request, gathers more until the largest bucket fills or the assembly
+  window (``FLAGS_serving_batch_timeout_ms``) closes, drops requests
+  whose deadline already passed (they complete with
+  :class:`DeadlineExceededError` WITHOUT dispatching), concatenates the
+  survivors along the batch axis, and zero-pads up to the smallest
+  covering bucket.
+- ``complete()`` slices the padded outputs back per request; padding
+  rows are computed and discarded (numerically inert: they ride along in
+  the same fused program, results for real rows are identical to an
+  unbatched run — asserted by golden tests).
+
+Everything reports into the monitor stack: queue-depth / batch-fill
+gauges, per-stage latency histograms (queue / assemble / dispatch /
+end-to-end), request counters in the Prometheus dump, and batcher
+events in the flight recorder.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..errors import (
+    ExecutionTimeoutError,
+    InvalidArgumentError,
+    ResourceExhaustedError,
+    UnavailableError,
+)
+from ..flags import flag
+from ..monitor import counter, gauge, histogram
+from ..monitor import flight_recorder as _flight
+from ..profiler import RecordEvent
+
+__all__ = [
+    "DynamicBatcher", "QueueFullError", "DeadlineExceededError",
+    "ServingClosedError", "parse_buckets",
+]
+
+
+class QueueFullError(ResourceExhaustedError):
+    """The bounded admission queue is full: back off and retry (429)."""
+
+
+class DeadlineExceededError(ExecutionTimeoutError):
+    """The request's deadline passed while it waited; never dispatched."""
+
+
+class ServingClosedError(UnavailableError):
+    """The batcher is shut down (or draining) and accepts no new work."""
+
+
+def parse_buckets(spec) -> tuple:
+    """Parse a bucket ladder ("1,2,4,8" or an int sequence) into a
+    strictly ascending tuple of positive batch sizes."""
+    if isinstance(spec, str):
+        parts = [p.strip() for p in spec.split(",") if p.strip()]
+        try:
+            vals = tuple(int(p) for p in parts)
+        except ValueError:
+            raise InvalidArgumentError(
+                f"serving_batch_buckets {spec!r} is not a comma-separated "
+                "int list") from None
+    else:
+        vals = tuple(int(v) for v in spec)
+    if not vals or any(v <= 0 for v in vals) or list(vals) != sorted(set(vals)):
+        raise InvalidArgumentError(
+            f"serving batch buckets must be strictly ascending positive "
+            f"ints, got {vals!r}")
+    return vals
+
+
+class _Request:
+    """One submitted prediction: inputs with a leading batch axis, an
+    optional absolute deadline, and a completion event the submitter
+    waits on."""
+
+    __slots__ = ("inputs", "rows", "deadline", "t_submit", "result",
+                 "error", "_done")
+
+    def __init__(self, inputs, rows, deadline, t_submit):
+        self.inputs = inputs
+        self.rows = rows
+        self.deadline = deadline  # absolute monotonic seconds, or None
+        self.t_submit = t_submit
+        self.result = None
+        self.error = None
+        self._done = threading.Event()
+
+    def expired(self, now) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def done(self, result=None, error=None):
+        self.result = result
+        self.error = error
+        self._done.set()
+
+    def wait(self, timeout=None):
+        """Block until completion; returns the per-fetch output list
+        (batch axis = this request's rows) or raises the stored error."""
+        if not self._done.wait(timeout):
+            raise ExecutionTimeoutError(
+                f"serving request not completed within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _Batch:
+    """An assembled, padded batch ready for one replica dispatch."""
+
+    __slots__ = ("requests", "bucket", "rows", "feed", "t_ready")
+
+    def __init__(self, requests, bucket, rows, feed, t_ready):
+        self.requests = requests
+        self.bucket = bucket  # padded batch-axis size (a ladder entry)
+        self.rows = rows      # real rows (sum over requests)
+        self.feed = feed      # name -> padded (bucket, *feature) array
+        self.t_ready = t_ready
+
+
+class DynamicBatcher:
+    """Bounded-queue dynamic batcher over a fixed feed-name set.
+
+    ``feed_names`` fixes the request schema (every request must supply
+    exactly these inputs, each with the same leading row count).
+    Workers drive it via ``next_batch()`` / ``complete()`` / ``fail()``;
+    clients via ``submit()`` (async) or ``predict()`` (sync).
+    """
+
+    def __init__(self, feed_names, buckets=None, queue_capacity=None,
+                 batch_timeout_ms=None, clock=time.monotonic,
+                 input_specs=None):
+        self.feed_names = list(feed_names)
+        # optional {feed: (feature_shape, dtype)}: when set (the replica
+        # pool wires it from the predictor's program), submit() rejects
+        # feature-shape mismatches at ADMISSION — co-batching a bad
+        # request must never poison the innocent requests in its batch
+        self.input_specs = dict(input_specs) if input_specs else None
+        self.buckets = parse_buckets(
+            buckets if buckets is not None
+            else flag("serving_batch_buckets"))
+        self.queue_capacity = int(
+            queue_capacity if queue_capacity is not None
+            else flag("serving_queue_capacity"))
+        if self.queue_capacity <= 0:
+            raise InvalidArgumentError(
+                f"serving queue capacity must be positive, got "
+                f"{self.queue_capacity}")
+        self._batch_timeout_ms = batch_timeout_ms
+        self._clock = clock
+        self._q = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+        self._paused = False
+        # metrics (get-or-create: shared across batcher rebuilds)
+        self._m_depth = gauge("serving/queue_depth")
+        self._m_fill = gauge("serving/batch_fill")
+        self._m_requests = counter("serving/requests_total")
+        self._m_rejected = counter("serving/rejected_total")
+        self._m_expired = counter("serving/deadline_expired_total")
+        self._m_responses = counter("serving/responses_total")
+        self._m_errors = counter("serving/errors_total")
+        self._m_batches = counter("serving/batches_total")
+        self._m_rows = counter("serving/batched_rows_total")
+        self._m_slots = counter("serving/batch_slots_total")
+        self._m_pad = counter("serving/padded_rows_total")
+        self._h_queue = histogram("serving/queue_ms")
+        self._h_assemble = histogram("serving/assemble_ms")
+        self._h_e2e = histogram("serving/e2e_ms")
+        from . import _register_live  # registration for shutdown_all
+
+        _register_live(self)
+
+    # -- client side ---------------------------------------------------------
+
+    @property
+    def max_batch(self) -> int:
+        return self.buckets[-1]
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def _batch_window_s(self) -> float:
+        ms = self._batch_timeout_ms
+        if ms is None:
+            ms = flag("serving_batch_timeout_ms")
+        return max(0.0, float(ms)) / 1e3
+
+    def _validate(self, inputs) -> int:
+        if set(inputs) != set(self.feed_names):
+            raise InvalidArgumentError(
+                f"serving request inputs {sorted(inputs)} != model feeds "
+                f"{sorted(self.feed_names)}")
+        rows = None
+        for n in self.feed_names:
+            a = inputs[n]
+            if a.ndim < 1:
+                raise InvalidArgumentError(
+                    f"serving input {n!r} needs a leading batch axis, "
+                    f"got a scalar")
+            spec = self.input_specs.get(n) if self.input_specs else None
+            if spec is not None and tuple(a.shape[1:]) != tuple(spec[0]):
+                raise InvalidArgumentError(
+                    f"serving input {n!r} has feature shape "
+                    f"{tuple(a.shape[1:])}, model expects {tuple(spec[0])}")
+            if rows is None:
+                rows = int(a.shape[0])
+            elif int(a.shape[0]) != rows:
+                raise InvalidArgumentError(
+                    f"serving input {n!r} has {a.shape[0]} rows, other "
+                    f"inputs have {rows}")
+        if rows == 0:
+            raise InvalidArgumentError("serving request has zero rows")
+        if rows > self.max_batch:
+            raise InvalidArgumentError(
+                f"serving request has {rows} rows > largest batch bucket "
+                f"{self.max_batch}; split the request or raise "
+                "FLAGS_serving_batch_buckets")
+        return rows
+
+    def submit(self, inputs, deadline_ms=None) -> _Request:
+        """Enqueue one request (dict feed_name -> array with leading
+        batch axis). Returns the request handle; ``wait()`` it.
+        Raises :class:`QueueFullError` on a full queue and
+        :class:`ServingClosedError` after ``close()``."""
+        inputs = {n: np.asarray(v) for n, v in inputs.items()}
+        rows = self._validate(inputs)
+        if deadline_ms is None:
+            d = float(flag("serving_default_deadline_ms"))
+            deadline_ms = d if d > 0 else None
+        now = self._clock()
+        deadline = (now + float(deadline_ms) / 1e3
+                    if deadline_ms is not None else None)
+        req = _Request(inputs, rows, deadline, now)
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError(
+                    "serving batcher is shut down; no new requests")
+            if len(self._q) >= self.queue_capacity:
+                self._m_rejected.inc()
+                _flight.record_event(
+                    "serving_reject", reason="queue_full",
+                    depth=len(self._q), capacity=self.queue_capacity)
+                raise QueueFullError(
+                    f"serving queue full ({self.queue_capacity} requests "
+                    "queued); backpressure — retry with backoff")
+            self._q.append(req)
+            self._m_depth.set(len(self._q))
+            self._not_empty.notify()
+        self._m_requests.inc()
+        return req
+
+    def predict(self, inputs, deadline_ms=None, timeout=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(inputs, deadline_ms).wait(timeout)
+
+    # -- worker side ---------------------------------------------------------
+
+    def _pop_expired_locked(self, now):
+        """Drop queue-front requests whose deadline passed (complete them
+        with DeadlineExceededError, no dispatch). Lock held."""
+        while self._q and self._q[0].expired(now):
+            req = self._q.popleft()
+            self._m_depth.set(len(self._q))
+            self._m_expired.inc()
+            _flight.record_event(
+                "serving_deadline_expired", rows=req.rows,
+                queued_ms=round((now - req.t_submit) * 1e3, 3))
+            req.done(error=DeadlineExceededError(
+                f"request deadline passed after "
+                f"{(now - req.t_submit) * 1e3:.1f}ms in queue; "
+                "never dispatched"))
+
+    def next_batch(self, timeout=None):
+        """Assemble the next batch (replica workers call this).
+
+        Blocks up to ``timeout`` seconds for a first live request
+        (``None``: until one arrives or the batcher closes), then holds
+        the batch open for the assembly window to gather more, up to the
+        largest bucket. Returns an assembled :class:`_Batch`, or ``None``
+        on timeout / when closed and drained.
+        """
+        with self._not_empty:
+            first = None
+            wait_until = (self._clock() + timeout
+                          if timeout is not None else None)
+            while first is None:
+                now = self._clock()
+                if not self._paused:
+                    self._pop_expired_locked(now)
+                    if self._q:
+                        first = self._q.popleft()
+                        self._m_depth.set(len(self._q))
+                        break
+                    if self._closed:
+                        return None  # closed and fully drained
+                elif self._closed and not self._q:
+                    return None
+                if wait_until is not None:
+                    remaining = wait_until - now
+                    if remaining <= 0:
+                        return None
+                    self._not_empty.wait(remaining)
+                else:
+                    self._not_empty.wait()
+
+            t_first = self._clock()
+            picked = [first]
+            rows = first.rows
+            window_end = t_first + self._batch_window_s()
+            while rows < self.max_batch:
+                now = self._clock()
+                self._pop_expired_locked(now)
+                if self._q:
+                    nxt = self._q[0]
+                    if rows + nxt.rows > self.max_batch:
+                        break  # next request wouldn't fit: dispatch now
+                    self._q.popleft()
+                    self._m_depth.set(len(self._q))
+                    picked.append(nxt)
+                    rows += nxt.rows
+                    continue
+                if self._closed:
+                    break  # draining: flush without waiting the window
+                remaining = window_end - now
+                if remaining <= 0:
+                    break
+                self._not_empty.wait(remaining)
+
+        # heavy work (concat + pad) outside the lock; any failure here
+        # must fail THESE requests and leave the worker alive — an
+        # unvalidated batcher (no input_specs) can still see
+        # incompatible feature shapes meet in one np.concatenate
+        try:
+            return self._assemble(picked, rows, t_first)
+        except Exception as e:  # noqa: BLE001 — workers must survive
+            for req in picked:
+                req.done(error=e)
+                self._m_errors.inc()
+            _flight.record_event(
+                "serving_assemble_error", rows=rows,
+                requests=len(picked),
+                error=f"{type(e).__name__}: {e}"[:300])
+            return None
+
+    def _assemble(self, picked, rows, t_first):
+        with RecordEvent("serving::assemble"):
+            now = self._clock()
+            for req in picked:
+                self._h_queue.observe((now - req.t_submit) * 1e3)
+            bucket = next(b for b in self.buckets if b >= rows)
+            feed = {}
+            for n in self.feed_names:
+                arr = (picked[0].inputs[n] if len(picked) == 1
+                       else np.concatenate([r.inputs[n] for r in picked]))
+                if bucket > rows:
+                    pad = np.zeros((bucket - rows,) + arr.shape[1:],
+                                   arr.dtype)
+                    arr = np.concatenate([arr, pad])
+                feed[n] = arr
+            t_ready = self._clock()
+            self._h_assemble.observe((t_ready - t_first) * 1e3)
+            self._m_batches.inc()
+            self._m_rows.inc(rows)
+            self._m_slots.inc(bucket)
+            self._m_pad.inc(bucket - rows)
+            self._m_fill.set(rows / bucket)
+            _flight.record_event(
+                "serving_batch", bucket=bucket, rows=rows,
+                requests=len(picked),
+                fill=round(rows / bucket, 4))
+            return _Batch(picked, bucket, rows, feed, t_ready)
+
+    def complete(self, batch, outputs):
+        """Slice the padded per-fetch ``outputs`` back per request and
+        complete each one. Padding rows are discarded here."""
+        now = self._clock()
+        outs = [np.asarray(o) for o in outputs]
+        offset = 0
+        for req in batch.requests:
+            req_out = [o[offset:offset + req.rows] for o in outs]
+            offset += req.rows
+            req.done(result=req_out)
+            self._m_responses.inc()
+            self._h_e2e.observe((now - req.t_submit) * 1e3)
+
+    def fail(self, batch, error):
+        """Complete every request of a failed dispatch with ``error``."""
+        for req in batch.requests:
+            req.done(error=error)
+            self._m_errors.inc()
+        _flight.record_event(
+            "serving_batch_error", bucket=batch.bucket, rows=batch.rows,
+            error=f"{type(error).__name__}: {error}"[:300])
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def pause(self):
+        """Freeze batch hand-out: ``next_batch`` stops popping (requests
+        keep queueing, so the bounded queue exerts backpressure). Takes
+        effect even for workers already blocked inside ``next_batch`` —
+        the deterministic handle the backpressure/deadline tests and
+        maintenance windows need."""
+        with self._lock:
+            self._paused = True
+
+    def resume(self):
+        with self._lock:
+            self._paused = False
+            self._not_empty.notify_all()
+
+    def close(self, drain=True):
+        """Stop accepting new requests. ``drain=True`` leaves queued work
+        for the workers to flush (``next_batch`` keeps returning batches
+        until the queue empties, then ``None``); ``drain=False`` fails
+        everything still queued with :class:`ServingClosedError`."""
+        with self._lock:
+            if self._closed and not self._q:
+                return
+            self._closed = True
+            self._paused = False  # a paused batcher must still drain
+            dropped = []
+            if not drain:
+                dropped = list(self._q)
+                self._q.clear()
+            self._m_depth.set(len(self._q))
+            self._not_empty.notify_all()
+        for req in dropped:
+            req.done(error=ServingClosedError(
+                "serving batcher shut down before dispatch"))
+            self._m_errors.inc()
+        _flight.record_event("serving_batcher_close", drain=drain,
+                             dropped=len(dropped))

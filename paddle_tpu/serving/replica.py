@@ -1,0 +1,219 @@
+"""Replica pool: N worker threads serving one shared executable cache.
+
+Throughput needs concurrent dispatch (one thread's device wait must not
+idle the queue), but naive replication would pay N compiles of the same
+program. ``Predictor.clone()`` shares the underlying Executor — and with
+it the RunPlan + jit/AOT executable caches — so every replica serves
+from the SAME compiled programs: N workers, zero extra compiles
+(asserted: the pool snapshots the jit-miss counter after warmup and
+counts any later miss as an ``unexpected_compile``).
+
+Warmup compiles every bucket of the batcher's ladder ahead of traffic
+(zero-filled synthetic batches through one replica — the shared cache
+warms them all), so the first real request never pays an XLA compile and
+readiness (`/healthz`) can gate on warmup-complete.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..errors import InvalidArgumentError, PreconditionNotMetError
+from ..flags import flag
+from ..monitor import counter, histogram
+from ..monitor import flight_recorder as _flight
+from ..profiler import RecordEvent, counters as _profiler_counters
+
+__all__ = ["ReplicaPool", "predictor_input_specs"]
+
+_JIT_MISS = "executor::jit_cache_miss"
+
+
+def predictor_input_specs(predictor) -> dict:
+    """Per-feed (feature_shape, dtype) from the predictor's program vars:
+    the leading (batch) axis is stripped; remaining dims must be static
+    so warmup can synthesize bucket-shaped batches."""
+    block = predictor._program.global_block()
+    specs = {}
+    for name in predictor.get_input_names():
+        if not block.has_var(name):
+            raise InvalidArgumentError(
+                f"feed {name!r} has no var in the inference program")
+        v = block.var(name)
+        if v.shape is None or len(v.shape) < 1:
+            raise InvalidArgumentError(
+                f"feed {name!r} needs a ranked shape with a leading "
+                f"batch axis, got {v.shape!r}")
+        feat = tuple(int(d) for d in v.shape[1:])
+        if any(d < 0 for d in feat):
+            raise InvalidArgumentError(
+                f"feed {name!r} has dynamic feature dims {v.shape!r}; "
+                "only the leading batch axis may be dynamic for serving")
+        specs[name] = (feat, v.dtype)
+    return specs
+
+
+class ReplicaPool:
+    """Worker threads pulling assembled batches from a DynamicBatcher
+    and dispatching them on Predictor clones."""
+
+    def __init__(self, predictor, batcher, replicas=None):
+        n = int(replicas if replicas is not None else flag("serving_replicas"))
+        if n <= 0:
+            raise InvalidArgumentError(
+                f"serving replica count must be positive, got {n}")
+        self.batcher = batcher
+        self.replicas = n
+        # replica 0 is the caller's predictor; the rest are clones that
+        # share its Executor (and therefore every compiled program)
+        self._preds = [predictor] + [predictor.clone() for _ in range(n - 1)]
+        self._specs = predictor_input_specs(predictor)
+        # arm admission-time feature-shape validation on a bare batcher:
+        # a request that couldn't concatenate must be rejected at
+        # submit(), never fail the batch it was co-assembled into
+        if batcher.input_specs is None:
+            batcher.input_specs = dict(self._specs)
+        self._threads = []
+        self._stop = threading.Event()
+        # cleared = paused (workers park before pulling the next batch);
+        # the 429/drain tests and maintenance windows use this
+        self._live = threading.Event()
+        self._live.set()
+        self.warmed = False
+        self._misses_after_warmup = None
+        self._unexpected = counter("serving/unexpected_compiles")
+        # N workers note compiles concurrently; the read-compare-bump
+        # must be atomic or one miss double-counts
+        self._unexpected_lock = threading.Lock()
+        self._unexpected_seen = 0
+        self._h_dispatch = histogram("serving/dispatch_ms")
+        from . import _register_live
+
+        _register_live(self)
+
+    # -- warmup --------------------------------------------------------------
+
+    def _synthetic_feed(self, bucket):
+        return {
+            name: np.zeros((bucket,) + feat, dtype=dtype)
+            for name, (feat, dtype) in self._specs.items()
+        }
+
+    def warmup(self):
+        """Compile every bucket ahead of traffic on one replica (the
+        shared cache warms all of them), then snapshot the jit-miss
+        counter: any later miss is an unexpected compile. Idempotent."""
+        if self.warmed:
+            return self
+        # warm on a DEDICATED clone: workers may already be serving
+        # direct batcher.submit() traffic on self._preds[0], and
+        # Predictor.run stages inputs through per-predictor IO handles —
+        # sharing one would let warmup's zero batches overwrite a live
+        # request between staging and dispatch. The clone shares the
+        # executable cache, which is all warmup needs.
+        pred = self._preds[0].clone()
+        names = pred.get_input_names()
+        for bucket in self.batcher.buckets:
+            feed = self._synthetic_feed(bucket)
+            with RecordEvent("serving::warmup"):
+                pred.run([feed[n] for n in names])
+        self._misses_after_warmup = _profiler_counters().get(_JIT_MISS, 0)
+        self.warmed = True
+        _flight.record_event(
+            "serving_warmup", buckets=list(self.batcher.buckets),
+            replicas=self.replicas)
+        return self
+
+    def extra_compiles(self) -> int:
+        """Jit-cache misses since warmup — the bounded-compile assertion:
+        steady-state serving must keep this at 0."""
+        if self._misses_after_warmup is None:
+            raise PreconditionNotMetError(
+                "extra_compiles() before warmup(): nothing to compare")
+        return (_profiler_counters().get(_JIT_MISS, 0)
+                - self._misses_after_warmup)
+
+    # -- worker loop ---------------------------------------------------------
+
+    def start(self):
+        if self._threads:
+            return self
+        self._stop.clear()
+        for i, pred in enumerate(self._preds):
+            t = threading.Thread(
+                target=self._worker, args=(i, pred),
+                name=f"ptpu-serving-replica-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _worker(self, idx, pred):
+        names = pred.get_input_names()
+        batcher = self.batcher
+        while True:
+            self._live.wait()
+            if self._stop.is_set() and not (batcher.closed
+                                            and batcher.queue_depth()):
+                break
+            batch = batcher.next_batch(timeout=0.05)
+            if batch is None:
+                if batcher.closed:
+                    break  # closed AND drained
+                continue
+            try:
+                with RecordEvent("serving::dispatch"):
+                    outs = pred.run([batch.feed[n] for n in names])
+                    # materialize before slicing (lazy fetch list)
+                    outs = [np.asarray(o) for o in outs]
+                self._h_dispatch.observe(
+                    (batcher._clock() - batch.t_ready) * 1e3)
+                if self.warmed:
+                    self._note_unexpected_compiles(idx, batch.bucket)
+                batcher.complete(batch, outs)
+            except Exception as e:  # noqa: BLE001 — worker must survive
+                batcher.fail(batch, e)
+
+    def _note_unexpected_compiles(self, replica_idx, bucket):
+        """The ladder invariant broke (a feed escaped the buckets, or
+        the program changed under us): count it loudly rather than
+        silently re-growing the cache. One atomic read-compare-bump."""
+        with self._unexpected_lock:
+            extra = self.extra_compiles()
+            grew = extra - self._unexpected_seen
+            if grew <= 0:
+                return
+            self._unexpected_seen = extra
+            self._unexpected.inc(grew)
+            _flight.record_event(
+                "serving_unexpected_compile", replica=replica_idx,
+                bucket=bucket, total=extra)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def pause(self):
+        """Freeze batch hand-out (in-flight dispatches finish). The gate
+        lives in the batcher, so it holds even for workers already
+        blocked inside ``next_batch`` — queued requests wait and the
+        bounded queue exerts backpressure. The deterministic handle the
+        429/deadline tests and maintenance windows need."""
+        self._live.clear()
+        self.batcher.pause()
+
+    def resume(self):
+        self.batcher.resume()
+        self._live.set()
+
+    @property
+    def alive(self) -> int:
+        return sum(t.is_alive() for t in self._threads)
+
+    def stop(self, drain=True, timeout=10.0):
+        """Stop the workers. ``drain=True`` closes the batcher but lets
+        workers flush everything already queued before they exit."""
+        self.batcher.close(drain=drain)
+        self._stop.set()
+        self._live.set()  # a paused pool must still be able to exit/drain
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = []

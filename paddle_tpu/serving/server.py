@@ -1,0 +1,346 @@
+"""HTTP frontend for the online serving subsystem.
+
+A stdlib ``ThreadingHTTPServer`` (same pattern as
+``monitor/debug_server.py``: no web framework dependency, daemon serving
+threads) exposing:
+
+- ``POST /predict`` — JSON ``{"inputs": {feed: nested-list}, ...}``
+  through the dynamic batcher; responds ``{"outputs": {fetch: ...}}``.
+  Backpressure maps onto status codes instead of unbounded queueing:
+  **429** queue full, **504** deadline expired, **400** malformed
+  request, **503** draining/not ready.
+- ``GET /healthz`` — READINESS, not liveness: 200 only once every batch
+  bucket is compiled (warmup-complete) and the server is not draining;
+  503 otherwise. Load balancers gate on this, so a replica never
+  receives traffic it would stall on with an XLA compile.
+- ``GET /statz`` — serving stats JSON: queue depth, bucket ladder,
+  request/batch counters, batch fill, latency quantiles (p50/p99 from
+  the stage histograms), compile accounting (warmup vs unexpected), and
+  MFU from the cost-model ledger — the ``/clusterz``-style capacity
+  math, extended to serving.
+- ``GET /metrics`` — the Prometheus dump (every ``serving/*`` metric
+  rides the same exporter the training stack uses).
+
+``stop(drain=True)`` is a graceful drain: new work is refused (503),
+queued work is flushed through the replicas, waiting HTTP handlers get
+their real responses, then the listener closes.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..errors import InvalidArgumentError
+from ..monitor import cost_model as _cost
+from ..monitor import flight_recorder as _flight
+from ..monitor import histogram_quantile, registry_snapshot
+from .batcher import (
+    DeadlineExceededError,
+    DynamicBatcher,
+    QueueFullError,
+    ServingClosedError,
+)
+from .replica import ReplicaPool
+
+__all__ = ["InferenceServer"]
+
+
+def _json_default(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    return str(o)
+
+
+class _ServingHandler(BaseHTTPRequestHandler):
+    server_version = "ptpu-serving/1"
+
+    def log_message(self, *args):  # no per-request stderr chatter
+        pass
+
+    @property
+    def _srv(self):
+        return self.server._inference_server
+
+    def _reply(self, status, payload, ctype="application/json"):
+        body = (payload if isinstance(payload, str)
+                else json.dumps(payload, default=_json_default))
+        data = body.encode("utf-8")
+        self.send_response(status)
+        self.send_header("Content-Type", f"{ctype}; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        srv = self._srv
+        if path == "/healthz":
+            ready = srv.ready
+            self._reply(200 if ready else 503, srv.healthz())
+        elif path == "/statz":
+            self._reply(200, srv.statz())
+        elif path == "/metrics":
+            from ..monitor.export import (
+                PROMETHEUS_CONTENT_TYPE,
+                prometheus_text,
+            )
+
+            self._reply(200, prometheus_text(), PROMETHEUS_CONTENT_TYPE)
+        elif path == "/":
+            self._reply(200, {
+                "service": "paddle_tpu serving",
+                "routes": ["/predict (POST)", "/healthz", "/statz",
+                           "/metrics"]})
+        else:
+            self._reply(404, {"error": f"unknown path {path!r}"})
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path != "/predict":
+            self._reply(404, {"error": f"unknown path {path!r}"})
+            return
+        srv = self._srv
+        if not srv.ready:
+            self._reply(503, {"error": "not ready"
+                              if not srv.draining else "draining"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise InvalidArgumentError(
+                    "request body must be a JSON object with an "
+                    '"inputs" key')
+            inputs = self._parse_inputs(body)
+            deadline_ms = body.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)  # "abc" -> 400, not 500
+        except (ValueError, TypeError, InvalidArgumentError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            req = srv.batcher.submit(inputs, deadline_ms=deadline_ms)
+        except QueueFullError as e:
+            self._reply(429, {"error": str(e)})
+            return
+        except ServingClosedError as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except InvalidArgumentError as e:
+            self._reply(400, {"error": str(e)})
+            return
+        try:
+            outs = req.wait(srv.request_timeout_s)
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — a bad batch must answer
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {
+            "outputs": {n: o.tolist()
+                        for n, o in zip(srv.fetch_names, outs)},
+            "rows": int(req.rows),
+        })
+
+    def _parse_inputs(self, body) -> dict:
+        srv = self._srv
+        raw = body.get("inputs")
+        if raw is None:
+            raise InvalidArgumentError('request body needs an "inputs" key')
+        # single-input convenience: a bare nested list maps to the feed
+        if not isinstance(raw, dict):
+            if len(srv.feed_names) != 1:
+                raise InvalidArgumentError(
+                    f'"inputs" must be a dict naming the feeds '
+                    f"{srv.feed_names}")
+            raw = {srv.feed_names[0]: raw}
+        parsed = {}
+        for name, val in raw.items():
+            spec = srv.input_specs.get(name)
+            dtype = spec[1] if spec else None
+            try:
+                arr = np.asarray(val, dtype=dtype)
+            except (ValueError, TypeError) as e:
+                raise InvalidArgumentError(
+                    f"input {name!r} is not a well-formed {dtype} "
+                    f"array: {e}") from None
+            parsed[name] = arr
+        return parsed
+
+
+class InferenceServer:
+    """Composed serving stack: HTTP frontend -> DynamicBatcher ->
+    ReplicaPool over one shared-executable Predictor.
+
+    ``port=0`` binds an ephemeral port (tests, smoke). ``start()`` runs
+    warmup by default so ``/healthz`` flips to ready only after every
+    bucket is compiled; pass ``warmup=False`` and call :meth:`warmup`
+    later to observe the readiness gate from outside.
+    """
+
+    def __init__(self, predictor, port=0, host="127.0.0.1", replicas=None,
+                 buckets=None, queue_capacity=None, batch_timeout_ms=None,
+                 request_timeout_s=60.0):
+        self.feed_names = list(predictor.get_input_names())
+        self.fetch_names = list(predictor.get_output_names())
+        self.batcher = DynamicBatcher(
+            self.feed_names, buckets=buckets,
+            queue_capacity=queue_capacity,
+            batch_timeout_ms=batch_timeout_ms)
+        self.pool = ReplicaPool(predictor, self.batcher, replicas=replicas)
+        self.input_specs = self.pool._specs
+        self.request_timeout_s = request_timeout_s
+        self._httpd = ThreadingHTTPServer((host, int(port)),
+                                          _ServingHandler)
+        self._httpd.daemon_threads = True
+        self._httpd._inference_server = self
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+        self._t0 = time.monotonic()
+        # MFU baseline: the executed-work ledger is process-global (a
+        # model.fit before model.serve leaves training FLOPs in it);
+        # statz attributes only the delta since construction to serving
+        self._flops0 = registry_snapshot().get(
+            "cost/executed_flops", {}).get("value", 0.0)
+        self.draining = False
+        self._stopped = False
+        from . import _register_live
+
+        _register_live(self)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def ready(self) -> bool:
+        return self.pool.warmed and not self.draining
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, warmup=True):
+        """Start replica workers and the HTTP listener; by default also
+        warm every bucket so the server comes up ready."""
+        self.pool.start()
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=f"ptpu-serving:{self.port}", daemon=True)
+            self._thread.start()
+        _flight.record_event(
+            "serving_start", port=self.port,
+            replicas=self.pool.replicas,
+            buckets=list(self.batcher.buckets))
+        if warmup:
+            self.warmup()
+        return self
+
+    def warmup(self):
+        self.pool.warmup()
+        return self
+
+    def stop(self, drain=True, timeout=10.0):
+        """Graceful shutdown: refuse new work (healthz -> 503,
+        /predict -> 503), flush queued work through the replicas when
+        ``drain``, then close the listener."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self.draining = True
+        self.pool.stop(drain=drain, timeout=timeout)  # closes the batcher
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+        _flight.record_event("serving_stop", port=self.port, drain=drain)
+
+    # -- introspection payloads ---------------------------------------------
+
+    def healthz(self) -> dict:
+        return {
+            "ready": self.ready,
+            "warmed": self.pool.warmed,
+            "draining": self.draining,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "buckets": list(self.batcher.buckets),
+            "replicas": self.pool.replicas,
+            "queue_depth": self.batcher.queue_depth(),
+            "queue_capacity": self.batcher.queue_capacity,
+        }
+
+    def statz(self) -> dict:
+        snap = registry_snapshot()
+
+        def val(name):
+            return snap.get(name, {}).get("value", 0)
+
+        from ..monitor import all_metrics
+
+        metrics = all_metrics()
+
+        def quantiles(name):
+            h = metrics.get(name)
+            if h is None or h.kind != "histogram" or h.count == 0:
+                return None
+            return {"p50_ms": round(histogram_quantile(h, 0.5), 3),
+                    "p99_ms": round(histogram_quantile(h, 0.99), 3),
+                    "count": h.count}
+
+        batches = val("serving/batches_total")
+        slots = val("serving/batch_slots_total")
+        rows = val("serving/batched_rows_total")
+        out = {
+            **self.healthz(),
+            "requests": {
+                "submitted": val("serving/requests_total"),
+                "completed": val("serving/responses_total"),
+                "rejected_429": val("serving/rejected_total"),
+                "deadline_expired": val("serving/deadline_expired_total"),
+                "errors": val("serving/errors_total"),
+            },
+            "batches": {
+                "dispatched": batches,
+                "rows": rows,
+                "padded_rows": val("serving/padded_rows_total"),
+                "mean_fill": round(rows / slots, 4) if slots else 0.0,
+            },
+            "latency": {
+                "queue": quantiles("serving/queue_ms"),
+                "assemble": quantiles("serving/assemble_ms"),
+                "dispatch": quantiles("serving/dispatch_ms"),
+                "e2e": quantiles("serving/e2e_ms"),
+            },
+            "compiles": {
+                "buckets": len(self.batcher.buckets),
+                "unexpected": val("serving/unexpected_compiles"),
+            },
+        }
+        # capacity math from the cost-model ledger: the executor dispatches
+        # every serving batch, so executed FLOPs accumulate there; over
+        # server uptime that is average achieved FLOP/s -> MFU against the
+        # device peak (the /clusterz denominator, extended to serving)
+        uptime = max(time.monotonic() - self._t0, 1e-9)
+        executed = val("cost/executed_flops") - self._flops0
+        peaks = _cost.device_peaks()
+        out["utilization"] = {
+            "executed_flops": executed,
+            "mfu_avg": round(_cost.mfu(executed / uptime, peaks), 6),
+            "device_kind": peaks.get("kind"),
+            "peaks_nominal": peaks.get("nominal"),
+        }
+        return out

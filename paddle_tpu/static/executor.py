@@ -23,6 +23,7 @@ construction; lax.while_loop is not (use scan for trainable loops).
 from __future__ import annotations
 
 import contextlib
+import threading
 from functools import partial
 
 import numpy as np
@@ -697,22 +698,30 @@ class Executor:
         self._cache_limit = 128  # compiled-block LRU bound
         self._plans = {}
         self._plan_cache_limit = 64  # RunPlan LRU bound
+        # serving replica pools run one Executor from N worker threads
+        # (Predictor.clone shares it so compiles are shared); the LRU
+        # pop-and-reinsert refreshes are not atomic, so cache BOOKKEEPING
+        # takes this lock. Dispatch itself stays outside it — concurrent
+        # device execution is the point of the pool.
+        self._cache_lock = threading.Lock()
 
     def _plan_for(self, program):
         """RunPlan cache lookup (LRU, counter-instrumented). Returns
         (plan, "hit"|"miss") so run() can put the cache disposition in
         the flight-recorder event without re-deriving it."""
         key = _plan_key(program)
-        plan = self._plans.get(key)
-        if plan is not None:
-            self._plans[key] = self._plans.pop(key)  # refresh LRU order
-            bump_counter("executor::plan_cache_hit")
-            return plan, "hit"
+        with self._cache_lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._plans[key] = self._plans.pop(key)  # refresh LRU order
+                bump_counter("executor::plan_cache_hit")
+                return plan, "hit"
         bump_counter("executor::plan_cache_miss")
         plan = RunPlan(program)
-        self._plans[key] = plan
-        while len(self._plans) > self._plan_cache_limit:
-            self._plans.pop(next(iter(self._plans)))
+        with self._cache_lock:
+            self._plans[key] = plan
+            while len(self._plans) > self._plan_cache_limit:
+                self._plans.pop(next(iter(self._plans)))
         return plan, "miss"
 
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -767,39 +776,47 @@ class Executor:
                 tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
                 persist_in, donate_enabled,
             )
-        entry = self._cache.get(sig)
-        first_run = entry is None
-        if entry is None:
-            bump_counter("executor::jit_cache_miss")
-            _sync_persistent_cache()
-            # donate the persistables the program statically writes
-            # (params, optimizer state): XLA aliases each update into the
-            # input buffer. Read-only persistables are held undonated.
-            if donate_enabled:
-                donate_names = tuple(
-                    n for n in persist_in if n in plan.written_names)
+        with self._cache_lock:
+            entry = self._cache.get(sig)
+            first_run = entry is None
+            if entry is None:
+                bump_counter("executor::jit_cache_miss")
+                _sync_persistent_cache()
+                # donate the persistables the program statically writes
+                # (params, optimizer state): XLA aliases each update into
+                # the input buffer. Read-only persistables are held
+                # undonated.
+                if donate_enabled:
+                    donate_names = tuple(
+                        n for n in persist_in if n in plan.written_names)
+                else:
+                    donate_names = ()
+                hold_names = tuple(
+                    n for n in persist_in if n not in donate_names)
+                traced = _trace_block(program, block, plan.op_list,
+                                      feed_names, fetch_names,
+                                      donate_names, hold_names)
+                jitted = jax.jit(
+                    traced, donate_argnums=(1,) if donate_names else ())
+                # [AOT executable, CostRecord, aot-attempted, per-entry
+                # lock]: filled on the first run (lower/compile once,
+                # cost-captured); a backend that rejects the AOT path
+                # leaves [None, None, True] and the entry dispatches
+                # through jax.jit forever after. The lock serializes the
+                # one-time compile across replica worker threads racing
+                # the same cold signature — without it both pay a full
+                # duplicated XLA compile (and double cost-capture).
+                entry = (jitted, donate_names, hold_names,
+                         [None, None, False, threading.Lock()])
+                self._cache[sig] = entry
+                # LRU-style eviction: a long-lived Executor fed many
+                # program versions (notebooks, unit-test loops) must not
+                # grow the cache unboundedly
+                while len(self._cache) > self._cache_limit:
+                    self._cache.pop(next(iter(self._cache)))
             else:
-                donate_names = ()
-            hold_names = tuple(
-                n for n in persist_in if n not in donate_names)
-            traced = _trace_block(program, block, plan.op_list, feed_names,
-                                  fetch_names, donate_names, hold_names)
-            jitted = jax.jit(
-                traced, donate_argnums=(1,) if donate_names else ())
-            # [AOT executable, CostRecord, aot-attempted]: filled on the
-            # first run (lower/compile once, cost-captured); a backend
-            # that rejects the AOT path leaves [None, None, True] and the
-            # entry dispatches through jax.jit forever after
-            entry = (jitted, donate_names, hold_names, [None, None, False])
-            self._cache[sig] = entry
-            # LRU-style eviction: a long-lived Executor fed many program
-            # versions (notebooks, unit-test loops) must not grow the
-            # cache unboundedly
-            while len(self._cache) > self._cache_limit:
-                self._cache.pop(next(iter(self._cache)))
-        else:
-            bump_counter("executor::jit_cache_hit")
-            self._cache[sig] = self._cache.pop(sig)  # refresh LRU order
+                bump_counter("executor::jit_cache_hit")
+                self._cache[sig] = self._cache.pop(sig)  # refresh LRU
         jitted, donate_names, hold_names, aot_slot = entry
 
         # flight-recorder breadcrumb: which program ran, and whether the
@@ -835,18 +852,22 @@ class Executor:
                     # first call would do) so the compiled module's own
                     # cost_analysis/memory_analysis land in the cost-model
                     # registry — utilization from what XLA actually built,
-                    # not an estimate
-                    aot_slot[2] = True
-                    try:
-                        lowered = jitted.lower(
-                            feed_arrays, donated, held, base_key)
-                        aot_slot[0] = lowered.compile()
-                        aot_slot[1] = _cost.capture(
-                            "executor", lowered=lowered,
-                            compiled=aot_slot[0], key=sig,
-                            program=program_id)
-                    except Exception:
-                        aot_slot[0] = None  # jax without AOT: jit path
+                    # not an estimate. Double-checked under the per-entry
+                    # lock: a second worker on the same cold signature
+                    # waits for the executable instead of recompiling.
+                    with aot_slot[3]:
+                        if not aot_slot[2]:
+                            try:
+                                lowered = jitted.lower(
+                                    feed_arrays, donated, held, base_key)
+                                aot_slot[0] = lowered.compile()
+                                aot_slot[1] = _cost.capture(
+                                    "executor", lowered=lowered,
+                                    compiled=aot_slot[0], key=sig,
+                                    program=program_id)
+                            except Exception:
+                                aot_slot[0] = None  # no AOT: jit path
+                            aot_slot[2] = True
                 runner = aot_slot[0] if aot_slot[0] is not None else jitted
                 try:
                     fetches, donated_out, extra = runner(

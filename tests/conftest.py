@@ -57,8 +57,12 @@ def _reset_telemetry():
     registry): zero it after every test so bump_counter/metric state
     cannot leak across test files and order-couple assertions."""
     yield
-    from paddle_tpu import monitor, profiler
+    from paddle_tpu import monitor, profiler, serving
 
+    # serving first: live servers/pools/batchers own daemon threads that
+    # keep bumping metrics — shut the subsystem down BEFORE zeroing, so
+    # no thread leaks (or stray counter bump) crosses into the next test
+    serving.shutdown_all()
     profiler.reset_counters()
     monitor.reset_registry(unregister=True)
     monitor.cost_model.reset_cost_records()

@@ -13,6 +13,7 @@
 #   check - static gates: op coverage + API spec + graft entry self-test
 #           + debugz smoke (debug server endpoints + flight-recorder dump)
 #           + mfu smoke (cost-model capture + utilization endpoints)
+#           + serving smoke (online batcher/replica/HTTP contracts)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -78,6 +79,8 @@ case "$MODE" in
     JAX_PLATFORMS=cpu python tools/debugz_smoke.py
     # utilization smoke: cost-model capture, MFU monitor line, /costz+/clusterz
     JAX_PLATFORMS=cpu python tools/utilization_smoke.py
+    # serving smoke: warmed-bucket readiness, bounded compiles, 429, drain
+    JAX_PLATFORMS=cpu python tools/serving_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

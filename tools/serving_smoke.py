@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""CI smoke for the online serving subsystem (`make serve-smoke`).
+
+Stands up the full stack — LeNet exported through ``jit.save``, loaded
+into an inference ``Predictor``, served by ``InferenceServer`` (dynamic
+batcher + replica pool + HTTP frontend) — and asserts the production
+contracts end to end:
+
+- readiness gating: ``/healthz`` is 503 until every batch bucket is
+  warmed, 200 after;
+- bounded compiles: warmup + a burst of mixed-size requests cost exactly
+  ``len(buckets)`` jit-cache misses (profiler counters);
+- correctness: batched-and-padded responses match direct
+  ``Predictor.run`` results;
+- backpressure: a full admission queue answers 429, not unbounded
+  queueing;
+- graceful drain: ``stop(drain=True)`` completes in-flight work, kills
+  the workers, and closes the listener.
+
+Exit 0 on success; nothing here depends on wall-clock timing beyond
+generous waits — a failure is a real serving regression.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+from urllib.error import HTTPError, URLError
+from urllib.request import Request, urlopen
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+BUCKETS = (1, 2, 4)
+QUEUE_CAPACITY = 4
+
+
+def _post(url, payload):
+    body = json.dumps(payload).encode()
+    try:
+        r = urlopen(Request(url + "/predict", data=body,
+                            headers={"Content-Type": "application/json"}))
+        return r.status, json.loads(r.read())
+    except HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.models import LeNet
+    from paddle_tpu.serving import InferenceServer
+
+    paddle.seed(0)
+    net = LeNet()
+    model_dir = tempfile.mkdtemp(prefix="ptpu_serve_smoke_")
+    paddle.jit.save(net, model_dir,
+                    input_spec=[paddle.jit.InputSpec([None, 1, 28, 28])])
+    pred = create_predictor(Config(model_dir))
+
+    # reference results from a SEPARATE predictor (its own Executor, so
+    # its compiles don't pre-warm the serving cache and the bounded-
+    # compile accounting below stays exact)
+    pred_ref = create_predictor(Config(model_dir))
+    rng = np.random.RandomState(0)
+    sizes = [1, 2, 3, 1, 2, 3]
+    refs = []
+    for i, rows in enumerate(sizes):
+        a = rng.randn(rows, 1, 28, 28).astype("float32")
+        refs.append((a, np.asarray(pred_ref.run([a])[0])))
+
+    srv = InferenceServer(pred, port=0, replicas=2, buckets=BUCKETS,
+                          queue_capacity=QUEUE_CAPACITY,
+                          batch_timeout_ms=1.0)
+    try:
+        # -- readiness gating ------------------------------------------
+        srv.start(warmup=False)
+        try:
+            urlopen(srv.url + "/healthz")
+            raise AssertionError("/healthz must be 503 before warmup")
+        except HTTPError as e:
+            assert e.code == 503, e.code
+
+        misses0 = profiler.counters().get("executor::jit_cache_miss", 0)
+        srv.warmup()
+        warm_misses = (profiler.counters().get("executor::jit_cache_miss",
+                                               0) - misses0)
+        assert warm_misses == len(BUCKETS), (
+            f"warmup cost {warm_misses} compiles, expected {len(BUCKETS)}")
+        hz = json.loads(urlopen(srv.url + "/healthz").read())
+        assert hz["ready"] and hz["warmed"], hz
+
+        # -- mixed-size requests: 200s + padding-parity ----------------
+        for a, ref in refs:
+            status, out = _post(srv.url, {"inputs": a.tolist()})
+            assert status == 200, (status, out)
+            got = np.asarray(next(iter(out["outputs"].values())),
+                             dtype="float32")
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        total = (profiler.counters().get("executor::jit_cache_miss", 0)
+                 - misses0)
+        assert total == len(BUCKETS), (
+            f"mixed traffic grew compiles to {total}; the bucket ladder "
+            "must bound them")
+        assert srv.pool.extra_compiles() == 0
+
+        # -- 429 backpressure under a full queue -----------------------
+        srv.pool.pause()
+        feed = srv.feed_names[0]
+        parked = [srv.batcher.submit(
+            {feed: np.zeros((1, 1, 28, 28), "float32")})
+            for _ in range(QUEUE_CAPACITY)]
+        status, out = _post(
+            srv.url, {"inputs": np.zeros((1, 1, 28, 28)).tolist()})
+        assert status == 429, (status, out)
+        srv.pool.resume()
+        for req in parked:  # queued work completes after resume
+            assert len(req.wait(timeout=30)) >= 1
+        sz = json.loads(urlopen(srv.url + "/statz").read())
+        assert sz["requests"]["rejected_429"] >= 1, sz["requests"]
+
+        # -- clean drain ----------------------------------------------
+        results = []
+
+        def client():
+            a = np.zeros((2, 1, 28, 28), "float32")
+            results.append(_post(srv.url, {"inputs": a.tolist()})[0])
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results == [200, 200, 200], results
+        srv.stop(drain=True)
+        assert srv.pool.alive == 0, "replica workers survived drain"
+        try:
+            urlopen(srv.url + "/healthz", timeout=2)
+            raise AssertionError("listener still up after stop()")
+        except (URLError, ConnectionError, OSError):
+            pass
+        print(f"serve-smoke OK: {len(BUCKETS)} buckets = {total} compiles, "
+              f"{sz['requests']['completed']} served, mean fill "
+              f"{sz['batches']['mean_fill']}, 429 + drain verified")
+        return 0
+    finally:
+        srv.stop(drain=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
