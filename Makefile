@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -49,6 +49,9 @@ mfu-smoke:  # cost-model capture + MFU line + /costz /clusterz endpoints
 
 serve-smoke:  # online serving: readiness gating, bounded compiles, 429, drain
 	JAX_PLATFORMS=cpu python tools/serving_smoke.py
+
+gen-smoke:  # generative serving: prefill ladder + compile-once decode, parity, streaming, drain
+	JAX_PLATFORMS=cpu python tools/generation_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

@@ -275,6 +275,58 @@ define_flag("serving_default_deadline_ms", 0.0,
             "default per-request serving deadline in ms (0: none); "
             "expired requests error without dispatch")
 
+# generation/engine.py — capacity (tokens) of the static-shape ring KV
+# cache per decode slot. Shapes never change across decode steps, so one
+# compiled step serves every sequence length; past the window the ring
+# overwrites the oldest token (sliding-window attention of this width —
+# the model computes the same function, golden-tested).
+define_flag("generation_kv_cache_len", 256,
+            "per-slot ring KV cache capacity (tokens) for autoregressive "
+            "decoding; also the sliding attention window width")
+
+# generation/engine.py — the sequence-length bucket ladder for prefill.
+# Prompts pad up to the smallest covering bucket, so prefill costs at
+# most len(ladder) compiles ever — the serving batch-bucket discipline,
+# applied to the sequence axis.
+define_flag("generation_prefill_buckets", "16,32,64,128",
+            "comma-separated ascending prompt-length buckets for "
+            "generation prefill; each bucket is one compiled shape")
+
+# generation/engine.py + serving/continuous.py — concurrent decode slots
+# in the continuous-batching step. A finished sequence vacates its slot
+# mid-batch and the next queued request is admitted at the next step;
+# the decode program's batch axis is always exactly this many rows.
+define_flag("generation_decode_slots", 4,
+            "decode slots co-batched in the compiled generation step "
+            "(continuous batching admits into vacant slots mid-batch)")
+
+# generation/engine.py — default generation budget when the request does
+# not set one.
+define_flag("generation_max_new_tokens", 64,
+            "default max tokens generated per request (requests may "
+            "override below the model's position limit)")
+
+# generation/engine.py — default sampling temperature; 0 = greedy
+# (argmax). Per-request temperatures are traced values: any mix of
+# greedy and sampled requests co-batches in the one compiled step.
+define_flag("generation_temperature", 0.0,
+            "default sampling temperature (0: greedy argmax); "
+            "per-request override is compile-free")
+
+# generation/engine.py — top-k filter width; 0 disables. STATIC: a
+# different k is a different compiled program, so it is an engine-level
+# knob, not a per-request one (the compile-once guarantee).
+define_flag("generation_top_k", 0,
+            "top-k sampling filter for generation (0: full distribution); "
+            "engine-level — changing it recompiles the decode step")
+
+# serving/continuous.py — bounded admission queue for generation
+# requests, same backpressure contract as serving_queue_capacity (full
+# queue -> QueueFullError -> HTTP 429).
+define_flag("generation_queue_capacity", 128,
+            "max generation requests queued for decode slots before "
+            "rejecting (backpressure: HTTP 429)")
+
 # static/executor.py — JAX persistent compilation cache directory: repeated
 # process starts skip XLA recompilation of unchanged programs (the role of
 # TVM's ahead-of-time compiled module artifact). Empty string disables.

@@ -1,0 +1,48 @@
+"""Generative (autoregressive) inference subsystem.
+
+Turns a causal LM into a compile-bound token stream:
+
+- :mod:`generation.cache` — static-shape ring KV cache pytree + the
+  causal/cache mask composition (O(1) memory per sequence, functional
+  index-update writes so decode shapes never change).
+- :mod:`generation.sampling` — greedy / temperature / top-k sampling,
+  pure jnp (traced into the compiled steps), plus the shared eager
+  ``decode_loop`` the seq2seq model delegates to.
+- :mod:`generation.engine` — :class:`GenerationEngine`: prefill padded
+  to a sequence-length bucket ladder, ONE jitted decode step for every
+  slot, warmup + compile accounting (``generation::compile`` /
+  ``extra_compiles() == 0`` in steady state).
+
+Continuous batching over the engine (slot turnover mid-batch, HTTP
+``/generate``) lives in :mod:`paddle_tpu.serving.continuous` /
+:class:`paddle_tpu.serving.GenerationServer`.
+
+Quickstart::
+
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny_config
+    from paddle_tpu.generation import GenerationEngine
+
+    engine = GenerationEngine(GPTForCausalLM(gpt_tiny_config()),
+                              slots=4, cache_len=64).warmup()
+    tokens = engine.generate([[5, 6, 7]], max_new_tokens=16)[0]
+"""
+from __future__ import annotations
+
+from ..nn.transformer import StaticCache, causal_mask  # noqa: F401
+from .cache import (  # noqa: F401
+    decode_mask,
+    init_cache,
+    insert_slot,
+    layer_caches,
+    prefill_mask,
+    stack_layer_caches,
+)
+from .engine import COMPILE_COUNTER, GenerationEngine  # noqa: F401
+from .sampling import decode_loop, sample_logits, top_k_filter  # noqa: F401
+
+__all__ = [
+    "GenerationEngine", "COMPILE_COUNTER", "StaticCache", "causal_mask",
+    "sample_logits", "top_k_filter", "decode_loop",
+    "init_cache", "layer_caches", "stack_layer_caches", "insert_slot",
+    "decode_mask", "prefill_mask",
+]

@@ -1,0 +1,97 @@
+"""Static-shape ring KV cache plumbing for compile-once decoding.
+
+The per-layer cache itself is :class:`paddle_tpu.nn.StaticCache`
+(``nn/transformer.py``): fixed ``[B, H, C, D]`` K/V arrays written by
+functional index updates, ring-wrapping at capacity ``C``. This module
+holds the ENGINE-side pieces — the stacked whole-model cache pytree and
+the mask composition that makes the static window numerically exact:
+
+- an all-layers cache is a ``[L, B, H, C, D]`` pair plus one shared
+  ``pos [B]`` vector, so slot-level operations (insert a prefilled
+  sequence, reset a vacated slot) are single indexed updates;
+- ``decode_mask``/``prefill_mask`` compose the causal constraint with
+  cache validity (entries beyond ``pos`` are zeros, never attended) into
+  one additive mask per step. Because the ring keeps exactly the last
+  ``C`` tokens, decoding with the cache equals a FULL forward under a
+  sliding window of width ``C`` (``nn.causal_mask(T, window=C)``) —
+  the parity contract the goldens in tests/test_generation.py pin,
+  including wraparound past the window.
+
+Everything here is shape-static: the same jitted program serves every
+sequence length, so steady-state generation is compile-bound at
+1 decode compile + one prefill compile per ladder bucket.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..nn.transformer import StaticCache
+
+__all__ = [
+    "init_cache", "layer_caches", "stack_layer_caches", "insert_slot",
+    "decode_mask", "prefill_mask",
+]
+
+NEG_INF = -1e9
+
+
+def init_cache(num_layers, batch, num_heads, cache_len, head_dim,
+               dtype="float32"):
+    """Zeroed whole-model cache: ``(k [L,B,H,C,D], v [...], pos [B])``."""
+    shape = (int(num_layers), int(batch), int(num_heads), int(cache_len),
+             int(head_dim))
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+            jnp.zeros((int(batch),), jnp.int32))
+
+
+def layer_caches(ck, cv, pos):
+    """Slice the stacked cache into per-layer :class:`StaticCache` views
+    (``pos`` is shared — every layer writes the same step)."""
+    return [StaticCache(ck[i], cv[i], pos) for i in range(ck.shape[0])]
+
+
+def stack_layer_caches(caches):
+    """Re-stack per-layer caches returned by the model into the
+    ``(k, v)`` whole-model arrays."""
+    return (jnp.stack([c.k for c in caches]),
+            jnp.stack([c.v for c in caches]))
+
+
+def insert_slot(ck, cv, pos, slot, new_k, new_v, length):
+    """Install one prefilled sequence (``new_k/new_v [L, H, C, D]``)
+    into decode slot ``slot`` and set its position to ``length`` — the
+    admission write of continuous batching, a functional indexed update
+    so the batch program never recompiles when a slot turns over."""
+    ck = ck.at[:, slot].set(new_k)
+    cv = cv.at[:, slot].set(new_v)
+    return ck, cv, pos.at[slot].set(length)
+
+
+def decode_mask(pos, cache_len, dtype="float32"):
+    """Additive ``[B, 1, 1, C]`` mask for one decode step.
+
+    The step's query (absolute position ``pos``) may attend every cache
+    entry already written INCLUDING itself — entry count after the write
+    is ``min(pos + 1, C)``; once the ring has wrapped, all ``C`` entries
+    are live and hold exactly the last ``C`` tokens (the sliding
+    window).
+    """
+    c = int(cache_len)
+    keep = jnp.arange(c)[None, :] < jnp.minimum(pos + 1, c)[:, None]
+    return jnp.where(keep, 0.0, NEG_INF).astype(dtype)[:, None, None, :]
+
+
+def prefill_mask(bucket, cache_len, length, dtype="float32"):
+    """Additive ``[1, 1, P, C]`` mask for a bucketed prefill.
+
+    Query ``t`` keeps cache entry ``j`` iff causal (``j <= t``) and the
+    entry holds a REAL prompt token (``j < length`` — bucket padding
+    beyond the true prompt writes garbage K/V that must never be
+    attended; decode later overwrites those entries in ring order before
+    each becomes valid). Padding QUERIES (``t >= length``) produce
+    garbage logits the engine never reads.
+    """
+    t = jnp.arange(int(bucket))[:, None]
+    j = jnp.arange(int(cache_len))[None, :]
+    keep = (j <= t) & (j < length)
+    return jnp.where(keep, 0.0, NEG_INF).astype(dtype)[None, None]

@@ -1,0 +1,370 @@
+"""Compile-once generation engine: bucketed prefill + O(1)-cache decode.
+
+The serving batcher bounds the BATCH axis with a powers-of-two bucket
+ladder; autoregressive decoding re-opens the same compile-explosion on
+the SEQUENCE axis (every prompt length and every growing context is a
+new XLA program if shapes are dynamic). The engine closes it with a
+prefill/decode split:
+
+- **Prefill** pads the prompt up to a sequence-length bucket ladder
+  (``FLAGS_generation_prefill_buckets``) and runs ONE full forward over
+  the bucket, writing K/V into the admitted slot of the static ring
+  cache — one compile per ladder bucket, ever.
+- **Decode** is a single jitted step over ALL decode slots: read last
+  tokens ``[S]``, attend the static cache window, sample, write back —
+  its shapes never depend on sequence length or slot turnover, so its
+  steady-state compile count is exactly 1 (asserted in tests and the
+  gen-smoke the same way ``serving/unexpected_compiles`` is).
+
+Compile accounting mirrors the serving pool: every new signature is AOT
+lowered/compiled through the cost model (so decode MFU lands in the
+``/statz`` ledger) and bumps the ``generation::compile`` profiler
+counter — warmup snapshots it, and ``extra_compiles()`` must stay 0
+under any traffic mix.
+
+The engine is single-threaded by design (one decode stream per model
+replica); :mod:`paddle_tpu.serving.continuous` drives it from a slot
+scheduler for continuous batching, and :meth:`generate` runs the same
+slot loop inline for offline use (bench, tests, parity goldens).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict, deque
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..errors import InvalidArgumentError
+from ..flags import flag
+from ..framework.jit import functional_call
+from ..monitor import cost_model as _cost
+from ..monitor import flight_recorder as _flight
+from ..profiler import RecordEvent, bump_counter, counters as _counters
+from . import cache as _cache
+from .sampling import sample_logits
+
+__all__ = ["GenerationEngine", "COMPILE_COUNTER"]
+
+COMPILE_COUNTER = "generation::compile"
+
+
+class GenerationEngine:
+    """Slot-structured generation over a causal LM.
+
+    ``model`` must expose ``forward(input_ids, position_ids,
+    attention_mask, caches) -> (logits, caches)`` with per-layer
+    :class:`nn.StaticCache` support plus ``cache_spec()`` (GPTForCausalLM
+    is the reference implementation). The engine owns the stacked ring
+    cache for ``slots`` concurrent sequences and exposes the two
+    scheduler primitives: :meth:`admit` (prefill a prompt into a vacant
+    slot, returns the first sampled token) and :meth:`step` (decode one
+    token for every slot).
+    """
+
+    def __init__(self, model, *, slots=None, cache_len=None,
+                 prefill_buckets=None, eos_id=None, pad_id=None,
+                 max_new_tokens=None, temperature=None, top_k=None,
+                 seed=0):
+        # lazy: serving imports generation's scheduler, so module-level
+        # imports the other way would cycle
+        from ..serving.batcher import parse_buckets
+        from ..serving.replica import CompileWatch
+
+        self.model = model
+        model.eval()  # generation never wants dropout
+        cfg = getattr(model, "config", None)
+        self.slots = int(slots if slots is not None
+                         else flag("generation_decode_slots"))
+        self.cache_len = int(cache_len if cache_len is not None
+                             else flag("generation_kv_cache_len"))
+        self.prefill_buckets = parse_buckets(
+            prefill_buckets if prefill_buckets is not None
+            else flag("generation_prefill_buckets"))
+        if self.slots <= 0:
+            raise InvalidArgumentError(
+                f"generation needs at least one decode slot, got {self.slots}")
+        if self.prefill_buckets[-1] > self.cache_len:
+            raise InvalidArgumentError(
+                f"largest prefill bucket {self.prefill_buckets[-1]} exceeds "
+                f"the KV cache window {self.cache_len}; prompts must fit "
+                "the cache")
+        self.eos_id = (eos_id if eos_id is not None
+                       else getattr(cfg, "eos_token_id", None))
+        self.pad_id = int(pad_id if pad_id is not None
+                          else getattr(cfg, "pad_token_id", 0))
+        self.max_positions = int(getattr(cfg, "max_position_embeddings",
+                                         1 << 30))
+        self.default_max_new_tokens = int(
+            max_new_tokens if max_new_tokens is not None
+            else flag("generation_max_new_tokens"))
+        self.default_temperature = float(
+            temperature if temperature is not None
+            else flag("generation_temperature"))
+        # static: a different top_k is a different program (lax.top_k k);
+        # per-request temperature stays a traced array and is free
+        self.top_k = int(top_k if top_k is not None
+                         else flag("generation_top_k"))
+        spec = model.cache_spec()
+        self._num_layers, self._num_heads, self._head_dim = (
+            int(spec[0]), int(spec[1]), int(spec[2]))
+        self._base_key = jax.random.PRNGKey(int(seed))
+        self._key_step = 0
+        self.reset()
+        # eval_step-style snapshot: walk the module tree once, read the
+        # live arrays per call (cheap, and parameter updates flow in)
+        self._named = None
+        self._prefill_jit = jax.jit(self._prefill_pure)
+        self._decode_jit = jax.jit(self._decode_pure)
+        self._compiled = {}
+        self.warmed = False
+        # the serving-wide warmup-snapshot discipline; the continuous
+        # batcher notes growth through this same watch
+        self.watch = CompileWatch(
+            lambda: _counters().get(COMPILE_COUNTER, 0),
+            metric="serving/gen_unexpected_compiles",
+            event="generation_unexpected_compile")
+
+    # -- functional state -----------------------------------------------------
+
+    def _state(self):
+        if self._named is None:
+            self._named = {
+                "params": [(n, p, getattr(p, "trainable", True))
+                           for n, p in self.model.named_parameters()],
+                "buffers": [(n, b) for n, b in self.model.named_buffers()
+                            if b is not None],
+            }
+        params, frozen = OrderedDict(), OrderedDict()
+        for n, p, trainable in self._named["params"]:
+            (params if trainable else frozen)[n] = p._array
+        return {
+            "params": params,
+            "frozen": frozen,
+            "buffers": OrderedDict(
+                (n, b._array) for n, b in self._named["buffers"]),
+        }
+
+    def reset(self):
+        """Zero every slot (all caches empty, positions 0)."""
+        self._ck, self._cv, self._pos = _cache.init_cache(
+            self._num_layers, self.slots, self._num_heads, self.cache_len,
+            self._head_dim)
+        return self
+
+    # -- compile accounting ---------------------------------------------------
+
+    def _dispatch(self, label, jitted, args):
+        """Run one compiled step, AOT-compiling new signatures so the
+        cost model captures them (MFU in ``/statz``) and every compile is
+        COUNTED (``generation::compile``) — the bounded-compile
+        discipline the batch-bucket ladder established, on the sequence
+        axis."""
+        leaves = jax.tree_util.tree_leaves(args)
+        sig = (label,) + tuple(
+            (tuple(x.shape), str(x.dtype)) for x in leaves)
+        slot = self._compiled.get(sig)
+        if slot is None:
+            bump_counter(COMPILE_COUNTER)
+            _flight.record_event(
+                "generation_compile", label=label,
+                known_programs=len(self._compiled) + 1)
+            try:
+                lowered = jitted.lower(*args)
+                compiled = lowered.compile()
+                rec = _cost.capture(
+                    f"generation_{label}", lowered=lowered,
+                    compiled=compiled, key=("generation", id(self), sig))
+            except Exception:  # backend without the AOT surface
+                compiled, rec = None, None
+            slot = self._compiled[sig] = (compiled, rec)
+        out = (slot[0] or jitted)(*args)
+        _cost.note_run(slot[1])
+        return out
+
+    def extra_compiles(self) -> int:
+        """Compiles since warmup — steady state must keep this at 0."""
+        return self.watch.extra()
+
+    def warmup(self):
+        """Compile every prefill bucket plus the decode step ahead of
+        traffic (exactly ``len(prefill_buckets) + 1`` programs), then
+        snapshot the compile counter. Idempotent."""
+        if self.warmed:
+            return self
+        with RecordEvent("generation::warmup"):
+            for bucket in self.prefill_buckets:
+                self.admit(0, [self.pad_id] * int(bucket))
+            self.step(np.zeros(self.slots, np.int32),
+                      np.zeros(self.slots, np.float32))
+        self.reset()  # warmup traffic must not look like live context
+        self.watch.arm()
+        self.warmed = True
+        _flight.record_event(
+            "generation_warmup", prefill_buckets=list(self.prefill_buckets),
+            slots=self.slots, cache_len=self.cache_len)
+        return self
+
+    # -- pure steps (jitted) --------------------------------------------------
+
+    def _prefill_pure(self, state, ck, cv, pos, slot, tokens, length, temp,
+                      ctr):
+        """Bucketed prefill of ONE prompt into decode slot ``slot``.
+
+        ``tokens [1, P]`` (P = a ladder bucket), ``length`` = true prompt
+        length. Runs the full forward over the bucket with fresh
+        per-layer caches, installs the K/V into the slot, and samples the
+        first generated token from the last REAL prompt position.
+        """
+        from ..nn.transformer import StaticCache
+
+        p = tokens.shape[1]
+        zero = jnp.zeros((1, self._num_heads, self.cache_len,
+                          self._head_dim), ck.dtype)
+        fresh = [StaticCache(zero, zero, jnp.zeros((1,), jnp.int32))
+                 for _ in range(self._num_layers)]
+        mask = _cache.prefill_mask(p, self.cache_len, length)
+        pos_ids = jnp.arange(p, dtype=jnp.int32)[None]
+        (logits, new_caches), _ = functional_call(
+            self.model, state, tokens,
+            position_ids=pos_ids, attention_mask=mask, caches=fresh)
+        new_k, new_v = _cache.stack_layer_caches(new_caches)
+        ck, cv, pos = _cache.insert_slot(
+            ck, cv, pos, slot, new_k[:, 0], new_v[:, 0], length)
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], length - 1, axis=0, keepdims=False)
+        key = jax.random.fold_in(self._base_key, ctr)
+        tok = sample_logits(last[None], key, temp[None], self.top_k)[0]
+        return ck, cv, pos, tok
+
+    def _decode_pure(self, state, ck, cv, pos, tokens, temps, ctr):
+        """One decode step for EVERY slot: ``tokens [S]`` (each slot's
+        last token) -> next token per slot. Static shapes throughout —
+        this is the program whose compile count is exactly 1."""
+        caches = _cache.layer_caches(ck, cv, pos)
+        pos_ids = jnp.minimum(pos, self.max_positions - 1)[:, None]
+        mask = _cache.decode_mask(pos, self.cache_len)
+        (logits, new_caches), _ = functional_call(
+            self.model, state, tokens[:, None],
+            position_ids=pos_ids, attention_mask=mask, caches=caches)
+        ck, cv = _cache.stack_layer_caches(new_caches)
+        key = jax.random.fold_in(self._base_key, ctr)
+        nxt = sample_logits(logits[:, 0], key, temps, self.top_k)
+        return ck, cv, pos + 1, nxt
+
+    # -- scheduler primitives -------------------------------------------------
+
+    def bucket_for(self, prompt_len) -> int:
+        """Smallest prefill bucket covering ``prompt_len``."""
+        for b in self.prefill_buckets:
+            if b >= prompt_len:
+                return int(b)
+        raise InvalidArgumentError(
+            f"prompt of {prompt_len} tokens exceeds the largest prefill "
+            f"bucket {self.prefill_buckets[-1]}; raise "
+            "FLAGS_generation_prefill_buckets or truncate")
+
+    def validate(self, prompt, max_new_tokens) -> int:
+        """Admission checks shared by offline generate and the serving
+        scheduler. Returns the prompt length."""
+        n = len(prompt)
+        if n < 1:
+            raise InvalidArgumentError("generation needs a non-empty prompt")
+        self.bucket_for(n)  # raises if no bucket covers it
+        if max_new_tokens < 1:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        total = n + int(max_new_tokens)
+        if total > self.max_positions:
+            raise InvalidArgumentError(
+                f"prompt ({n}) + max_new_tokens ({max_new_tokens}) = "
+                f"{total} exceeds the model's max_position_embeddings "
+                f"{self.max_positions}")
+        return n
+
+    def admit(self, slot, prompt, temperature=None) -> int:
+        """Prefill ``prompt`` into ``slot`` and return the first sampled
+        token. The slot's previous occupant is simply overwritten — a
+        vacated slot needs no reset pass."""
+        n = len(prompt)
+        bucket = self.bucket_for(n)
+        padded = np.full(bucket, self.pad_id, np.int32)
+        padded[:n] = np.asarray(prompt, np.int32)
+        temp = (self.default_temperature if temperature is None
+                else float(temperature))
+        self._key_step += 1
+        with RecordEvent("generation::prefill"):
+            out = self._dispatch("prefill", self._prefill_jit, (
+                self._state(), self._ck, self._cv, self._pos,
+                jnp.asarray(slot, jnp.int32), jnp.asarray(padded[None]),
+                jnp.asarray(n, jnp.int32), jnp.asarray(temp, jnp.float32),
+                jnp.asarray(self._key_step, jnp.int32)))
+        self._ck, self._cv, self._pos, tok = out
+        return int(tok)
+
+    def step(self, tokens, temps) -> np.ndarray:
+        """Decode one token for every slot. ``tokens``/``temps`` are
+        host ``[S]`` arrays (vacant slots: anything — their output is
+        ignored and their cache entries are overwritten on admission)."""
+        self._key_step += 1
+        with RecordEvent("generation::decode"):
+            out = self._dispatch("decode", self._decode_jit, (
+                self._state(), self._ck, self._cv, self._pos,
+                jnp.asarray(np.asarray(tokens, np.int32)),
+                jnp.asarray(np.asarray(temps, np.float32)),
+                jnp.asarray(self._key_step, jnp.int32)))
+        self._ck, self._cv, self._pos, nxt = out
+        return np.asarray(nxt)
+
+    # -- offline API ----------------------------------------------------------
+
+    def generate(self, prompts, max_new_tokens=None, temperature=None,
+                 stop_at_eos=True, continuous=True):
+        """Generate for a list of prompts, continuous-batched across the
+        engine's slots: a finished sequence vacates its slot and the next
+        prompt is admitted at the next step. ``continuous=False`` is the
+        static baseline (a new group is admitted only when EVERY slot has
+        drained — what tearing the batch down costs; bench.py's
+        ``decode_throughput`` row measures the difference). Returns one
+        token list per prompt (EOS included when hit)."""
+        max_new = (self.default_max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        for prompt in prompts:
+            self.validate(prompt, max_new)
+        pending = deque(enumerate(prompts))
+        results = [None] * len(prompts)
+        active = {}  # slot -> (prompt_idx, tokens list)
+        last = np.zeros(self.slots, np.int32)
+        temps = np.zeros(self.slots, np.float32)
+        temp = (self.default_temperature if temperature is None
+                else float(temperature))
+
+        def finished(tokens):
+            return (len(tokens) >= max_new
+                    or (stop_at_eos and self.eos_id is not None
+                        and tokens[-1] == self.eos_id))
+
+        while pending or active:
+            admit_ok = bool(pending) and (continuous or not active)
+            while admit_ok and pending and len(active) < self.slots:
+                slot = next(s for s in range(self.slots) if s not in active)
+                idx, prompt = pending.popleft()
+                tok = self.admit(slot, prompt, temp)
+                temps[slot] = temp
+                if finished([tok]):
+                    results[idx] = [tok]
+                else:
+                    active[slot] = (idx, [tok])
+                    last[slot] = tok
+            if not active:
+                continue
+            nxt = self.step(last, temps)
+            for slot in list(active):
+                idx, tokens = active[slot]
+                tokens.append(int(nxt[slot]))
+                last[slot] = nxt[slot]
+                if finished(tokens):
+                    results[idx] = tokens
+                    del active[slot]
+        return results

@@ -1,0 +1,76 @@
+"""Token sampling and stopping for autoregressive decoding.
+
+Two layers, ONE implementation of each idea in the codebase:
+
+- :func:`sample_logits` — pure jnp, traced into the compiled
+  prefill/decode steps. Greedy is temperature == 0 (selected with
+  ``jnp.where``, so per-slot greedy/sampled mixes co-batch in one
+  program); top-k is a STATIC engine-level knob (the ``top_k`` changes
+  the lowered program, so per-request top-k would break the
+  compile-once guarantee — per-request temperature is a traced array
+  and stays free).
+- :func:`decode_loop` — the eager host-side greedy loop every decoder
+  model shares (``models/seq2seq.py`` delegates here instead of rolling
+  its own), with EOS stopping.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["sample_logits", "top_k_filter", "decode_loop"]
+
+
+def top_k_filter(logits, k):
+    """Mask every logit below the k-th largest to -inf. ``k <= 0``
+    disables (full distribution). Pure jnp; ``k`` is static."""
+    k = int(k)
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, -jnp.inf, logits)
+
+
+def sample_logits(logits, key, temperature, top_k=0):
+    """Draw one token per row from ``logits [B, V]``.
+
+    ``temperature`` is scalar or ``[B]``; rows with ``temperature <= 0``
+    take the argmax (greedy), others sample ``softmax(top_k(logits)/T)``
+    — both branches are computed and selected with ``where`` so mixed
+    batches stay a single program. Returns ``[B] int32``.
+    """
+    temperature = jnp.asarray(temperature, logits.dtype)
+    if temperature.ndim == 0:
+        temperature = jnp.broadcast_to(temperature, logits.shape[:1])
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = top_k_filter(logits, top_k) / jnp.maximum(
+        temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def decode_loop(next_logits, ys, max_len, eos_id=None):
+    """Greedy host-side decode loop (eager models, no KV cache).
+
+    ``next_logits(ys) -> [B, V]`` returns next-token logits given the
+    tokens so far (``ys [B, T]``, a Tensor); the loop appends the argmax
+    until ``ys`` reaches ``max_len`` columns or (``eos_id`` set) every
+    row has emitted EOS. Returns the grown ``ys``. One decode-loop
+    implementation for the eager path — the compiled O(1)-cache path
+    lives in :mod:`generation.engine`.
+    """
+    from .. import ops
+
+    b = ys.shape[0]
+    done = np.zeros(b, bool)
+    for _ in range(int(max_len) - ys.shape[1]):
+        logits = next_logits(ys)
+        nxt = ops.argmax(logits, axis=-1)
+        ys = ops.concat([ys, ops.reshape(nxt, [b, 1]).astype("int64")],
+                        axis=1)
+        if eos_id is not None:
+            done |= np.asarray(nxt.numpy()).reshape(-1) == eos_id
+            if done.all():
+                break
+    return ys

@@ -34,6 +34,12 @@ from .mobilenet import (  # noqa: F401
     mobilenet_v2,
 )
 from .seq2seq import TransformerSeq2Seq  # noqa: F401
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt_tiny_config,
+)
 from .se_resnext import (  # noqa: F401
     SEResNeXt,
     se_resnext50_32x4d,

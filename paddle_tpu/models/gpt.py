@@ -1,0 +1,157 @@
+"""GPT-style decoder-only causal language model.
+
+The autoregressive counterpart of ``models/bert.py``: the same
+``nn/transformer.py`` building blocks, assembled pre-norm and
+decoder-only (``TransformerDecoderLayer(with_cross_attention=False)``),
+with the LM head weight-tied to the token embedding.
+
+Designed for the generation stack (``paddle_tpu/generation/``): the
+forward takes an optional list of per-layer :class:`nn.StaticCache`
+entries and then runs the INCREMENTAL attention path — functional
+ring-buffer K/V writes, shapes static across steps — so one jitted
+decode step serves the whole life of every sequence.
+
+``attention_window`` gives the model sliding-window attention (each
+token sees at most the last W tokens). Serving sets it to the KV-cache
+capacity, which is exactly what a ring cache of that capacity computes —
+the full forward and the cached decode agree numerically even after the
+ring wraps (golden-tested).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import ops
+from ..framework.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..nn.layers import Dropout, Embedding, LayerList, LayerNorm
+from ..nn.transformer import TransformerDecoderLayer, causal_mask
+from .bert import _init_bert_weights
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny_config"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 1024
+    initializer_range: float = 0.02
+    bos_token_id: int = 0
+    eos_token_id: int = 1
+    pad_token_id: int = 2
+    # sliding-window attention width (None = full causal). The serving
+    # engine sets this to the KV-cache capacity so the compiled full
+    # forward and the O(1) ring-cache decode compute the same function.
+    attention_window: int | None = None
+
+
+def gpt_tiny_config() -> GPTConfig:
+    """For tests / smokes: 2 layers, 64 hidden."""
+    return GPTConfig(
+        vocab_size=211, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=128, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+
+
+class GPTModel(Layer):
+    """Embeddings + pre-norm decoder-only stack + final LayerNorm."""
+
+    def __init__(self, cfg: GPTConfig | None = None, **kwargs):
+        super().__init__()
+        self.config = cfg or GPTConfig(**kwargs)
+        cfg = self.config
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size
+        )
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self.layers = LayerList([
+            TransformerDecoderLayer(
+                cfg.hidden_size, cfg.num_attention_heads,
+                cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+                activation=cfg.hidden_act,
+                attn_dropout=cfg.attention_probs_dropout_prob,
+                act_dropout=0.0, normalize_before=True,
+                with_cross_attention=False,
+            )
+            for _ in range(cfg.num_hidden_layers)
+        ])
+        self.norm_f = LayerNorm(cfg.hidden_size)
+        _init_bert_weights(self, cfg.initializer_range)
+
+    @staticmethod
+    def _wrap(x, dtype=None):
+        if isinstance(x, Tensor):
+            return x
+        arr = jnp.asarray(x)
+        if dtype is not None:
+            arr = arr.astype(dtype)
+        return Tensor._from_array(arr)
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                caches=None):
+        """Hidden states ``[B, T, H]``; with ``caches`` (a list of
+        per-layer ``StaticCache``) also the updated caches."""
+        input_ids = self._wrap(input_ids)
+        t = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.expand(
+                ops.unsqueeze(ops.arange(t, dtype="int64"), 0),
+                [input_ids.shape[0], t],
+            )
+        else:
+            position_ids = self._wrap(position_ids)
+        if attention_mask is None:
+            attention_mask = causal_mask(
+                t, window=self.config.attention_window)
+        else:
+            attention_mask = self._wrap(attention_mask)
+        x = self.dropout(
+            self.word_embeddings(input_ids)
+            + self.position_embeddings(position_ids)
+        )
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if caches is None:
+                x = layer(x, tgt_mask=attention_mask)
+            else:
+                x, c = layer(x, tgt_mask=attention_mask, cache=caches[i])
+                new_caches.append(c)
+        x = self.norm_f(x)
+        return x if caches is None else (x, new_caches)
+
+
+class GPTForCausalLM(Layer):
+    """GPTModel + weight-tied LM head: logits over the vocabulary."""
+
+    def __init__(self, cfg: GPTConfig | None = None, **kwargs):
+        super().__init__()
+        self.gpt = GPTModel(cfg, **kwargs)
+        self.config = self.gpt.config
+
+    def forward(self, input_ids, position_ids=None, attention_mask=None,
+                caches=None):
+        out = self.gpt(input_ids, position_ids, attention_mask, caches)
+        hidden = out[0] if caches is not None else out
+        logits = ops.matmul(hidden, self.gpt.word_embeddings.weight,
+                            transpose_y=True)
+        return logits if caches is None else (logits, out[1])
+
+    # -- generation-engine contract ------------------------------------------
+
+    def cache_spec(self):
+        """(num_layers, num_heads, head_dim) for KV-cache allocation."""
+        cfg = self.config
+        return (cfg.num_hidden_layers, cfg.num_attention_heads,
+                cfg.hidden_size // cfg.num_attention_heads)

@@ -97,18 +97,22 @@ class TransformerSeq2Seq(Layer):
         return self.decode_logits(memory, self._pad_mask(src_ids), tgt_ids)
 
     # -- decoding -------------------------------------------------------------
-    def greedy_decode(self, src_ids, max_len=20):
-        """Greedy decoding (book test_machine_translation's decode loop)."""
+    def greedy_decode(self, src_ids, max_len=20, stop_at_eos=False):
+        """Greedy decoding (book test_machine_translation's decode loop),
+        delegated to the shared :func:`generation.sampling.decode_loop`
+        — one decode-loop implementation in the codebase.
+        ``stop_at_eos`` ends early once every row has emitted EOS
+        (off by default: the book loop always runs ``max_len - 1``
+        steps)."""
+        from ..generation.sampling import decode_loop
+
         b = src_ids.shape[0]
         memory = self.encode(src_ids)
         src_mask = self._pad_mask(src_ids)
         ys = ops.full([b, 1], self.bos_id, "int64")
-        for _ in range(max_len - 1):
-            logits = self.decode_logits(memory, src_mask, ys)
-            nxt = ops.argmax(logits[:, -1], axis=-1)
-            ys = ops.concat([ys, ops.reshape(nxt, [b, 1]).astype("int64")],
-                            axis=1)
-        return ys
+        return decode_loop(
+            lambda ys_: self.decode_logits(memory, src_mask, ys_)[:, -1],
+            ys, max_len, eos_id=self.eos_id if stop_at_eos else None)
 
     def beam_search(self, src_ids, beam_size=4, max_len=20):
         """Beam-search decoding over the beam_search op pair.

@@ -7,9 +7,15 @@ in via paddle_tpu.ops.pallas_kernels for long sequences.
 """
 from __future__ import annotations
 
+from typing import Any, NamedTuple
+
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from .. import ops
+from ..framework.tensor import Tensor
 from . import functional as F
 from .layer_base import Layer
 from .layers import Dropout, LayerList, LayerNorm, Linear
@@ -21,14 +27,67 @@ FLASH_ATTENTION_MIN_SEQ = 512
 
 
 def _convert_attention_mask(attn_mask, dtype):
+    """Normalize a mask to an ADDITIVE mask broadcastable against the
+    [B, H, Lq, Lk] score tensor.
+
+    Accepts bool masks (True = keep, paddle semantics) and additive float
+    masks, at rank 2 ``[Lq, Lk]``, rank 3 ``[B, Lq, Lk]``, or rank 4
+    ``[B, 1|H, Lq, Lk]`` — all composed the same way on the encoder,
+    decoder, and incremental-cache paths. Rank 3 in particular would
+    silently broadcast against the wrong axes if added raw to the scores
+    (``[B, Lq, Lk]`` lines up as ``[1, B, Lq, Lk]``), so ranks are
+    normalized here, once, instead of per call site.
+    """
     if attn_mask is None:
         return None
     if attn_mask.dtype == np.bool_ or str(attn_mask.dtype) == "bool":
         # True = keep, False = mask out (paddle semantics)
         zero = ops.zeros_like(ops.cast(attn_mask, dtype))
         neg = ops.full_like(zero, -1e9)
-        return ops.where(attn_mask, zero, neg)
-    return ops.cast(attn_mask, dtype)
+        attn_mask = ops.where(attn_mask, zero, neg)
+    else:
+        attn_mask = ops.cast(attn_mask, dtype)
+    if attn_mask.ndim == 2:        # [Lq, Lk] -> [1, 1, Lq, Lk]
+        attn_mask = ops.unsqueeze(attn_mask, [0, 1])
+    elif attn_mask.ndim == 3:      # [B, Lq, Lk] -> [B, 1, Lq, Lk]
+        attn_mask = ops.unsqueeze(attn_mask, [1])
+    return attn_mask
+
+
+def causal_mask(length, window=None, dtype="float32"):
+    """Additive ``[L, L]`` causal mask; ``window=W`` additionally masks
+    keys more than ``W-1`` positions behind the query (sliding-window
+    attention) — the full-sequence equivalent of decoding with a ring KV
+    cache of capacity ``W``, which keeps exactly the last ``W`` tokens.
+    ``window=None`` is the standard full causal mask."""
+    i = np.arange(length)[:, None]
+    j = np.arange(length)[None, :]
+    keep = j <= i
+    if window is not None:
+        keep = keep & (j > i - int(window))
+    from ..framework.tensor import to_tensor
+
+    return to_tensor(np.where(keep, 0.0, -1e9).astype(dtype))
+
+
+class StaticCache(NamedTuple):
+    """Fixed-shape ring KV cache for ONE attention layer.
+
+    ``k``/``v`` are ``[B, H, C, D]`` arrays (C = cache capacity) and
+    ``pos`` is ``[B]`` int32 — how many tokens each row has written so
+    far. Writes are FUNCTIONAL index updates (``.at[].set`` /
+    ``dynamic_update_slice``), so the pytree's shapes never change
+    across decode steps: one XLA program decodes forever, and once
+    ``pos`` passes ``C`` the write index wraps (``pos % C``) and the
+    oldest entry is overwritten — O(1) memory, compile-once decoding
+    (PAPERS.md: portable O(1) autoregressive caching). Validity/window
+    masking is the CALLER's job (the mask composes causal + cache-fill,
+    see generation/cache.py); the layer only writes and attends.
+    """
+
+    k: Any
+    v: Any
+    pos: Any
 
 
 class MultiHeadAttention(Layer):
@@ -81,7 +140,14 @@ class MultiHeadAttention(Layer):
         q = self._shape(self.q_proj(query))
         k = self._shape(self.k_proj(key))
         v = self._shape(self.v_proj(value))
-        if cache is not None:
+        if isinstance(cache, StaticCache):
+            # incremental path: write the new K/V into the ring cache by
+            # functional index update, then attend over the FULL static
+            # window — shapes never change across steps, so a jitted
+            # decode step compiles exactly once (the caller's mask hides
+            # not-yet-written entries)
+            k, v, new_cache = self._update_static_cache(cache, k, v)
+        elif cache is not None:
             pk, pv = cache
             k = ops.concat([pk, k], axis=2)
             v = ops.concat([pv, v], axis=2)
@@ -159,6 +225,40 @@ class MultiHeadAttention(Layer):
         v = ops.zeros([b, self.num_heads, 0, self.head_dim], key.dtype)
         return (k, v)
 
+    def gen_static_cache(self, batch, cache_len, dtype="float32"):
+        """A zeroed :class:`StaticCache` of capacity ``cache_len``."""
+        shape = (int(batch), self.num_heads, int(cache_len), self.head_dim)
+        return StaticCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
+                           jnp.zeros((int(batch),), jnp.int32))
+
+    def _update_static_cache(self, cache, k, v):
+        """Write the freshly projected K/V into the ring cache.
+
+        Decode (Lq == 1): every row writes its own ring index
+        ``pos % C`` — a batched scatter, so co-batched sequences at
+        different positions share one program. Prefill (Lq > 1): the
+        whole span lands at the shared start offset (fresh slots start
+        at pos == 0; ring-wrap writes are decode-only by construction —
+        the engine admits prompts no longer than the cache window).
+        """
+        kc, vc, pos = cache
+        kn = k._array if isinstance(k, Tensor) else jnp.asarray(k)
+        vn = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+        kn = kn.astype(kc.dtype)
+        vn = vn.astype(vc.dtype)
+        c = kc.shape[2]
+        if kn.shape[2] == 1:
+            rows = jnp.arange(kc.shape[0])
+            idx = jnp.mod(pos, c)
+            kc = kc.at[rows, :, idx, :].set(kn[:, :, 0, :])
+            vc = vc.at[rows, :, idx, :].set(vn[:, :, 0, :])
+        else:
+            start = jnp.mod(pos[0], c)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, kn, start, axis=2)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, vn, start, axis=2)
+        return (Tensor._from_array(kc), Tensor._from_array(vc),
+                StaticCache(kc, vc, pos))
+
 
 class TransformerEncoderLayer(Layer):
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1, activation="relu",
@@ -227,29 +327,40 @@ class TransformerEncoder(Layer):
 
 
 class TransformerDecoderLayer(Layer):
+    """Decoder block: self-attention (+ optional cross-attention) + FFN.
+
+    ``with_cross_attention=False`` builds a decoder-ONLY block (GPT
+    style): no cross-attention parameters exist at all — not merely
+    skipped, so the functional state stays free of zombie weights — and
+    ``memory`` may be omitted at call time.
+    """
+
     def __init__(self, d_model, nhead, dim_feedforward, dropout=0.1, activation="relu",
                  attn_dropout=None, act_dropout=None, normalize_before=False,
-                 weight_attr=None, bias_attr=None):
+                 weight_attr=None, bias_attr=None, with_cross_attention=True):
         super().__init__()
         attn_dropout = dropout if attn_dropout is None else attn_dropout
         act_dropout = dropout if act_dropout is None else act_dropout
         self.normalize_before = normalize_before
         self.self_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
                                             weight_attr=weight_attr, bias_attr=bias_attr)
-        self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
-                                             weight_attr=weight_attr, bias_attr=bias_attr)
+        if with_cross_attention:
+            self.cross_attn = MultiHeadAttention(d_model, nhead, dropout=attn_dropout,
+                                                 weight_attr=weight_attr, bias_attr=bias_attr)
+            self.norm2 = LayerNorm(d_model)
+            self.dropout2 = Dropout(dropout)
+        else:
+            self.cross_attn = None
         self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
         self.dropout = Dropout(act_dropout)
         self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
         self.norm1 = LayerNorm(d_model)
-        self.norm2 = LayerNorm(d_model)
         self.norm3 = LayerNorm(d_model)
         self.dropout1 = Dropout(dropout)
-        self.dropout2 = Dropout(dropout)
         self.dropout3 = Dropout(dropout)
         self.activation = getattr(F, activation)
 
-    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+    def forward(self, tgt, memory=None, tgt_mask=None, memory_mask=None, cache=None):
         residual = tgt
         if self.normalize_before:
             tgt = self.norm1(tgt)
@@ -261,13 +372,19 @@ class TransformerDecoderLayer(Layer):
         if not self.normalize_before:
             tgt = self.norm1(tgt)
 
-        residual = tgt
-        if self.normalize_before:
-            tgt = self.norm2(tgt)
-        tgt = self.cross_attn(tgt, memory, memory, memory_mask)
-        tgt = residual + self.dropout2(tgt)
-        if not self.normalize_before:
-            tgt = self.norm2(tgt)
+        if self.cross_attn is not None:
+            if memory is None:
+                raise ValueError(
+                    "this TransformerDecoderLayer was built with cross-"
+                    "attention; pass memory (or build it with "
+                    "with_cross_attention=False for decoder-only use)")
+            residual = tgt
+            if self.normalize_before:
+                tgt = self.norm2(tgt)
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+            tgt = residual + self.dropout2(tgt)
+            if not self.normalize_before:
+                tgt = self.norm2(tgt)
 
         residual = tgt
         if self.normalize_before:

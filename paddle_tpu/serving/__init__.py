@@ -12,6 +12,12 @@ Layers (each usable alone) on top of ``paddle_tpu.inference.Predictor``:
 - :mod:`serving.server` — stdlib ThreadingHTTPServer frontend
   (``/predict``, ``/healthz`` readiness, ``/statz``, ``/metrics``) with
   429 backpressure on a full queue and graceful drain on shutdown.
+- :mod:`serving.continuous` — CONTINUOUS BATCHING for autoregressive
+  generation: a slot scheduler over ``generation.GenerationEngine``
+  where finished sequences vacate their decode slot mid-batch and queued
+  requests are admitted at the next step; served by
+  :class:`GenerationServer` (``/generate``, streaming-friendly, with
+  tokens/sec + slot occupancy + per-token latency on ``/statz``).
 
 Quickstart::
 
@@ -37,11 +43,14 @@ from .batcher import (  # noqa: F401
     ServingClosedError,
     parse_buckets,
 )
-from .replica import ReplicaPool, predictor_input_specs  # noqa: F401
-from .server import InferenceServer  # noqa: F401
+from .replica import CompileWatch, ReplicaPool, predictor_input_specs  # noqa: F401
+from .continuous import ContinuousBatcher, GenerationRequest  # noqa: F401
+from .server import GenerationServer, InferenceServer  # noqa: F401
 
 __all__ = [
     "DynamicBatcher", "ReplicaPool", "InferenceServer",
+    "ContinuousBatcher", "GenerationRequest", "GenerationServer",
+    "CompileWatch",
     "QueueFullError", "DeadlineExceededError", "ServingClosedError",
     "parse_buckets", "predictor_input_specs", "shutdown_all",
 ]
@@ -60,20 +69,19 @@ def shutdown_all():
     """Stop every live server, pool, and batcher (idempotent; exceptions
     swallowed — this is the test-teardown / atexit path, where a
     half-constructed object must not mask the real failure)."""
-    # servers first (they drain their own pool+batcher), then bare pools,
-    # then bare batchers — reverse dependency order
+    # servers first (they drain their own pool/scheduler+batcher), then
+    # bare pools/schedulers, then bare batchers — reverse dependency order
     objs = list(_live)
-    for cls in (InferenceServer, ReplicaPool, DynamicBatcher):
+    for cls in (InferenceServer, GenerationServer, ReplicaPool,
+                ContinuousBatcher, DynamicBatcher):
         for obj in objs:
             if type(obj) is not cls:
                 continue
             try:
-                if cls is InferenceServer:
-                    obj.stop(drain=False, timeout=2.0)
-                elif cls is ReplicaPool:
-                    obj.stop(drain=False, timeout=2.0)
-                else:
+                if cls is DynamicBatcher:
                     obj.close(drain=False)
+                else:
+                    obj.stop(drain=False, timeout=2.0)
             except Exception:
                 pass
 

@@ -1,0 +1,409 @@
+"""Continuous batching: slot-turnover scheduling over a GenerationEngine.
+
+The dynamic batcher (``batcher.py``) assembles a batch, dispatches it,
+and TEARS IT DOWN — right for single-call predictors, ruinous for
+autoregressive decoding where co-batched sequences finish at different
+times: the batch would run at the pace of its longest member while
+finished slots burn compute on garbage.
+
+Here the batch never tears down. The compiled decode step always runs
+all ``engine.slots`` rows; a sequence that hits EOS or its token budget
+VACATES its slot mid-batch, and the next queued request is admitted into
+the vacant slot at the very next step (a prefill + one functional
+indexed cache write — no recompile, the decode program's shapes are slot
+-count-static). Under mixed-length traffic the slots stay full, which is
+where the throughput comes from (bench.py ``decode_throughput`` measures
+continuous vs static on exactly that sweep).
+
+Admission reuses the serving queue contracts: bounded queue with
+:class:`QueueFullError` backpressure (HTTP 429), deadlines that expire
+queued requests WITHOUT dispatch, :class:`ServingClosedError` after
+close, and graceful drain. Compile accounting reuses
+:class:`replica.CompileWatch` over the ``generation::compile`` counter —
+steady state is exactly 1 decode + len(prefill ladder) programs, any
+growth bumps ``serving/gen_unexpected_compiles`` + a flight event.
+
+Per-token streaming: pass ``on_token`` to :meth:`submit` and every
+sampled token is delivered as it is decoded (the HTTP ``/generate``
+endpoint's streaming mode rides this).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..errors import InvalidArgumentError
+from ..flags import flag
+from ..monitor import counter, gauge, histogram
+from ..monitor import flight_recorder as _flight
+from .batcher import (
+    DeadlineExceededError,
+    QueueFullError,
+    ServingClosedError,
+)
+
+__all__ = ["ContinuousBatcher", "GenerationRequest"]
+
+
+class GenerationRequest:
+    """One submitted generation: a token prompt, its budget and sampling
+    override, the tokens produced so far, and a completion event."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "deadline",
+                 "t_submit", "t_first_token", "tokens", "finish_reason",
+                 "on_token", "error", "_done")
+
+    def __init__(self, prompt, max_new_tokens, temperature, deadline,
+                 t_submit, on_token=None):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.temperature = temperature
+        self.deadline = deadline  # absolute monotonic seconds, or None
+        self.t_submit = t_submit
+        self.t_first_token = None
+        self.tokens = []
+        self.finish_reason = None  # "eos" | "length" | None
+        self.on_token = on_token
+        self.error = None
+        self._done = threading.Event()
+
+    def expired(self, now) -> bool:
+        return self.deadline is not None and now > self.deadline
+
+    def done(self, error=None):
+        self.error = error
+        self._done.set()
+
+    @property
+    def finished(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout=None):
+        """Block until generation completes; returns the token list or
+        raises the stored error."""
+        if not self._done.wait(timeout):
+            from ..errors import ExecutionTimeoutError
+
+            raise ExecutionTimeoutError(
+                f"generation not completed within {timeout}s")
+        if self.error is not None:
+            raise self.error
+        return self.tokens
+
+
+class ContinuousBatcher:
+    """Slot scheduler + decode-loop worker over one GenerationEngine."""
+
+    def __init__(self, engine, queue_capacity=None, clock=time.monotonic):
+        self.engine = engine
+        self.queue_capacity = int(
+            queue_capacity if queue_capacity is not None
+            else flag("generation_queue_capacity"))
+        if self.queue_capacity <= 0:
+            raise InvalidArgumentError(
+                f"generation queue capacity must be positive, got "
+                f"{self.queue_capacity}")
+        self._clock = clock
+        self._q = deque()
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._closed = False
+        self._drain = True
+        self._thread = None
+        s = engine.slots
+        self._slots = [None] * s           # slot -> GenerationRequest
+        import numpy as np
+
+        self._last = np.zeros(s, np.int32)
+        self._temps = np.zeros(s, np.float32)
+        # the engine owns the warmup-snapshot watch (armed by warmup());
+        # the loop notes growth through it after every step
+        self._watch = engine.watch
+        # metrics (get-or-create; shared across scheduler rebuilds)
+        self._m_requests = counter("serving/gen_requests_total")
+        self._m_responses = counter("serving/gen_responses_total")
+        self._m_rejected = counter("serving/gen_rejected_total")
+        self._m_expired = counter("serving/gen_expired_total")
+        self._m_errors = counter("serving/gen_errors_total")
+        self._m_tokens = counter("serving/gen_tokens_total")
+        self._m_midbatch = counter("serving/gen_midbatch_admissions_total")
+        self._m_depth = gauge("serving/gen_queue_depth")
+        self._m_busy = gauge("serving/gen_slots_busy")
+        self._h_token = histogram("serving/gen_token_ms")
+        self._h_ttft = histogram("serving/gen_ttft_ms")
+        self._h_e2e = histogram("serving/gen_e2e_ms")
+        from . import _register_live
+
+        _register_live(self)
+
+    # -- client side ---------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    @property
+    def live_slots(self) -> int:
+        return sum(r is not None for r in self._slots)
+
+    def occupancy(self) -> float:
+        return self.live_slots / self.engine.slots
+
+    def extra_compiles(self) -> int:
+        return self.engine.extra_compiles()
+
+    def submit(self, prompt, max_new_tokens=None, temperature=None,
+               deadline_ms=None, on_token=None) -> GenerationRequest:
+        """Enqueue one generation request. Validation happens at
+        ADMISSION TIME here (a malformed prompt must be rejected before
+        it can occupy a decode slot); a full queue raises
+        :class:`QueueFullError` (HTTP 429)."""
+        prompt = [int(t) for t in prompt]
+        max_new = (self.engine.default_max_new_tokens
+                   if max_new_tokens is None else int(max_new_tokens))
+        self.engine.validate(prompt, max_new)
+        now = self._clock()
+        deadline = (now + float(deadline_ms) / 1e3
+                    if deadline_ms is not None and float(deadline_ms) > 0
+                    else None)
+        req = GenerationRequest(prompt, max_new, temperature, deadline,
+                                now, on_token=on_token)
+        with self._lock:
+            if self._closed:
+                raise ServingClosedError(
+                    "generation scheduler is shut down; no new requests")
+            if len(self._q) >= self.queue_capacity:
+                self._m_rejected.inc()
+                _flight.record_event(
+                    "generation_reject", reason="queue_full",
+                    depth=len(self._q), capacity=self.queue_capacity)
+                raise QueueFullError(
+                    f"generation queue full ({self.queue_capacity} "
+                    "requests queued); backpressure — retry with backoff")
+            self._q.append(req)
+            self._m_depth.set(len(self._q))
+            self._not_empty.notify()
+        self._m_requests.inc()
+        return req
+
+    def generate(self, prompt, max_new_tokens=None, temperature=None,
+                 timeout=None) -> list:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(prompt, max_new_tokens, temperature).wait(timeout)
+
+    # -- decode loop ---------------------------------------------------------
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._thread = threading.Thread(
+            target=self._loop, name="ptpu-generation-decode", daemon=True)
+        self._thread.start()
+        return self
+
+    def _pop_expired_locked(self, now):
+        while self._q and self._q[0].expired(now):
+            req = self._q.popleft()
+            self._m_depth.set(len(self._q))
+            self._m_expired.inc()
+            _flight.record_event(
+                "generation_deadline_expired",
+                queued_ms=round((now - req.t_submit) * 1e3, 3))
+            req.done(error=DeadlineExceededError(
+                f"generation deadline passed after "
+                f"{(now - req.t_submit) * 1e3:.1f}ms in queue; "
+                "never admitted to a slot"))
+
+    def _finished_reason(self, req):
+        if (self.engine.eos_id is not None
+                and req.tokens and req.tokens[-1] == self.engine.eos_id):
+            return "eos"
+        if len(req.tokens) >= req.max_new_tokens:
+            return "length"
+        return None
+
+    def _deliver(self, req, tok):
+        req.tokens.append(int(tok))
+        self._m_tokens.inc()
+        if req.on_token is not None:
+            try:
+                req.on_token(int(tok))
+            except Exception:  # a slow/broken stream must not stall decode
+                req.on_token = None
+
+    def _complete(self, req, reason):
+        req.finish_reason = reason
+        now = self._clock()
+        self._h_e2e.observe((now - req.t_submit) * 1e3)
+        self._m_responses.inc()
+        _flight.record_event(
+            "generation_complete", reason=reason,
+            prompt_tokens=len(req.prompt), tokens=len(req.tokens))
+        req.done()
+
+    def _admit_ready(self):
+        """Fill vacant slots from the queue (the continuous-batching
+        move: admission happens between decode steps, never tearing the
+        running batch down)."""
+        engine = self.engine
+        while True:
+            with self._lock:
+                now = self._clock()
+                self._pop_expired_locked(now)
+                if not self._q:
+                    return
+                free = next((s for s, r in enumerate(self._slots)
+                             if r is None), None)
+                if free is None:
+                    return
+                req = self._q.popleft()
+                self._m_depth.set(len(self._q))
+            midbatch = self.live_slots > 0
+            try:
+                tok = engine.admit(free, req.prompt, req.temperature)
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                self._m_errors.inc()
+                req.done(error=e)
+                continue
+            with self._lock:
+                if self._closed and not self._drain:
+                    # stop(drain=False) landed while this request was in
+                    # flight between the queue pop and slot install — it
+                    # was promised a failure, not a quiet completion
+                    self._m_errors.inc()
+                    req.done(error=ServingClosedError(
+                        "generation scheduler shut down before the "
+                        "request reached a decode slot"))
+                    continue
+            req.t_first_token = self._clock()
+            self._h_ttft.observe((req.t_first_token - req.t_submit) * 1e3)
+            if midbatch:
+                self._m_midbatch.inc()
+            _flight.record_event(
+                "generation_admit", slot=free, midbatch=midbatch,
+                prompt_tokens=len(req.prompt),
+                queued_ms=round(
+                    (req.t_first_token - req.t_submit) * 1e3, 3))
+            self._deliver(req, tok)
+            reason = self._finished_reason(req)
+            if reason is not None:
+                self._complete(req, reason)
+                continue
+            self._slots[free] = req
+            self._last[free] = tok
+            self._temps[free] = (
+                self.engine.default_temperature
+                if req.temperature is None else float(req.temperature))
+            self._m_busy.set(self.live_slots)
+
+    def _loop(self):
+        engine = self.engine
+        while True:
+            self._admit_ready()
+            busy = [s for s, r in enumerate(self._slots) if r is not None]
+            if not busy:
+                with self._lock:
+                    if self._closed and not self._q:
+                        break
+                    if not self._q:
+                        self._not_empty.wait(0.05)
+                continue
+            t0 = self._clock()
+            try:
+                nxt = engine.step(self._last, self._temps)
+            except Exception as e:  # noqa: BLE001 — fail THESE, keep serving
+                for s in busy:
+                    req, self._slots[s] = self._slots[s], None
+                    self._m_errors.inc()
+                    req.done(error=e)
+                self._m_busy.set(0)
+                _flight.record_event(
+                    "generation_step_error", slots=len(busy),
+                    error=f"{type(e).__name__}: {e}"[:300])
+                continue
+            self._h_token.observe((self._clock() - t0) * 1e3)
+            if self._watch.armed:
+                self._watch.note(slots=len(busy))
+            for s in busy:
+                req = self._slots[s]
+                if req is None or req.finished:  # stop(drain=False) race
+                    self._slots[s] = None
+                    continue
+                self._deliver(req, nxt[s])
+                self._last[s] = nxt[s]
+                reason = self._finished_reason(req)
+                if reason is not None:
+                    self._slots[s] = None
+                    self._complete(req, reason)
+            self._m_busy.set(self.live_slots)
+        # drained exit: nothing queued, nothing active
+        self._m_busy.set(self.live_slots)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self, drain=True):
+        """Refuse new requests. ``drain=True`` lets the decode loop
+        finish everything queued AND active; ``drain=False`` fails
+        queued requests immediately (active ones still finish their
+        current step and are failed by ``stop``)."""
+        with self._lock:
+            if self._closed and not self._q:
+                return
+            self._closed = True
+            self._drain = drain
+            dropped = []
+            if not drain:
+                dropped = list(self._q)
+                self._q.clear()
+            self._m_depth.set(len(self._q))
+            self._not_empty.notify_all()
+        for req in dropped:
+            self._m_errors.inc()
+            req.done(error=ServingClosedError(
+                "generation scheduler shut down before admission"))
+        _flight.record_event("generation_close", drain=drain,
+                             dropped=len(dropped))
+
+    def stop(self, drain=True, timeout=30.0):
+        """Close and join the decode loop. With ``drain=False`` active
+        sequences are failed instead of run to completion."""
+        self.close(drain=drain)
+        if not drain:
+            self._fail_pending("generation scheduler shut down "
+                               "mid-sequence")
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        if t is None or not t.is_alive():
+            # a drain-stop with no live loop (never started, or it died)
+            # would otherwise strand queued/slot requests un-completed
+            # forever — their waiters must get an error, not a hang
+            self._thread = None
+            self._fail_pending("generation scheduler stopped with no "
+                               "decode loop to drain the request")
+
+    def _fail_pending(self, why):
+        with self._lock:
+            dropped = list(self._q)
+            self._q.clear()
+            self._m_depth.set(len(self._q))
+        for s, req in enumerate(self._slots):
+            if req is not None:
+                self._slots[s] = None
+                if not req.finished:
+                    dropped.append(req)
+        for req in dropped:
+            if not req.finished:
+                self._m_errors.inc()
+                req.done(error=ServingClosedError(why))
+        self._m_busy.set(0)
+
+    @property
+    def alive(self) -> int:
+        t = self._thread
+        return int(t is not None and t.is_alive())

@@ -25,9 +25,58 @@ from ..monitor import counter, histogram
 from ..monitor import flight_recorder as _flight
 from ..profiler import RecordEvent, counters as _profiler_counters
 
-__all__ = ["ReplicaPool", "predictor_input_specs"]
+__all__ = ["ReplicaPool", "CompileWatch", "predictor_input_specs"]
 
 _JIT_MISS = "executor::jit_cache_miss"
+
+
+class CompileWatch:
+    """Warmup-snapshot compile accounting, shared by the replica pool
+    and the continuous-batching generation worker.
+
+    ``arm()`` after warmup snapshots a compile counter (read through
+    ``read``); any later growth is an UNEXPECTED compile — the bounded-
+    compile invariant broke — counted loudly into ``metric`` plus a
+    flight-recorder event instead of silently re-growing the cache.
+    ``note()`` is an atomic read-compare-bump: N workers may observe the
+    same miss concurrently and it must count once.
+    """
+
+    def __init__(self, read, metric="serving/unexpected_compiles",
+                 event="serving_unexpected_compile"):
+        self._read = read
+        self._event = event
+        self._baseline = None
+        self._seen = 0
+        self._metric = counter(metric)
+        self._lock = threading.Lock()
+
+    def arm(self):
+        self._baseline = self._read()
+        self._seen = 0
+        return self
+
+    @property
+    def armed(self) -> bool:
+        return self._baseline is not None
+
+    def extra(self) -> int:
+        """Compiles since ``arm()`` — steady state must keep this 0."""
+        if self._baseline is None:
+            raise PreconditionNotMetError(
+                "extra_compiles() before warmup(): nothing to compare")
+        return self._read() - self._baseline
+
+    def note(self, **fields):
+        """Record any NEW growth since the last note (no-op when flat)."""
+        with self._lock:
+            extra = self.extra()
+            grew = extra - self._seen
+            if grew <= 0:
+                return
+            self._seen = extra
+            self._metric.inc(grew)
+            _flight.record_event(self._event, total=extra, **fields)
 
 
 def predictor_input_specs(predictor) -> dict:
@@ -81,12 +130,8 @@ class ReplicaPool:
         self._live = threading.Event()
         self._live.set()
         self.warmed = False
-        self._misses_after_warmup = None
-        self._unexpected = counter("serving/unexpected_compiles")
-        # N workers note compiles concurrently; the read-compare-bump
-        # must be atomic or one miss double-counts
-        self._unexpected_lock = threading.Lock()
-        self._unexpected_seen = 0
+        self._watch = CompileWatch(
+            lambda: _profiler_counters().get(_JIT_MISS, 0))
         self._h_dispatch = histogram("serving/dispatch_ms")
         from . import _register_live
 
@@ -118,7 +163,7 @@ class ReplicaPool:
             feed = self._synthetic_feed(bucket)
             with RecordEvent("serving::warmup"):
                 pred.run([feed[n] for n in names])
-        self._misses_after_warmup = _profiler_counters().get(_JIT_MISS, 0)
+        self._watch.arm()
         self.warmed = True
         _flight.record_event(
             "serving_warmup", buckets=list(self.batcher.buckets),
@@ -128,11 +173,7 @@ class ReplicaPool:
     def extra_compiles(self) -> int:
         """Jit-cache misses since warmup — the bounded-compile assertion:
         steady-state serving must keep this at 0."""
-        if self._misses_after_warmup is None:
-            raise PreconditionNotMetError(
-                "extra_compiles() before warmup(): nothing to compare")
-        return (_profiler_counters().get(_JIT_MISS, 0)
-                - self._misses_after_warmup)
+        return self._watch.extra()
 
     # -- worker loop ---------------------------------------------------------
 
@@ -177,17 +218,8 @@ class ReplicaPool:
     def _note_unexpected_compiles(self, replica_idx, bucket):
         """The ladder invariant broke (a feed escaped the buckets, or
         the program changed under us): count it loudly rather than
-        silently re-growing the cache. One atomic read-compare-bump."""
-        with self._unexpected_lock:
-            extra = self.extra_compiles()
-            grew = extra - self._unexpected_seen
-            if grew <= 0:
-                return
-            self._unexpected_seen = extra
-            self._unexpected.inc(grew)
-            _flight.record_event(
-                "serving_unexpected_compile", replica=replica_idx,
-                bucket=bucket, total=extra)
+        silently re-growing the cache."""
+        self._watch.note(replica=replica_idx, bucket=bucket)
 
     # -- lifecycle -----------------------------------------------------------
 

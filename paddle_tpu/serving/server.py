@@ -44,9 +44,10 @@ from .batcher import (
     QueueFullError,
     ServingClosedError,
 )
+from .continuous import ContinuousBatcher
 from .replica import ReplicaPool
 
-__all__ = ["InferenceServer"]
+__all__ = ["InferenceServer", "GenerationServer"]
 
 
 def _json_default(o):
@@ -59,7 +60,51 @@ def _json_default(o):
     return str(o)
 
 
-class _ServingHandler(BaseHTTPRequestHandler):
+def _stats_readers():
+    """One registry snapshot + the counter/quantile readers both statz
+    endpoints share (a change to the quantile fields must not have to be
+    made twice)."""
+    snap = registry_snapshot()
+    from ..monitor import all_metrics
+
+    metrics = all_metrics()
+
+    def val(name):
+        return snap.get(name, {}).get("value", 0)
+
+    def quantiles(name):
+        h = metrics.get(name)
+        if h is None or h.kind != "histogram" or h.count == 0:
+            return None
+        return {"p50_ms": round(histogram_quantile(h, 0.5), 3),
+                "p99_ms": round(histogram_quantile(h, 0.99), 3),
+                "count": h.count}
+
+    return val, quantiles
+
+
+def _utilization(t0, flops0, val):
+    """Capacity math from the cost-model ledger: the engine/executor
+    dispatches every serving program, so executed FLOPs accumulate
+    there; the delta since server construction over uptime is average
+    achieved FLOP/s -> MFU against the device peak (the ``/clusterz``
+    denominator, extended to serving). Returns (uptime_s, block)."""
+    uptime = max(time.monotonic() - t0, 1e-9)
+    executed = val("cost/executed_flops") - flops0
+    peaks = _cost.device_peaks()
+    return uptime, {
+        "executed_flops": executed,
+        "mfu_avg": round(_cost.mfu(executed / uptime, peaks), 6),
+        "device_kind": peaks.get("kind"),
+        "peaks_nominal": peaks.get("nominal"),
+    }
+
+
+class _BaseHandler(BaseHTTPRequestHandler):
+    """Shared plumbing for the serving frontends: JSON replies, silent
+    request logging, and the introspection GET routes every server
+    exposes (``/healthz`` readiness, ``/statz``, ``/metrics``)."""
+
     server_version = "ptpu-serving/1"
 
     def log_message(self, *args):  # no per-request stderr chatter
@@ -82,12 +127,26 @@ class _ServingHandler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass
 
-    def do_GET(self):
-        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+    def _try_submit(self, fn):
+        """Run an admission call, mapping the shared backpressure
+        contract onto statuses: full queue 429, draining/closed 503,
+        malformed 400. Returns the submitted request, or ``None`` after
+        replying with the error."""
+        try:
+            return fn()
+        except QueueFullError as e:
+            self._reply(429, {"error": str(e)})
+        except ServingClosedError as e:
+            self._reply(503, {"error": str(e)})
+        except InvalidArgumentError as e:
+            self._reply(400, {"error": str(e)})
+        return None
+
+    def _get_common(self, path) -> bool:
+        """Serve the shared GET routes; True when handled."""
         srv = self._srv
         if path == "/healthz":
-            ready = srv.ready
-            self._reply(200 if ready else 503, srv.healthz())
+            self._reply(200 if srv.ready else 503, srv.healthz())
         elif path == "/statz":
             self._reply(200, srv.statz())
         elif path == "/metrics":
@@ -97,7 +156,17 @@ class _ServingHandler(BaseHTTPRequestHandler):
             )
 
             self._reply(200, prometheus_text(), PROMETHEUS_CONTENT_TYPE)
-        elif path == "/":
+        else:
+            return False
+        return True
+
+
+class _ServingHandler(_BaseHandler):
+    def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if self._get_common(path):
+            return
+        if path == "/":
             self._reply(200, {
                 "service": "paddle_tpu serving",
                 "routes": ["/predict (POST)", "/healthz", "/statz",
@@ -129,16 +198,9 @@ class _ServingHandler(BaseHTTPRequestHandler):
         except (ValueError, TypeError, InvalidArgumentError) as e:
             self._reply(400, {"error": str(e)})
             return
-        try:
-            req = srv.batcher.submit(inputs, deadline_ms=deadline_ms)
-        except QueueFullError as e:
-            self._reply(429, {"error": str(e)})
-            return
-        except ServingClosedError as e:
-            self._reply(503, {"error": str(e)})
-            return
-        except InvalidArgumentError as e:
-            self._reply(400, {"error": str(e)})
+        req = self._try_submit(
+            lambda: srv.batcher.submit(inputs, deadline_ms=deadline_ms))
+        if req is None:
             return
         try:
             outs = req.wait(srv.request_timeout_s)
@@ -261,9 +323,12 @@ class InferenceServer:
         self._stopped = True
         self.draining = True
         self.pool.stop(drain=drain, timeout=timeout)  # closes the batcher
-        self._httpd.shutdown()
-        self._httpd.server_close()
         t = self._thread
+        if t is not None and t.is_alive():
+            # shutdown() blocks on an event only serve_forever() sets —
+            # calling it on a never-started listener would hang forever
+            self._httpd.shutdown()
+        self._httpd.server_close()
         if t is not None:
             t.join(timeout=5)
         self._thread = None
@@ -284,23 +349,7 @@ class InferenceServer:
         }
 
     def statz(self) -> dict:
-        snap = registry_snapshot()
-
-        def val(name):
-            return snap.get(name, {}).get("value", 0)
-
-        from ..monitor import all_metrics
-
-        metrics = all_metrics()
-
-        def quantiles(name):
-            h = metrics.get(name)
-            if h is None or h.kind != "histogram" or h.count == 0:
-                return None
-            return {"p50_ms": round(histogram_quantile(h, 0.5), 3),
-                    "p99_ms": round(histogram_quantile(h, 0.99), 3),
-                    "count": h.count}
-
+        val, quantiles = _stats_readers()
         batches = val("serving/batches_total")
         slots = val("serving/batch_slots_total")
         rows = val("serving/batched_rows_total")
@@ -330,17 +379,304 @@ class InferenceServer:
                 "unexpected": val("serving/unexpected_compiles"),
             },
         }
-        # capacity math from the cost-model ledger: the executor dispatches
-        # every serving batch, so executed FLOPs accumulate there; over
-        # server uptime that is average achieved FLOP/s -> MFU against the
-        # device peak (the /clusterz denominator, extended to serving)
-        uptime = max(time.monotonic() - self._t0, 1e-9)
-        executed = val("cost/executed_flops") - self._flops0
-        peaks = _cost.device_peaks()
-        out["utilization"] = {
-            "executed_flops": executed,
-            "mfu_avg": round(_cost.mfu(executed / uptime, peaks), 6),
-            "device_kind": peaks.get("kind"),
-            "peaks_nominal": peaks.get("nominal"),
+        _, out["utilization"] = _utilization(self._t0, self._flops0, val)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# generative inference frontend
+# ---------------------------------------------------------------------------
+
+
+class _GenerationHandler(_BaseHandler):
+    # chunked transfer encoding (the streaming /generate response) does
+    # not exist in HTTP/1.0 — spec-conforming clients key dechunking on
+    # the version line. Non-stream replies all carry Content-Length, so
+    # HTTP/1.1 keep-alive stays correct.
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        if self._get_common(path):
+            return
+        if path == "/":
+            self._reply(200, {
+                "service": "paddle_tpu generation",
+                "routes": ["/generate (POST)", "/healthz", "/statz",
+                           "/metrics"]})
+        else:
+            self._reply(404, {"error": f"unknown path {path!r}"})
+
+    def do_POST(self):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path != "/generate":
+            self._reply(404, {"error": f"unknown path {path!r}"})
+            return
+        srv = self._srv
+        if not srv.ready:
+            self._reply(503, {"error": "not ready"
+                              if not srv.draining else "draining"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise InvalidArgumentError(
+                    "request body must be a JSON object with a "
+                    '"prompt" key')
+            prompt = body.get("prompt")
+            if (not isinstance(prompt, (list, tuple)) or not prompt
+                    or not all(isinstance(t, int) for t in prompt)):
+                raise InvalidArgumentError(
+                    '"prompt" must be a non-empty list of token ids '
+                    "(ints)")
+            max_new = body.get("max_new_tokens")
+            max_new = int(max_new) if max_new is not None else None
+            temperature = body.get("temperature")
+            temperature = (float(temperature) if temperature is not None
+                           else None)
+            deadline_ms = body.get("deadline_ms")
+            if deadline_ms is not None:
+                deadline_ms = float(deadline_ms)
+            stream = bool(body.get("stream", False))
+        except (ValueError, TypeError, InvalidArgumentError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        if stream:
+            self._generate_stream(srv, prompt, max_new, temperature,
+                                  deadline_ms)
+            return
+        req = self._try_submit(lambda: srv.scheduler.submit(
+            prompt, max_new_tokens=max_new, temperature=temperature,
+            deadline_ms=deadline_ms))
+        if req is None:
+            return
+        try:
+            tokens = req.wait(srv.request_timeout_s)
+        except DeadlineExceededError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — a failed step must answer
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._reply(200, {
+            "tokens": tokens,
+            "finish_reason": req.finish_reason,
+            "prompt_tokens": len(req.prompt),
+        })
+
+    def _generate_stream(self, srv, prompt, max_new, temperature,
+                         deadline_ms):
+        """Chunked ndjson streaming: one ``{"token": id}`` line per
+        decoded token as it is produced, then a final ``{"done": ...}``
+        line with the full result — the scheduler's ``on_token`` hook
+        feeding an HTTP chunk per decode step."""
+        import queue as _queue
+
+        q = _queue.Queue()
+        req = self._try_submit(lambda: srv.scheduler.submit(
+            prompt, max_new_tokens=max_new, temperature=temperature,
+            deadline_ms=deadline_ms, on_token=q.put))
+        if req is None:
+            return
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "application/x-ndjson; charset=utf-8")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(obj):
+            data = (json.dumps(obj, default=_json_default) + "\n").encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode()
+                             + data + b"\r\n")
+
+        t_end = time.monotonic() + srv.request_timeout_s
+        try:
+            while True:
+                try:
+                    chunk({"token": q.get(timeout=0.1)})
+                    continue
+                except _queue.Empty:
+                    pass
+                if req.finished or time.monotonic() > t_end:
+                    break
+            while not q.empty():  # tokens landed between poll and finish
+                chunk({"token": q.get_nowait()})
+            if req.error is not None:
+                chunk({"error": f"{type(req.error).__name__}: "
+                                f"{req.error}"})
+            elif not req.finished:
+                chunk({"error": "stream timeout"})
+            else:
+                chunk({"done": True, "tokens": req.tokens,
+                       "finish_reason": req.finish_reason,
+                       "prompt_tokens": len(req.prompt)})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; decoding continues
+        finally:
+            # every exit abandons the local queue — a still-decoding
+            # request must stop feeding it (timeout/error paths would
+            # otherwise accumulate every remaining token unread)
+            req.on_token = None
+
+
+class GenerationServer:
+    """Composed generative-serving stack: HTTP frontend ->
+    ContinuousBatcher (slot scheduler) -> GenerationEngine over a causal
+    LM.
+
+    ``model_or_engine`` is either a ready :class:`GenerationEngine` or a
+    causal LM (``GPTForCausalLM``-shaped), in which case an engine is
+    built from the ``generation_*`` flags / keyword overrides. As with
+    :class:`InferenceServer`, ``start()`` warms by default so
+    ``/healthz`` readiness means every prefill bucket AND the decode
+    step are compiled.
+    """
+
+    def __init__(self, model_or_engine, port=0, host="127.0.0.1",
+                 slots=None, cache_len=None, prefill_buckets=None,
+                 queue_capacity=None, max_new_tokens=None,
+                 temperature=None, top_k=None, request_timeout_s=120.0):
+        if hasattr(model_or_engine, "step") and hasattr(
+                model_or_engine, "admit"):
+            dropped = {
+                "slots": slots, "cache_len": cache_len,
+                "prefill_buckets": prefill_buckets,
+                "max_new_tokens": max_new_tokens,
+                "temperature": temperature, "top_k": top_k,
+            }
+            bad = sorted(k for k, v in dropped.items() if v is not None)
+            if bad:
+                raise InvalidArgumentError(
+                    f"GenerationServer got a ready engine AND engine-"
+                    f"construction kwargs {bad}; configure them on the "
+                    "engine, or pass the model instead")
+            self.engine = model_or_engine
+        else:
+            from ..generation.engine import GenerationEngine
+
+            self.engine = GenerationEngine(
+                model_or_engine, slots=slots, cache_len=cache_len,
+                prefill_buckets=prefill_buckets,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_k=top_k)
+        self.scheduler = ContinuousBatcher(
+            self.engine, queue_capacity=queue_capacity)
+        self.request_timeout_s = request_timeout_s
+        self._httpd = ThreadingHTTPServer((host, int(port)),
+                                          _GenerationHandler)
+        self._httpd.daemon_threads = True
+        self._httpd._inference_server = self
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = None
+        self._t0 = time.monotonic()
+        snap = registry_snapshot()
+        self._flops0 = snap.get(
+            "cost/executed_flops", {}).get("value", 0.0)
+        self._tokens0 = snap.get(
+            "serving/gen_tokens_total", {}).get("value", 0)
+        self.draining = False
+        self._stopped = False
+        from . import _register_live
+
+        _register_live(self)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def ready(self) -> bool:
+        return self.engine.warmed and not self.draining
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self, warmup=True):
+        self.scheduler.start()
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=f"ptpu-generation:{self.port}", daemon=True)
+            self._thread.start()
+        _flight.record_event(
+            "generation_server_start", port=self.port,
+            slots=self.engine.slots,
+            prefill_buckets=list(self.engine.prefill_buckets),
+            cache_len=self.engine.cache_len)
+        if warmup:
+            self.warmup()
+        return self
+
+    def warmup(self):
+        self.engine.warmup()
+        return self
+
+    def stop(self, drain=True, timeout=30.0):
+        if self._stopped:
+            return
+        self._stopped = True
+        self.draining = True
+        self.scheduler.stop(drain=drain, timeout=timeout)
+        t = self._thread
+        if t is not None and t.is_alive():
+            # shutdown() blocks on an event only serve_forever() sets —
+            # calling it on a never-started listener would hang forever
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if t is not None:
+            t.join(timeout=5)
+        self._thread = None
+        _flight.record_event("generation_server_stop", port=self.port,
+                             drain=drain)
+
+    # -- introspection payloads ---------------------------------------------
+
+    def healthz(self) -> dict:
+        return {
+            "ready": self.ready,
+            "warmed": self.engine.warmed,
+            "draining": self.draining,
+            "uptime_s": round(time.monotonic() - self._t0, 3),
+            "slots": self.engine.slots,
+            "slots_busy": self.scheduler.live_slots,
+            "cache_len": self.engine.cache_len,
+            "prefill_buckets": list(self.engine.prefill_buckets),
+            "queue_depth": self.scheduler.queue_depth(),
+            "queue_capacity": self.scheduler.queue_capacity,
+        }
+
+    def statz(self) -> dict:
+        val, quantiles = _stats_readers()
+        uptime, utilization = _utilization(self._t0, self._flops0, val)
+        tokens = val("serving/gen_tokens_total") - self._tokens0
+        out = {
+            **self.healthz(),
+            "requests": {
+                "submitted": val("serving/gen_requests_total"),
+                "completed": val("serving/gen_responses_total"),
+                "rejected_429": val("serving/gen_rejected_total"),
+                "deadline_expired": val("serving/gen_expired_total"),
+                "errors": val("serving/gen_errors_total"),
+            },
+            "generation": {
+                "tokens_generated": tokens,
+                "tokens_per_sec": round(tokens / uptime, 3),
+                "slot_occupancy": round(self.scheduler.occupancy(), 4),
+                "midbatch_admissions": val(
+                    "serving/gen_midbatch_admissions_total"),
+            },
+            "latency": {
+                "token": quantiles("serving/gen_token_ms"),
+                "ttft": quantiles("serving/gen_ttft_ms"),
+                "e2e": quantiles("serving/gen_e2e_ms"),
+            },
+            "compiles": {
+                "prefill_buckets": len(self.engine.prefill_buckets),
+                "decode": 1,
+                "unexpected": val("serving/gen_unexpected_compiles"),
+            },
+            "utilization": utilization,
         }
         return out

@@ -14,6 +14,8 @@
 #           + debugz smoke (debug server endpoints + flight-recorder dump)
 #           + mfu smoke (cost-model capture + utilization endpoints)
 #           + serving smoke (online batcher/replica/HTTP contracts)
+#           + generation smoke (prefill ladder/compile-once decode,
+#             KV-cache parity, streaming /generate, drain)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -81,6 +83,9 @@ case "$MODE" in
     JAX_PLATFORMS=cpu python tools/utilization_smoke.py
     # serving smoke: warmed-bucket readiness, bounded compiles, 429, drain
     JAX_PLATFORMS=cpu python tools/serving_smoke.py
+    # generation smoke: prefill ladder + single decode compile, KV-cache
+    # parity over HTTP, streaming round trip, drain leaves no live slots
+    JAX_PLATFORMS=cpu python tools/generation_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

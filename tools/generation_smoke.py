@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""CI smoke for generative inference (`make gen-smoke`).
+
+Stands up the full stack — tiny GPT causal LM, GenerationEngine
+(bucketed prefill + compile-once ring-cache decode), ContinuousBatcher
+slot scheduler, GenerationServer HTTP frontend — and asserts the
+production contracts end to end:
+
+- compile-bound generation: warmup costs exactly len(prefill ladder) + 1
+  programs (``generation::compile`` counter), and a burst of
+  mixed-length prompts afterwards costs ZERO more;
+- parity: greedy tokens served over HTTP equal an independent engine's
+  offline greedy decode of the same prompts (continuous batching and
+  bucket padding are numerically inert);
+- streaming: the ndjson stream delivers every token and a final summary
+  line consistent with the non-streamed reply;
+- /statz carries tokens/sec, slot occupancy, and per-token latency;
+- graceful drain: ``stop(drain=True)`` finishes queued work, leaves no
+  live slot, and kills the decode loop + listener.
+
+Exit 0 on success; a failure is a real generation-serving regression.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from urllib.error import HTTPError, URLError
+from urllib.request import Request, urlopen
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+SLOTS = 2
+CACHE_LEN = 32
+BUCKETS = (4, 8)
+
+
+def _post(url, payload, timeout=120):
+    body = json.dumps(payload).encode()
+    try:
+        r = urlopen(Request(url + "/generate", data=body,
+                            headers={"Content-Type": "application/json"}),
+                    timeout=timeout)
+        return r.status, json.loads(r.read())
+    except HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.generation import COMPILE_COUNTER, GenerationEngine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny_config
+    from paddle_tpu.serving import GenerationServer
+
+    paddle.seed(11)
+    cfg = gpt_tiny_config()
+    cfg.attention_window = CACHE_LEN
+    model = GPTForCausalLM(cfg)
+
+    rng = np.random.RandomState(0)
+    prompts = [list(map(int, rng.randint(3, 200, size=n)))
+               for n in (1, 3, 8, 5, 2, 7, 4, 6)]
+    budgets = [int(b) for b in rng.randint(2, 10, size=len(prompts))]
+
+    # independent reference engine: offline greedy, solo slots
+    ref_eng = GenerationEngine(model, slots=1, cache_len=CACHE_LEN,
+                               prefill_buckets=BUCKETS).warmup()
+    refs = [ref_eng.generate([p], max_new_tokens=b, temperature=0.0)[0]
+            for p, b in zip(prompts, budgets)]
+
+    srv = GenerationServer(
+        GenerationEngine(model, slots=SLOTS, cache_len=CACHE_LEN,
+                         prefill_buckets=BUCKETS),
+        port=0, queue_capacity=32)
+    try:
+        # -- readiness gating + exact warmup compile count -------------
+        srv.start(warmup=False)
+        try:
+            urlopen(srv.url + "/healthz")
+            raise AssertionError("/healthz must be 503 before warmup")
+        except HTTPError as e:
+            assert e.code == 503, e.code
+        c0 = profiler.counters().get(COMPILE_COUNTER, 0)
+        srv.warmup()
+        warm = profiler.counters().get(COMPILE_COUNTER, 0) - c0
+        assert warm == len(BUCKETS) + 1, (
+            f"warmup cost {warm} compiles, expected prefill ladder "
+            f"({len(BUCKETS)}) + 1 decode")
+        hz = json.loads(urlopen(srv.url + "/healthz").read())
+        assert hz["ready"] and hz["prefill_buckets"] == list(BUCKETS), hz
+
+        # -- mixed-length burst: parity + zero extra compiles ----------
+        for p, b, ref in zip(prompts, budgets, refs):
+            status, out = _post(srv.url, {
+                "prompt": p, "max_new_tokens": b, "temperature": 0.0})
+            assert status == 200, (status, out)
+            assert out["tokens"] == ref, (p, out["tokens"], ref)
+        total = profiler.counters().get(COMPILE_COUNTER, 0) - c0
+        assert total == len(BUCKETS) + 1, (
+            f"burst grew compiles to {total}; the prefill ladder + "
+            "single decode program must bound them")
+        assert srv.engine.extra_compiles() == 0
+
+        # -- streaming round trip --------------------------------------
+        body = json.dumps({"prompt": prompts[0], "max_new_tokens":
+                           budgets[0], "temperature": 0.0,
+                           "stream": True}).encode()
+        r = urlopen(Request(srv.url + "/generate", data=body), timeout=120)
+        lines = [json.loads(l) for l in r.read().decode().splitlines()]
+        toks = [l["token"] for l in lines if "token" in l]
+        assert lines[-1].get("done") and lines[-1]["tokens"] == toks
+        assert toks == refs[0], (toks, refs[0])
+
+        # -- statz: tokens/sec, occupancy, per-token latency -----------
+        sz = json.loads(urlopen(srv.url + "/statz").read())
+        assert sz["generation"]["tokens_per_sec"] > 0, sz["generation"]
+        assert sz["latency"]["token"]["p99_ms"] >= 0
+        assert sz["compiles"]["unexpected"] == 0
+        assert sz["requests"]["completed"] == len(prompts) + 1
+
+        # -- graceful drain: no live slots, loop + listener down -------
+        srv.stop(drain=True)
+        assert srv.scheduler.live_slots == 0, "slots survived drain"
+        assert srv.scheduler.alive == 0, "decode loop survived drain"
+        try:
+            urlopen(srv.url + "/healthz", timeout=2)
+            raise AssertionError("listener still up after stop()")
+        except (URLError, ConnectionError, OSError):
+            pass
+        print(f"gen-smoke OK: {len(BUCKETS)} prefill buckets + 1 decode "
+              f"= {total} compiles, {sz['requests']['completed']} served, "
+              f"{sz['generation']['tokens_generated']} tokens "
+              f"(parity + streaming + drain verified)")
+        return 0
+    finally:
+        srv.stop(drain=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
