@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -52,6 +52,9 @@ serve-smoke:  # online serving: readiness gating, bounded compiles, 429, drain
 
 gen-smoke:  # generative serving: prefill ladder + compile-once decode, parity, streaming, drain
 	JAX_PLATFORMS=cpu python tools/generation_smoke.py
+
+router-smoke:  # serving fleet: 2 backend processes + router, kill -9 survival, drain
+	JAX_PLATFORMS=cpu python tools/router_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

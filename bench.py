@@ -569,6 +569,243 @@ def bench_serving_throughput(requests=120, rows_cycle=(1, 2, 3, 4),
         static.global_scope().clear()
 
 
+def bench_router_throughput(requests=640, rows_cycle=(1, 2, 3, 4),
+                            backend_counts=(1, 2), clients_per_backend=24):
+    """Serving fleet scaling: an offered-load sweep over 1 -> N
+    independent backend PROCESSES behind the router, vs the same load on
+    a single backend.
+
+    Each backend is a real ``python -m paddle_tpu.serving.backend``
+    subprocess (own interpreter, own XLA client, own registry) booted by
+    the scaler's SubprocessLauncher, and the router runs as ITS OWN
+    process too (``python -m paddle_tpu.serving.router`` — an in-bench
+    router would share the client threads' GIL and cap the whole sweep
+    at one core of Python) — process-level parallelism end to end, not
+    the thread-level replica pool the ``serving_throughput`` row
+    measures. Reports requests/sec and rows/sec per fleet size, the
+    1->N speedup (the near-linear scaling acceptance), fleet p50/p99
+    merged from the backends' /histz bucket counts, and per-backend
+    compile accounting scraped from /loadz (each backend exactly
+    len(ladder) jit misses, zero unexpected — the bounded-compile
+    discipline holds per process).
+    """
+    import os
+    import subprocess
+    import tempfile
+    import threading
+    from urllib.request import urlopen
+
+    import paddle_tpu.static as static
+    from paddle_tpu.serving import SubprocessLauncher
+
+    buckets = (1, 2, 4, 8)
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        # wide enough that one backend process is genuinely compute-
+        # bound well below the client side's capacity — the sweep must
+        # measure BACKEND scaling, not the load generator's ceiling
+        x = static.data("x", [None, 64], "float32")
+        h = static.nn.fc(x, 4096, name="rt_fc1")
+        h = static.nn.fc(h, 4096, name="rt_fc2")
+        h = static.nn.fc(h, 4096, name="rt_fc3")
+        y = static.nn.fc(h, 8, name="rt_fc4")
+        exe = static.Executor()
+        exe.run_startup()
+        model_dir = tempfile.mkdtemp(prefix="ptpu_bench_router_")
+        static.save_inference_model(model_dir, ["x"], [y], exe)
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+
+    from paddle_tpu.serving.scaler import launch_process
+
+    # core layout: disjoint sets per backend (on one box XLA:CPU would
+    # otherwise spread each backend's intra-op threads across EVERY
+    # core and co-hosted backends would contend for the same silicon —
+    # pinning emulates one host per backend, what a real fleet has),
+    # the router on its own pair, the load generator on the rest.
+    # Boxes too small to split run everything unpinned — the scaling
+    # number is then contention-limited, but the row still runs.
+    ncores = os.cpu_count() or 1
+    # 2 cores per backend: small enough that neither shared DRAM
+    # bandwidth (the 4096-wide weights stream from memory every
+    # dispatch) nor the single-process load generator approaches its
+    # ceiling before the second backend shows — measured headroom is
+    # what makes the scaling number repeatable
+    per = min(2, ncores // (max(backend_counts) + 1))
+    cpu_sets = ([f"{i * per}-{(i + 1) * per - 1}"
+                 for i in range(max(backend_counts))]
+                if per >= 1 else None)
+    n_backend_cores = per * max(backend_counts) if cpu_sets else 0
+    router_cores = None
+    orig_affinity = None
+    if cpu_sets and ncores > n_backend_cores + 2:
+        router_cores = f"{n_backend_cores}-{n_backend_cores + 1}"
+        try:
+            orig_affinity = os.sched_getaffinity(0)
+            os.sched_setaffinity(
+                0, set(range(n_backend_cores + 2, ncores)))
+        except (AttributeError, OSError):
+            orig_affinity = None
+    launcher = SubprocessLauncher(model_dir, buckets=buckets,
+                                  batch_timeout_ms=1.0, replicas=2,
+                                  queue_capacity=max(64, requests),
+                                  cpu_sets=cpu_sets)
+
+    def spawn_router(urls):
+        """Router as its own process (shared launch_process recipe:
+        PYTHONPATH, port-file-when-ready, taskset); (proc, url)."""
+        args = ["--probe-interval-s", "1.0"]
+        for u in urls:
+            args += ["--backend", u]
+        h = launch_process("paddle_tpu.serving.router", args,
+                           cpus=router_cores, startup_timeout_s=120)
+        return h.proc, h.url
+
+    payloads = []
+    rng = np.random.RandomState(0)
+    for i in range(max(requests // (clients_per_backend
+                            * max(backend_counts)), 1)):
+        rows = rows_cycle[i % len(rows_cycle)]
+        payloads.append(json.dumps({
+            "inputs": rng.randn(rows, 64).astype("float32").tolist()
+        }).encode())
+    rows_per_client = sum(
+        rows_cycle[i % len(rows_cycle)] for i in range(len(payloads)))
+
+    sweep = []
+    try:
+        for n in backend_counts:
+            # WEAK scaling: offered load grows with the fleet (a fleet
+            # exists because traffic grew) — a fixed closed-loop client
+            # count would hand each fleet backend a shallower queue and
+            # worse batch fill than the solo baseline enjoyed, and the
+            # sweep would measure that artifact, not capacity
+            clients = clients_per_backend * n
+            handles = [launcher.launch() for _ in range(n)]
+            rproc, rurl = spawn_router([h.url for h in handles])
+            try:
+                failures = []
+                from http.client import HTTPConnection
+                from urllib.parse import urlsplit
+
+                ru = urlsplit(rurl)
+                # all clients connect + warm OUTSIDE the timed window
+                # (a closed-loop sweep otherwise times its own
+                # ramp-up), then release together per trial
+                barrier = None
+
+                def post_one(conn, body):
+                    try:
+                        conn.request("POST", "/predict", body=body,
+                                     headers={"Content-Type":
+                                              "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        if resp.status != 200:
+                            failures.append(f"HTTP {resp.status}")
+                        if resp.will_close:
+                            conn.close()
+                            conn = HTTPConnection(ru.hostname, ru.port,
+                                                  timeout=60)
+                    except Exception as e:  # noqa: BLE001
+                        failures.append(repr(e))
+                        conn.close()
+                        conn = HTTPConnection(ru.hostname, ru.port,
+                                              timeout=60)
+                    return conn
+
+                def client(cid):
+                    # keep-alive load generator: one persistent
+                    # connection per client (a connection-per-request
+                    # generator measures TCP/thread churn, not the
+                    # fleet)
+                    conn = HTTPConnection(ru.hostname, ru.port,
+                                          timeout=60)
+                    try:
+                        for body in payloads[:2]:  # untimed warmup
+                            conn = post_one(conn, body)
+                        barrier.wait()
+                        for body in payloads:
+                            conn = post_one(conn, body)
+                    finally:
+                        conn.close()
+
+                # best-of-2 timed trials (the deeply saturated
+                # closed loop is noisy at the few-percent level; the
+                # ratio of two levels doubles that)
+                dts = []
+                for _trial in range(2):
+                    barrier = threading.Barrier(clients + 1)
+                    threads = [threading.Thread(target=client,
+                                                args=(c,))
+                               for c in range(clients)]
+                    for t in threads:
+                        t.start()
+                    barrier.wait()
+                    t0 = time.perf_counter()
+                    for t in threads:
+                        t.join()
+                    dts.append(time.perf_counter() - t0)
+                    assert not failures, failures[:3]
+                dt = min(dts)
+                per_backend = []
+                for h in handles:
+                    lz = json.loads(urlopen(h.url + "/loadz").read())
+                    assert lz["compiles"]["jit_misses"] == len(buckets), lz
+                    assert lz["compiles"]["unexpected"] == 0, lz
+                    per_backend.append({
+                        "url": h.url,
+                        "compiles": lz["compiles"],
+                        "mean_fill": lz["mean_fill"],
+                    })
+                sz = json.loads(urlopen(rurl + "/statz").read())
+                assert (sz["fleet"]["requests"]
+                        >= len(payloads) * clients), sz["fleet"]
+                merged = sz["latency"]["backends_merged"][
+                    "serving/e2e_ms"]
+                total = len(payloads) * clients
+                sweep.append({
+                    "backends": n,
+                    "requests": total,
+                    "req_per_sec": round(total / dt, 1),
+                    "rows_per_sec": round(
+                        rows_per_client * clients / dt, 1),
+                    "p50_ms": merged["p50_ms"],
+                    "p99_ms": merged["p99_ms"],
+                    "per_backend": per_backend,
+                })
+            finally:
+                rproc.terminate()
+                try:
+                    rproc.wait(15)
+                except subprocess.TimeoutExpired:
+                    rproc.kill()
+                for h in handles:
+                    launcher.terminate(h, drain=True)
+    finally:
+        if orig_affinity is not None:
+            # the affinity squeeze is sweep-local: the remaining bench
+            # rows must see the whole machine again
+            try:
+                os.sched_setaffinity(0, orig_affinity)
+            except OSError:
+                pass
+    base = sweep[0]["req_per_sec"]
+    best = sweep[-1]
+    return {
+        "metric": "router_throughput",
+        "value": best["req_per_sec"],
+        "unit": "requests/sec",
+        "scaling_vs_one_backend": round(best["req_per_sec"] / base, 3),
+        "scaling_target": 1.6,
+        "offered_load_sweep": sweep,
+        "compiles_per_backend_expected": len(buckets),
+    }
+
+
 def bench_decode_throughput(requests=16, slots=4, cache_len=64,
                             prefill_buckets=(8, 16)):
     """Generative decoding: continuous batching vs static batching on a
@@ -762,6 +999,8 @@ def main():
     result["serving_throughput"] = bench_serving_throughput()
     # generative decoding: continuous vs static batching, mixed lengths
     result["decode_throughput"] = bench_decode_throughput()
+    # serving fleet: 1 -> N backend processes behind the router
+    result["router_throughput"] = bench_router_throughput()
     print(json.dumps(result))
 
 

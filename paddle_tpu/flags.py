@@ -327,6 +327,72 @@ define_flag("generation_queue_capacity", 128,
             "max generation requests queued for decode slots before "
             "rejecting (backpressure: HTTP 429)")
 
+# serving/router.py — period of the router's backend prober (GET
+# /healthz + /loadz per backend): drives load-signal freshness AND the
+# only re-admission path for an evicted backend (readiness must flip
+# back on /healthz before it rejoins rotation).
+define_flag("serving_router_probe_interval_s", 1.0,
+            "seconds between router health/load probes of each backend; "
+            "also the re-admission latency for a recovered backend")
+
+# serving/router.py — how many DISTINCT backends one request may be
+# offered before the router gives up with 503. Retries happen only for
+# connection-level failures and admission rejections (503) — work a
+# backend actually answered is never replayed.
+define_flag("serving_router_retries", 3,
+            "max distinct backends tried per routed request before 503 "
+            "(connection failures / admission rejects only)")
+
+# serving/router.py — TCP connect budget per dispatch attempt. Short on
+# purpose: a dead backend must cost the request milliseconds (then the
+# next backend is tried), not a full request timeout.
+define_flag("serving_router_connect_timeout_ms", 1000.0,
+            "router->backend TCP connect timeout per attempt in ms")
+
+# serving/router.py — end-to-end budget for one proxied request once it
+# is on a backend (covers queueing + dispatch there).
+define_flag("serving_router_request_timeout_s", 120.0,
+            "router->backend response timeout once a request is "
+            "dispatched (seconds)")
+
+# serving/scaler.py — period of the autoscaler's evaluate loop; each
+# tick gathers router + cluster signals and runs one decision.
+define_flag("serving_scaler_interval_s", 5.0,
+            "seconds between autoscaler evaluations of the fleet signals")
+
+# serving/scaler.py — fleet size bounds the scaler may move between.
+define_flag("serving_scaler_min_backends", 1,
+            "autoscaler floor: never drain below this many backends")
+define_flag("serving_scaler_max_backends", 4,
+            "autoscaler ceiling: never launch above this many backends")
+
+# serving/scaler.py — scale-up pressure: mean queue depth per healthy
+# backend at/above this for `serving_scaler_window` consecutive
+# evaluations triggers a launch.
+define_flag("serving_scaler_up_queue_depth", 4.0,
+            "scale up when mean backend queue depth sustains at or "
+            "above this for a full hysteresis window")
+
+# serving/scaler.py — scale-down idleness: mean queue depth per backend
+# at/below this (and no inflight pressure) for a full window triggers a
+# drain of the least-loaded backend.
+define_flag("serving_scaler_down_queue_depth", 0.25,
+            "scale down when mean backend queue depth sustains at or "
+            "below this for a full hysteresis window")
+
+# serving/scaler.py — hysteresis: consecutive same-direction evaluations
+# required before acting (one spiky tick must not flap the fleet).
+define_flag("serving_scaler_window", 3,
+            "consecutive over/under-threshold evaluations required "
+            "before the autoscaler acts")
+
+# serving/scaler.py — cooldown after any scale action; decisions are
+# suppressed until it elapses so a fresh backend's warmup window cannot
+# be misread as sustained pressure.
+define_flag("serving_scaler_cooldown_s", 30.0,
+            "seconds after a scale action during which the autoscaler "
+            "makes no further decisions")
+
 # static/executor.py — JAX persistent compilation cache directory: repeated
 # process starts skip XLA recompilation of unchanged programs (the role of
 # TVM's ahead-of-time compiled module artifact). Empty string disables.

@@ -58,6 +58,7 @@ from .registry import (  # noqa: F401
     histogram,
     histogram_quantile,
     install_jax_listeners,
+    merge_histogram_snapshots,
     registry_snapshot,
     reset_registry,
     stat_add,
@@ -102,7 +103,7 @@ __all__ = [
     "counter", "gauge", "histogram",
     "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
     "registry_snapshot", "reset_registry", "all_metrics",
-    "histogram_quantile",
+    "histogram_quantile", "merge_histogram_snapshots",
     "collect_hbm_gauges", "hbm_watermark_bytes", "install_jax_listeners",
     "export_prometheus", "prometheus_text", "export_merged_chrome_trace",
     "PROMETHEUS_CONTENT_TYPE",

@@ -24,7 +24,7 @@ __all__ = [
     "counter", "gauge", "histogram",
     "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
     "registry_snapshot", "reset_registry", "all_metrics",
-    "histogram_quantile",
+    "histogram_quantile", "merge_histogram_snapshots",
     "collect_hbm_gauges", "hbm_watermark_bytes",
     "install_jax_listeners",
 ]
@@ -242,6 +242,44 @@ def histogram_quantile(h: Histogram, q: float) -> float:
         acc += c
         lo = bound
     return float(snap["bounds"][-1])
+
+
+def merge_histogram_snapshots(snapshots, name="merged") -> Histogram:
+    """Merge histogram ``snapshot()`` dicts from several sources (e.g. N
+    serving backends' ``/histz`` payloads) into one UNREGISTERED
+    :class:`Histogram` whose bucket counts are the elementwise sums —
+    feed it to :func:`histogram_quantile` for fleet-wide p50/p99.
+
+    Bucketed histograms merge exactly: summing per-bucket counts over
+    backends is identical to having observed every sample into one
+    pooled histogram (same bounds), so the router's merged quantiles
+    match the single-histogram golden. All snapshots must share the
+    same bounds; a mismatch raises rather than silently mis-binning.
+    """
+    snapshots = [s for s in snapshots if s]
+    if not snapshots:
+        raise ValueError("merge_histogram_snapshots needs >= 1 snapshot")
+    bounds = tuple(snapshots[0]["bounds"])
+    h = Histogram(name, buckets=bounds)
+    counts = [0] * (len(bounds) + 1)
+    total, sum_ = 0, 0.0
+    for s in snapshots:
+        if tuple(s["bounds"]) != bounds:
+            raise ValueError(
+                f"histogram bounds mismatch: {tuple(s['bounds'])} vs "
+                f"{bounds}; backends must share one bucket ladder")
+        if len(s["buckets"]) != len(counts):
+            raise ValueError(
+                f"histogram has {len(s['buckets'])} buckets, expected "
+                f"{len(counts)} (bounds + the +Inf bucket)")
+        for i, c in enumerate(s["buckets"]):
+            counts[i] += int(c)
+        total += int(s["count"])
+        sum_ += float(s["sum"])
+    h._counts = counts
+    h._count = total
+    h._sum = sum_
+    return h
 
 
 def all_metrics() -> dict:

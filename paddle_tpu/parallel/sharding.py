@@ -53,6 +53,12 @@ class ShardingRules:
                 return spec
         return self.default
 
+    def clamped_spec_for(self, name: str, ndim: int) -> P:
+        """``spec_for`` trimmed to the array rank (rules written for the
+        2D weight may match a 1D bias) — the public entry sharded
+        serving uses to map loaded inference params onto the mesh."""
+        return _clamp_spec(self.spec_for(name), ndim)
+
     def __add__(self, other: "ShardingRules") -> "ShardingRules":
         out = ShardingRules(default=other.default)
         out.rules = list(self.rules) + list(other.rules)

@@ -18,6 +18,22 @@ Layers (each usable alone) on top of ``paddle_tpu.inference.Predictor``:
   requests are admitted at the next step; served by
   :class:`GenerationServer` (``/generate``, streaming-friendly, with
   tokens/sec + slot occupancy + per-token latency on ``/statz``).
+- :mod:`serving.sharded` — GSPMD-SHARDED backends: commit the loaded
+  weights and feeds onto a device mesh per ``parallel.ShardingRules``
+  PartitionSpecs and the predictor's compiled program becomes a
+  partitioned program — one logical backend spanning a multi-device
+  world, executor caches/donation untouched.
+- :mod:`serving.router` — the FLEET tier: a front door spreading
+  ``/predict``/``/generate`` over N independent backend processes with
+  power-of-two-choices dispatch on their ``/loadz`` signals, health
+  probes with eviction/readmission, retry-on-next-backend for
+  connection failures (never for answered work), and fleet p50/p99
+  merged exactly from backend ``/histz`` bucket counts.
+- :mod:`serving.scaler` — metrics-driven AUTOSCALING: hysteresis +
+  cooldown decisions over router aggregates and ``monitor/cluster``
+  snapshots, acting through a pluggable backend launcher
+  (:class:`SubprocessLauncher` boots ``python -m
+  paddle_tpu.serving.backend`` processes with port-file discovery).
 
 Quickstart::
 
@@ -46,11 +62,30 @@ from .batcher import (  # noqa: F401
 from .replica import CompileWatch, ReplicaPool, predictor_input_specs  # noqa: F401
 from .continuous import ContinuousBatcher, GenerationRequest  # noqa: F401
 from .server import GenerationServer, InferenceServer  # noqa: F401
+from .sharded import ShardedPredictor, shard_predictor  # noqa: F401
+from .router import (  # noqa: F401
+    BackendState,
+    BackendTimeoutError,
+    BackendUnavailableError,
+    NoBackendError,
+    Router,
+)
+from .scaler import (  # noqa: F401
+    AutoScaler,
+    FleetSignals,
+    LaunchedBackend,
+    SubprocessLauncher,
+)
 
 __all__ = [
     "DynamicBatcher", "ReplicaPool", "InferenceServer",
     "ContinuousBatcher", "GenerationRequest", "GenerationServer",
     "CompileWatch",
+    "ShardedPredictor", "shard_predictor",
+    "Router", "BackendState", "NoBackendError",
+    "BackendUnavailableError", "BackendTimeoutError",
+    "AutoScaler", "FleetSignals", "SubprocessLauncher",
+    "LaunchedBackend",
     "QueueFullError", "DeadlineExceededError", "ServingClosedError",
     "parse_buckets", "predictor_input_specs", "shutdown_all",
 ]
@@ -69,11 +104,13 @@ def shutdown_all():
     """Stop every live server, pool, and batcher (idempotent; exceptions
     swallowed — this is the test-teardown / atexit path, where a
     half-constructed object must not mask the real failure)."""
-    # servers first (they drain their own pool/scheduler+batcher), then
-    # bare pools/schedulers, then bare batchers — reverse dependency order
+    # fleet tier first (the scaler owns backend PROCESSES, the router
+    # fronts the servers), then servers (they drain their own
+    # pool/scheduler+batcher), then bare pools/schedulers, then bare
+    # batchers — reverse dependency order
     objs = list(_live)
-    for cls in (InferenceServer, GenerationServer, ReplicaPool,
-                ContinuousBatcher, DynamicBatcher):
+    for cls in (AutoScaler, Router, InferenceServer, GenerationServer,
+                ReplicaPool, ContinuousBatcher, DynamicBatcher):
         for obj in objs:
             if type(obj) is not cls:
                 continue

@@ -1,0 +1,123 @@
+"""Backend-process entrypoint: one serving process of the fleet.
+
+``python -m paddle_tpu.serving.backend --model-dir DIR [--port 0] ...``
+boots a full :class:`InferenceServer` (predictor -> batcher -> replica
+pool -> HTTP frontend) over a ``jit.save``/``save_inference_model``
+export, warms every bucket, then parks until SIGTERM/SIGINT — on which
+it drains gracefully (queued work completes, then the listener closes)
+and exits 0. This is the unit the router spreads traffic over and the
+autoscaler's :class:`~paddle_tpu.serving.scaler.SubprocessLauncher`
+boots and reaps.
+
+Port discovery: with ``--port 0`` (the default — N backends on one host
+must not fight over a port) the chosen port is announced through
+``--port-file``: the file is written atomically (tmp + rename) AFTER the
+server is constructed, so a launcher polling for it never reads a
+half-written path or a port that isn't bound yet.
+
+``--mesh-dp N`` serves a GSPMD-sharded backend: the predictor is wrapped
+with :func:`~paddle_tpu.serving.sharded.shard_predictor` over an
+N-device data-parallel mesh before the server boots (pair it with batch
+buckets divisible by N so every hot-path batch actually splits).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import tempfile
+import threading
+
+__all__ = ["main", "build_server"]
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.serving.backend",
+        description="boot one serving backend process over a saved "
+                    "inference model")
+    p.add_argument("--model-dir", required=True,
+                   help="directory produced by jit.save / "
+                        "save_inference_model")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0,
+                   help="0 binds an ephemeral port (see --port-file)")
+    p.add_argument("--port-file", default="",
+                   help="file to write the bound port into (atomic; "
+                        "written once the server is constructed)")
+    p.add_argument("--replicas", type=int, default=None)
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated batch bucket ladder override")
+    p.add_argument("--queue-capacity", type=int, default=None)
+    p.add_argument("--batch-timeout-ms", type=float, default=None)
+    p.add_argument("--mesh-dp", type=int, default=0,
+                   help="shard the backend over an N-device dp mesh "
+                        "(0: unsharded)")
+    return p.parse_args(argv)
+
+
+def build_server(args):
+    """Predictor (optionally GSPMD-sharded) + InferenceServer, not yet
+    started — split from :func:`main` so tests can drive it in-process."""
+    from ..inference import Config, create_predictor
+    from .server import InferenceServer
+
+    pred = create_predictor(Config(args.model_dir))
+    if args.mesh_dp and args.mesh_dp > 1:
+        import jax
+
+        from ..parallel.mesh import MeshConfig, create_mesh
+        from .sharded import shard_predictor
+
+        mesh = create_mesh(MeshConfig(
+            dp=args.mesh_dp, devices=jax.devices()[:args.mesh_dp]))
+        pred = shard_predictor(pred, mesh=mesh)
+    return InferenceServer(
+        pred, port=args.port, host=args.host, replicas=args.replicas,
+        buckets=args.buckets, queue_capacity=args.queue_capacity,
+        batch_timeout_ms=args.batch_timeout_ms)
+
+
+def _announce_port(path, port):
+    """Atomic write: the launcher polls for this file, so it must never
+    observe a partial write."""
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    fd, tmp = tempfile.mkstemp(dir=d, prefix=".port_")
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(str(port))
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    srv = build_server(args)
+    srv.start(warmup=True)  # /healthz flips ready only after warmup
+    if args.port_file:
+        _announce_port(args.port_file, srv.port)
+    print(f"serving backend ready on {srv.url} "
+          f"(model={args.model_dir}, pid={os.getpid()})", flush=True)
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    stop.wait()
+    # graceful drain: admission refused (503 -> the router evicts us),
+    # queued work flushes through the replicas, listener closes
+    srv.stop(drain=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

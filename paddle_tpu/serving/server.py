@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ..errors import InvalidArgumentError
+from ..monitor import all_metrics, counter
 from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
 from ..monitor import histogram_quantile, registry_snapshot
@@ -60,13 +61,53 @@ def _json_default(o):
     return str(o)
 
 
+#: The machine-oriented load-signal schema (``GET /loadz``) the router
+#: tier scrapes instead of the human-oriented ``/statz`` blob. STABLE:
+#: fields are only ever added, never renamed or removed, and additions
+#: bump ``schema``. Every backend kind serves exactly these keys:
+#:
+#: - ``schema``      int   — schema version (currently 1)
+#: - ``kind``        str   — "predict" | "generate" (routes the router
+#:                            may send here)
+#: - ``ready``       bool  — warmed AND not draining (admission works)
+#: - ``draining``    bool  — shutdown in progress; admissions get 503
+#: - ``queue_depth`` int   — requests waiting for a batch/slot
+#: - ``queue_capacity`` int
+#: - ``load``        float — queue_depth / queue_capacity (the p2c
+#:                            comparison signal, normalized)
+#: - ``mean_fill``   float|None — predict: batch-slot utilization
+#: - ``slot_occupancy`` float|None — generate: busy decode slots ratio
+#: - ``compiles``    {"expected": int, "unexpected": int,
+#:                    "jit_misses": int} — per-process compile
+#:                    accounting (the bench's per-backend assertion)
+LOADZ_SCHEMA_VERSION = 1
+
+
+def _histz_payload() -> dict:
+    """``GET /histz``: raw snapshots (bounds + per-bucket counts + sum +
+    count) of every ``serving/*`` histogram in this process — the
+    machine-oriented feed for cross-backend quantile merging
+    (``monitor.merge_histogram_snapshots`` on the router side). The
+    human-oriented quantiles stay on ``/statz``."""
+    return {
+        "histograms": {
+            name: m.snapshot() for name, m in all_metrics().items()
+            if m.kind == "histogram" and name.startswith("serving/")
+        },
+    }
+
+
+def _jit_misses() -> int:
+    from ..profiler import counters as _pc
+
+    return int(_pc().get("executor::jit_cache_miss", 0))
+
+
 def _stats_readers():
     """One registry snapshot + the counter/quantile readers both statz
     endpoints share (a change to the quantile fields must not have to be
     made twice)."""
     snap = registry_snapshot()
-    from ..monitor import all_metrics
-
     metrics = all_metrics()
 
     def val(name):
@@ -100,12 +141,30 @@ def _utilization(t0, flops0, val):
     }
 
 
+class ServingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer with a fleet-sized accept backlog. The
+    stdlib default (request_queue_size=5) refuses connections under a
+    burst of connection-per-request clients — which the router would
+    read as a dead backend and evict. Refusals belong to the bounded
+    ADMISSION queue (429), never to the TCP accept queue."""
+
+    request_queue_size = 128
+    daemon_threads = True
+
+
 class _BaseHandler(BaseHTTPRequestHandler):
     """Shared plumbing for the serving frontends: JSON replies, silent
     request logging, and the introspection GET routes every server
-    exposes (``/healthz`` readiness, ``/statz``, ``/metrics``)."""
+    exposes (``/healthz`` readiness, ``/statz``, ``/metrics``).
+
+    HTTP/1.1 across the board: every reply carries Content-Length (or
+    chunked transfer encoding), so keep-alive is safe — and the fleet
+    NEEDS it: connection-per-request across the client->router->backend
+    hops costs a TCP handshake plus a handler-thread spawn per hop per
+    request, which caps a fleet well below one backend's capacity."""
 
     server_version = "ptpu-serving/1"
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *args):  # no per-request stderr chatter
         pass
@@ -126,6 +185,21 @@ class _BaseHandler(BaseHTTPRequestHandler):
             self.wfile.write(data)
         except (BrokenPipeError, ConnectionResetError):
             pass
+
+    def _read_body(self):
+        """Read (and thereby DRAIN) the POST body before any reply — an
+        unread body left on a keep-alive connection parses as the next
+        request line and poisons every later request on that socket.
+        Returns the raw bytes, or ``None`` after answering 400 to a
+        malformed Content-Length (the connection is closed then: with
+        an unparseable length the body cannot be drained)."""
+        try:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+        except (TypeError, ValueError):
+            self.close_connection = True
+            self._reply(400, {"error": "malformed Content-Length"})
+            return None
+        return self.rfile.read(length) if length > 0 else b"{}"
 
     def _try_submit(self, fn):
         """Run an admission call, mapping the shared backpressure
@@ -149,6 +223,10 @@ class _BaseHandler(BaseHTTPRequestHandler):
             self._reply(200 if srv.ready else 503, srv.healthz())
         elif path == "/statz":
             self._reply(200, srv.statz())
+        elif path == "/loadz":
+            self._reply(200, srv.loadz())
+        elif path == "/histz":
+            self._reply(200, _histz_payload())
         elif path == "/metrics":
             from ..monitor.export import (
                 PROMETHEUS_CONTENT_TYPE,
@@ -170,12 +248,15 @@ class _ServingHandler(_BaseHandler):
             self._reply(200, {
                 "service": "paddle_tpu serving",
                 "routes": ["/predict (POST)", "/healthz", "/statz",
-                           "/metrics"]})
+                           "/loadz", "/histz", "/metrics"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
     def do_POST(self):
         path = self.path.split("?", 1)[0].rstrip("/")
+        raw = self._read_body()
+        if raw is None:
+            return
         if path != "/predict":
             self._reply(404, {"error": f"unknown path {path!r}"})
             return
@@ -185,8 +266,7 @@ class _ServingHandler(_BaseHandler):
                               if not srv.draining else "draining"})
             return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            body = json.loads(raw or b"{}")
             if not isinstance(body, dict):
                 raise InvalidArgumentError(
                     "request body must be a JSON object with an "
@@ -264,9 +344,8 @@ class InferenceServer:
         self.pool = ReplicaPool(predictor, self.batcher, replicas=replicas)
         self.input_specs = self.pool._specs
         self.request_timeout_s = request_timeout_s
-        self._httpd = ThreadingHTTPServer((host, int(port)),
-                                          _ServingHandler)
-        self._httpd.daemon_threads = True
+        self._httpd = ServingHTTPServer((host, int(port)),
+                                        _ServingHandler)
         self._httpd._inference_server = self
         self.host = host
         self.port = self._httpd.server_address[1]
@@ -348,6 +427,32 @@ class InferenceServer:
             "queue_capacity": self.batcher.queue_capacity,
         }
 
+    def loadz(self) -> dict:
+        """The compact router-facing load signal (see
+        :data:`LOADZ_SCHEMA_VERSION` for the schema contract). Direct
+        counter reads only — no registry walk, cheap enough to scrape
+        every probe interval."""
+        rows = counter("serving/batched_rows_total").value
+        slots = counter("serving/batch_slots_total").value
+        depth = self.batcher.queue_depth()
+        return {
+            "schema": LOADZ_SCHEMA_VERSION,
+            "kind": "predict",
+            "ready": self.ready,
+            "draining": self.draining,
+            "queue_depth": depth,
+            "queue_capacity": self.batcher.queue_capacity,
+            "load": round(depth / self.batcher.queue_capacity, 4),
+            "mean_fill": round(rows / slots, 4) if slots else None,
+            "slot_occupancy": None,
+            "compiles": {
+                "expected": len(self.batcher.buckets),
+                "unexpected": counter(
+                    "serving/unexpected_compiles").value,
+                "jit_misses": _jit_misses(),
+            },
+        }
+
     def statz(self) -> dict:
         val, quantiles = _stats_readers()
         batches = val("serving/batches_total")
@@ -389,12 +494,6 @@ class InferenceServer:
 
 
 class _GenerationHandler(_BaseHandler):
-    # chunked transfer encoding (the streaming /generate response) does
-    # not exist in HTTP/1.0 — spec-conforming clients key dechunking on
-    # the version line. Non-stream replies all carry Content-Length, so
-    # HTTP/1.1 keep-alive stays correct.
-    protocol_version = "HTTP/1.1"
-
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         if self._get_common(path):
@@ -403,12 +502,15 @@ class _GenerationHandler(_BaseHandler):
             self._reply(200, {
                 "service": "paddle_tpu generation",
                 "routes": ["/generate (POST)", "/healthz", "/statz",
-                           "/metrics"]})
+                           "/loadz", "/histz", "/metrics"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
     def do_POST(self):
         path = self.path.split("?", 1)[0].rstrip("/")
+        raw = self._read_body()
+        if raw is None:
+            return
         if path != "/generate":
             self._reply(404, {"error": f"unknown path {path!r}"})
             return
@@ -418,8 +520,7 @@ class _GenerationHandler(_BaseHandler):
                               if not srv.draining else "draining"})
             return
         try:
-            length = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(length) or b"{}")
+            body = json.loads(raw or b"{}")
             if not isinstance(body, dict):
                 raise InvalidArgumentError(
                     "request body must be a JSON object with a "
@@ -564,9 +665,8 @@ class GenerationServer:
         self.scheduler = ContinuousBatcher(
             self.engine, queue_capacity=queue_capacity)
         self.request_timeout_s = request_timeout_s
-        self._httpd = ThreadingHTTPServer((host, int(port)),
-                                          _GenerationHandler)
-        self._httpd.daemon_threads = True
+        self._httpd = ServingHTTPServer((host, int(port)),
+                                        _GenerationHandler)
         self._httpd._inference_server = self
         self.host = host
         self.port = self._httpd.server_address[1]
@@ -645,6 +745,29 @@ class GenerationServer:
             "prefill_buckets": list(self.engine.prefill_buckets),
             "queue_depth": self.scheduler.queue_depth(),
             "queue_capacity": self.scheduler.queue_capacity,
+        }
+
+    def loadz(self) -> dict:
+        """Router-facing load signal; same stable schema as the predict
+        server's (``mean_fill`` is the predict-side field, decode-slot
+        occupancy is the generation analog)."""
+        depth = self.scheduler.queue_depth()
+        return {
+            "schema": LOADZ_SCHEMA_VERSION,
+            "kind": "generate",
+            "ready": self.ready,
+            "draining": self.draining,
+            "queue_depth": depth,
+            "queue_capacity": self.scheduler.queue_capacity,
+            "load": round(depth / self.scheduler.queue_capacity, 4),
+            "mean_fill": None,
+            "slot_occupancy": round(self.scheduler.occupancy(), 4),
+            "compiles": {
+                "expected": len(self.engine.prefill_buckets) + 1,
+                "unexpected": counter(
+                    "serving/gen_unexpected_compiles").value,
+                "jit_misses": _jit_misses(),
+            },
         }
 
     def statz(self) -> dict:

@@ -1,0 +1,187 @@
+"""GSPMD-sharded serving: one logical backend spanning a device mesh.
+
+``inference.Predictor`` compiles through the static executor's jax.jit
+path, and jax.jit's partitioner follows its INPUT shardings: commit the
+loaded weights to a mesh with :class:`parallel.ShardingRules`
+PartitionSpecs and stage the feeds as batch-sharded arrays, and the very
+same compiled program becomes a GSPMD program — XLA inserts the
+collectives, the executor's plan/jit caches, donation discipline, and
+cost capture are untouched. That is the whole trick: sharding is
+threaded through the predictor as *array placement*, not as a second
+compile path.
+
+Placement rules:
+
+- **weights** (scope-resident persistables of the inference program)
+  are ``device_put`` once at wrap time with the rule table's clamped
+  spec — unmatched parameters replicate (pure data parallelism), a
+  megatron-style table shards them tensor-parallel;
+- **feeds** are staged batch-sharded over ``data_axis`` when the row
+  count divides the axis size, replicated otherwise (odd direct calls
+  stay correct; the serving bucket ladder should be chosen divisible so
+  the hot path always splits);
+- everything else (rng keys, executor-synthesized constants) is
+  uncommitted and follows the computation onto the mesh.
+
+``ShardedPredictor.clone()`` preserves the replica-pool contract: clones
+share the Executor (one compiled-program cache) and the already-sharded
+scope weights, so an ``InferenceServer`` over a sharded predictor is a
+*sharded backend* — N worker threads dispatching one multi-device
+program. Parity with the unsharded predictor is golden-tested on a
+2-device CPU mesh (tests/test_sharded_serving.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from ..errors import InvalidArgumentError, PreconditionNotMetError
+from ..inference.predictor import Predictor
+from ..monitor import flight_recorder as _flight
+from ..parallel.mesh import get_mesh
+from ..parallel.sharding import DEFAULT_RULES, named_sharding
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["ShardedPredictor", "shard_predictor"]
+
+
+def _persistable_names(program):
+    """Names of the program's scope-resident parameters (every var the
+    inference program reads from the scope rather than the feed)."""
+    block = program.global_block()
+    return [name for name, v in block.vars.items()
+            if getattr(v, "persistable", False)]
+
+
+class ShardedPredictor(Predictor):
+    """A :class:`Predictor` whose compiled program is GSPMD-partitioned
+    over a mesh. Build one with :func:`shard_predictor`; construction
+    from a Config directly is intentionally unsupported (the wrap point
+    is explicit so the weight re-placement is visible at the call site).
+    """
+
+    def __init__(self, *a, **k):  # pragma: no cover - guarded API
+        raise InvalidArgumentError(
+            "ShardedPredictor is built by shard_predictor(predictor, "
+            "rules=..., mesh=...), not constructed directly")
+
+    # -- staging -------------------------------------------------------------
+
+    def _stage(self, arr):
+        """Commit one feed onto the mesh: batch-sharded over
+        ``data_axis`` when the leading dim divides the axis size,
+        replicated otherwise. Committed placement is what makes jax.jit
+        compile (and cache) the partitioned program."""
+        arr = np.asarray(arr)
+        axis = self.data_axis
+        n = self.num_shards
+        if arr.ndim >= 1 and n > 1 and arr.shape[0] % n == 0:
+            spec = P(axis, *([None] * (arr.ndim - 1)))
+        else:
+            spec = P()
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def run(self, inputs=None):
+        """Same contract as :meth:`Predictor.run`, with feeds staged as
+        mesh-committed jax arrays (the executor passes jax.Array feeds
+        through untouched, so the jit signature sees the shardings)."""
+        if inputs is not None:
+            for n, arr in zip(self._feed_names, inputs):
+                self._inputs[n]._data = self._stage(arr)
+        feed = {}
+        for n in self._feed_names:
+            v = self._inputs[n]._data
+            if v is None:
+                raise RuntimeError(f"input {n!r} not set")
+            if not isinstance(v, jax.Array):
+                v = self._stage(v)  # handle staged via copy_from_cpu
+            feed[n] = v
+        outs = self._exe.run(
+            self._program, feed=feed, fetch_list=self._fetch_names
+        )
+        for n, o in zip(self._fetch_names, outs):
+            self._outputs[n]._data = o
+        return outs
+
+    def clone(self):
+        """Replica twin: shared Executor/program/scope (the weights are
+        already mesh-committed — one placement serves every clone), plus
+        the mesh/axis staging config; per-clone IO handles as in the
+        base class."""
+        new = Predictor.clone(self)
+        new.__class__ = ShardedPredictor
+        new.mesh = self.mesh
+        new.data_axis = self.data_axis
+        new.num_shards = self.num_shards
+        new.rules = self.rules
+        new.sharded_params = self.sharded_params
+        return new
+
+
+def shard_predictor(predictor, rules=None, mesh=None, data_axis="dp"):
+    """Thread PartitionSpecs into a predictor's compiled program.
+
+    Commits every scope-resident parameter of ``predictor``'s inference
+    program onto ``mesh`` per ``rules`` (:class:`parallel.ShardingRules`;
+    default replicates everything) and returns the predictor rewrapped
+    as a :class:`ShardedPredictor` staging its feeds onto the same mesh.
+
+    Wrap BEFORE the first ``run()``: the executor's jit cache keys on
+    shapes, not placement, so programs compiled after the wrap are
+    partitioned from their first compile, while an entry compiled
+    pre-wrap would be demoted to the jit fallback on its first sharded
+    call (correct, but it forfeits that entry's AOT cost record).
+
+    ``mesh`` defaults to the active ``parallel.mesh_scope`` mesh;
+    ``data_axis`` names the mesh axis the batch dimension splits over.
+    """
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        raise PreconditionNotMetError(
+            "shard_predictor needs a mesh: pass mesh=... or enter "
+            "parallel.mesh_scope(create_mesh(dp=...))")
+    if data_axis not in mesh.shape:
+        raise InvalidArgumentError(
+            f"data_axis {data_axis!r} is not a mesh axis; mesh has "
+            f"{dict(mesh.shape)}")
+    rules = rules or DEFAULT_RULES
+    from ..static.executor import global_scope
+
+    scope = global_scope()
+    sharded = {}
+    for name in _persistable_names(predictor._program):
+        if not scope.has(name):
+            continue
+        arr = scope.get(name)
+        np_arr = np.asarray(arr)
+        spec = rules.clamped_spec_for(name, np_arr.ndim)
+        # a spec that does not divide the array degrades to replication
+        # rather than erroring mid-boot: serving a new checkpoint must
+        # not die because one bias picked up a stale rule
+        for dim, part in zip(np_arr.shape, tuple(spec)):
+            if part is None:
+                continue
+            axes = part if isinstance(part, tuple) else (part,)
+            k = 1
+            for ax in axes:
+                k *= int(mesh.shape[ax])
+            if dim % k:
+                spec = P()
+                break
+        scope.set(name, jax.device_put(
+            np_arr, named_sharding(spec, mesh)))
+        sharded[name] = spec
+    predictor.__class__ = ShardedPredictor
+    predictor.mesh = mesh
+    predictor.data_axis = data_axis
+    predictor.num_shards = int(mesh.shape[data_axis])
+    predictor.rules = rules
+    predictor.sharded_params = sharded
+    _flight.record_event(
+        "serving_shard_predictor",
+        mesh={ax: int(n) for ax, n in mesh.shape.items()},
+        data_axis=data_axis,
+        params=len(sharded),
+        partitioned=sum(1 for s in sharded.values() if tuple(s)))
+    return predictor

@@ -16,6 +16,8 @@
 #           + serving smoke (online batcher/replica/HTTP contracts)
 #           + generation smoke (prefill ladder/compile-once decode,
 #             KV-cache parity, streaming /generate, drain)
+#           + router smoke (fleet tier: backend processes + router,
+#             kill -9 mid-burst survival, eviction, clean drain)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -86,6 +88,9 @@ case "$MODE" in
     # generation smoke: prefill ladder + single decode compile, KV-cache
     # parity over HTTP, streaming round trip, drain leaves no live slots
     JAX_PLATFORMS=cpu python tools/generation_smoke.py
+    # router smoke: 2 backend processes + router, kill -9 one mid-burst
+    # (zero client-visible failures), eviction counters, clean drain
+    JAX_PLATFORMS=cpu python tools/router_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""CI smoke for the serving fleet tier (`make router-smoke`).
+
+Boots the REAL fleet shape — two independent backend processes
+(``python -m paddle_tpu.serving.backend`` via the scaler's
+SubprocessLauncher) behind a Router — and asserts the availability
+contracts a load balancer exists for:
+
+- fleet readiness: both backends admitted, per-backend ``/loadz``
+  compile accounting exact (warmup == len(buckets) jit misses, zero
+  unexpected);
+- **kill -9 survival**: one backend is SIGKILLed mid-burst and every
+  client request still answers 200 — connection failures retry on the
+  survivor, the dead backend's eviction counter bumps, and no client
+  ever sees the failure;
+- fleet introspection: /statz shows the surviving backend and merged
+  latency quantiles;
+- clean teardown: graceful terminate of the survivor (SIGTERM -> drain
+  -> exit 0), router drain, and NOTHING left alive — no processes, no
+  listeners.
+
+Exit 0 on success; a failure is a real fleet regression.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from urllib.error import HTTPError, URLError
+from urllib.request import Request, urlopen
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+BUCKETS = (1, 2, 4)
+IN_DIM = 16
+CLIENTS = 6
+PER_CLIENT = 20
+
+
+def _build_model_dir():
+    import paddle_tpu.static as static
+
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        x = static.data("x", [None, IN_DIM], "float32")
+        h = static.nn.fc(x, 64, name="rsm_fc1")
+        y = static.nn.fc(h, 8, name="rsm_fc2")
+        exe = static.Executor()
+        exe.run_startup()
+        d = tempfile.mkdtemp(prefix="ptpu_router_smoke_")
+        static.save_inference_model(d, ["x"], [y], exe)
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+    return d
+
+
+def _post(url, rows, timeout=30):
+    a = np.random.RandomState(rows).randn(rows, IN_DIM).astype("float32")
+    body = json.dumps({"inputs": a.tolist()}).encode()
+    try:
+        r = urlopen(Request(url + "/predict", data=body,
+                            headers={"Content-Type": "application/json"}),
+                    timeout=timeout)
+        return r.status
+    except HTTPError as e:
+        return e.code
+    except (URLError, ConnectionError, OSError) as e:
+        # a dropped connection is ALSO a client-visible failure — it
+        # must fail the zero-failures assertion, not kill the thread
+        return f"conn: {type(e).__name__}"
+
+
+def main():
+    from paddle_tpu.serving import Router, SubprocessLauncher
+
+    model_dir = _build_model_dir()
+    launcher = SubprocessLauncher(
+        model_dir, buckets=BUCKETS, batch_timeout_ms=1.0,
+        queue_capacity=256)
+    print("booting 2 backend processes ...", flush=True)
+    handles = [launcher.launch(), launcher.launch()]
+    # probe on a long interval: the kill-recovery below must happen via
+    # the DISPATCH path (connect failure -> evict -> retry), not get
+    # cleaned up early by a lucky probe
+    router = Router(backends=[h.url for h in handles],
+                    probe_interval_s=5.0).start()
+    try:
+        assert router.healthy_count == 2, router.healthz()
+        for h in handles:
+            lz = json.loads(urlopen(h.url + "/loadz").read())
+            assert lz["ready"] and lz["kind"] == "predict", lz
+            assert lz["compiles"]["jit_misses"] == len(BUCKETS), lz
+            assert lz["compiles"]["unexpected"] == 0, lz
+        print(f"fleet ready: 2 backends x {len(BUCKETS)} warmup "
+              "compiles each, 0 unexpected", flush=True)
+
+        # -- kill -9 one backend mid-burst -----------------------------
+        statuses = []
+        done = [0]
+        lock = threading.Lock()
+
+        def client(cid):
+            for i in range(PER_CLIENT):
+                s = _post(router.url, rows=(i % 3) + 1)
+                with lock:
+                    statuses.append(s)
+                    done[0] += 1
+
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(CLIENTS)]
+        for t in threads:
+            t.start()
+        while True:  # kill once the burst is genuinely in flight
+            with lock:
+                if done[0] >= (CLIENTS * PER_CLIENT) // 4:
+                    break
+            time.sleep(0.002)
+        victim = handles[0]
+        os.kill(victim.proc.pid, signal.SIGKILL)
+        print(f"kill -9 backend {victim.url} mid-burst "
+              f"(after {done[0]} requests)", flush=True)
+        for t in threads:
+            t.join()
+        victim.proc.wait(10)
+
+        assert len(statuses) == CLIENTS * PER_CLIENT, (
+            f"only {len(statuses)}/{CLIENTS * PER_CLIENT} requests "
+            "accounted for — a client thread died")
+        failed = [s for s in statuses if s != 200]
+        assert not failed, (
+            f"{len(failed)} requests failed after the kill: "
+            f"{sorted(set(failed))} — retry-to-survivor must make the "
+            "kill invisible to clients")
+        sz = router.statz()
+        assert sz["fleet"]["evictions"] >= 1, sz["fleet"]
+        assert sz["fleet"]["retries"] >= 1, sz["fleet"]
+        assert sz["backends_healthy"] == 1, sz
+        merged = sz["latency"]["backends_merged"]
+        assert merged.get("serving/e2e_ms", {}).get("count", 0) > 0, (
+            "merged fleet quantiles missing", merged)
+        print(f"burst OK: {len(statuses)} requests all 200 "
+              f"(evictions={sz['fleet']['evictions']}, "
+              f"retries={sz['fleet']['retries']}), survivor p99 "
+              f"{merged['serving/e2e_ms']['p99_ms']}ms", flush=True)
+
+        # -- clean teardown --------------------------------------------
+        launcher.terminate(handles[1], drain=True)
+        assert handles[1].proc.returncode == 0, (
+            f"graceful drain must exit 0, got "
+            f"{handles[1].proc.returncode}")
+        router.stop(drain=True)
+        for h in handles:
+            assert h.proc.poll() is not None, f"{h.url} still alive"
+        try:
+            urlopen(router.url + "/healthz", timeout=2)
+            raise AssertionError("router listener still up after stop()")
+        except (URLError, ConnectionError, OSError):
+            pass
+        for h in handles:
+            try:
+                urlopen(h.url + "/healthz", timeout=2)
+                raise AssertionError(f"backend {h.url} listener still up")
+            except (URLError, ConnectionError, OSError):
+                pass
+        print("router-smoke OK: kill -9 invisible to clients, drain "
+              "left no live processes or listeners")
+        return 0
+    finally:
+        router.stop(drain=False)
+        for h in handles:
+            try:
+                launcher.terminate(h, drain=False, timeout_s=5)
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())
