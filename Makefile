@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -55,6 +55,9 @@ gen-smoke:  # generative serving: prefill ladder + compile-once decode, parity, 
 
 router-smoke:  # serving fleet: 2 backend processes + router, kill -9 survival, drain
 	JAX_PLATFORMS=cpu python tools/router_smoke.py
+
+chaos-smoke:  # elastic training: kill -9 mid-save + world resizes, loss-curve-identical resume
+	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

@@ -926,6 +926,134 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
     }
 
 
+def bench_checkpoint_overhead(steps=150, every=25):
+    """Async-checkpoint cost on the training step path.
+
+    The preemption-tolerance contract (ISSUE 8 / ROADMAP item 5) is only
+    free if snapshotting does not slow training: the capture is a
+    device-side copy of the state pytree (donation-safe) dispatched
+    async; serialize + fsync + atomic publish run on the background
+    writer thread. This row runs the same deterministic train loop three
+    ways — no checkpointing, a BLOCKING save every ``every`` steps (the
+    reference's save-on-the-step-path behavior), and the async path —
+    and reports the step-loop overhead of each vs the no-checkpoint
+    baseline. Target: async < 2% (the blocking column is the price it
+    replaces). The drain (wait for the last writes after the loop) is
+    reported separately — it overlaps training everywhere except the
+    final step.
+    """
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.framework import jit as fjit
+
+    # compute-heavy, state-light: large batch over a narrow MLP keeps the
+    # step on the XLA compute path for milliseconds while the snapshot
+    # payload stays ~300KB — the realistic regime (any sane checkpoint
+    # interval makes save bytes tiny next to inter-save compute; on real
+    # accelerators the step doesn't even share cores with the writer)
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(96, 96)
+            self.fc2 = nn.Linear(96, 96)
+            self.fc3 = nn.Linear(96, 16)
+
+        def forward(self, x):
+            return self.fc3(F.relu(self.fc2(F.relu(self.fc1(x)))))
+
+    def loss_fn(m, x, y):
+        return F.cross_entropy(m(x), y).mean()
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(2048, 96).astype("float32")
+    Y = rng.randint(0, 16, (2048,)).astype("int64")
+
+    def build():
+        paddle.seed(11)
+        m = MLP()
+        o = popt.Adam(learning_rate=0.01, parameters=m.parameters())
+        return fjit.train_step(m, o, loss_fn)
+
+    def run(mode, outdir):
+        step = build()
+        step(X, Y)  # compile outside the timed window
+        saves = 0
+        t0 = time.perf_counter()
+        m = None
+        for s in range(steps):
+            m = step(X, Y)
+            if mode != "none" and (s + 1) % every == 0:
+                step.save_checkpoint(
+                    f"{outdir}/step_{s}", step=s, keep=2,
+                    async_=(mode == "async"))
+                saves += 1
+        loss = float(np.asarray(m["loss"]))  # value fetch = barrier
+        loop_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        ckpt.wait_pending()
+        drain_s = time.perf_counter() - t1
+        return loop_s, drain_s, saves, loss
+
+    root = tempfile.mkdtemp(prefix="ptpu_ckpt_bench_")
+    try:
+        # interleave arms best-of-3 so machine noise hits all three alike
+        best = {"none": None, "blocking": None, "async": None}
+        for _ in range(3):
+            for mode in best:
+                out = run(mode, f"{root}/{mode}")
+                if best[mode] is None or out[0] < best[mode][0]:
+                    best[mode] = out
+        base_s, _, _, loss_none = best["none"]
+        blk_s, _, n_saves, loss_blk = best["blocking"]
+        asn_s, drain_s, _, loss_asn = best["async"]
+        asn_pct = (asn_s - base_s) / base_s * 100.0
+        blk_pct = (blk_s - base_s) / base_s * 100.0
+        assert abs(loss_blk - loss_none) < 1e-6  # snapshots don't perturb
+        assert abs(loss_asn - loss_none) < 1e-6
+
+        # direct decomposition (monitor_overhead discipline): the step
+        # path pays exactly the capture+submit of save_checkpoint — time
+        # it in isolation and amortize over the save interval. The
+        # whole-loop A/B above corroborates but swings with box noise;
+        # this number is what the <2% contract is gated on.
+        step = build()
+        step(X, Y)
+        step.save_checkpoint(f"{root}/direct/warm", step=0, async_=True)
+        ckpt.wait_pending()
+        t0 = time.perf_counter()
+        for i in range(20):
+            step.save_checkpoint(f"{root}/direct/s{i}", step=i,
+                                 async_=True)
+        capture_ms = (time.perf_counter() - t0) / 20 * 1e3
+        ckpt.wait_pending()
+        step_ms = base_s / steps * 1e3
+        direct_pct = capture_ms / (every * step_ms) * 100.0
+        return {
+            "metric": "checkpoint_step_overhead_pct",
+            "value": round(direct_pct, 3),
+            "unit": "% of step time (capture+submit / save interval)",
+            "steps": steps,
+            "save_every": every,
+            "saves": n_saves,
+            "capture_submit_ms": round(capture_ms, 3),
+            "baseline_steps_per_sec": round(steps / base_s, 1),
+            "async_steps_per_sec": round(steps / asn_s, 1),
+            "blocking_steps_per_sec": round(steps / blk_s, 1),
+            "loop_async_overhead_pct": round(asn_pct, 3),
+            "loop_blocking_overhead_pct": round(blk_pct, 3),
+            "async_drain_ms": round(drain_s * 1e3, 3),
+            "target_met": bool(direct_pct < 2.0),
+        }
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def bench_executor_dispatch(iters=200):
     """Static-graph Executor steady-state dispatch micro-bench.
 
@@ -1001,6 +1129,8 @@ def main():
     result["decode_throughput"] = bench_decode_throughput()
     # serving fleet: 1 -> N backend processes behind the router
     result["router_throughput"] = bench_router_throughput()
+    # async snapshot capture on the step path vs blocking saves (target <2%)
+    result["checkpoint_overhead"] = bench_checkpoint_overhead()
     print(json.dumps(result))
 
 

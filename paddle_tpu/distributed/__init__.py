@@ -30,3 +30,5 @@ from . import fleet  # noqa: F401
 from .fleet import DistributedStrategy  # noqa: F401
 from .launch import spawn  # noqa: F401
 from . import elastic  # noqa: F401  (heartbeat monitor + restart driver)
+from . import checkpoint  # noqa: F401  (async reshardable snapshots)
+from . import chaos  # noqa: F401  (FLAGS_fault_injection hooks)

@@ -10,27 +10,85 @@ TPU-native: multi-host pods have no pserver; liveness is tracked
 through a shared filesystem (the checkpoint dir every host already
 mounts). Each host runs a HeartbeatMonitor thread touching its beat
 file; any host can list dead peers; recovery = resume from
-incubate.auto_checkpoint (crash-redo semantics tested there).
+incubate.auto_checkpoint / distributed.checkpoint snapshots.
+
+Beyond the reference (ROADMAP item 5 — preemption-tolerant *elastic*
+training): the job survives a *changing* world, not just a restarted
+one. A dead rank (heartbeat silence) or a persistently-flagged
+straggler (:class:`StragglerTracker`, fed by ``monitor/cluster.py``
+/clusterz verdicts) triggers a **world renegotiation**: the survivors
+each vote their observed membership over the shared filesystem (the
+heartbeat side channel), agree on the new world, and
+:func:`elastic_run` re-enters the training function — which rebuilds
+its mesh at the surviving size and resumes *resharded* from the last
+intact snapshot (distributed/checkpoint.py) instead of running at the
+straggler's pace or dying. World changes do not consume the crash-
+restart budget: a resize is recovery working, not a failure.
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import time
 
-__all__ = ["HeartbeatMonitor", "elastic_run"]
+__all__ = [
+    "HeartbeatMonitor",
+    "elastic_run",
+    "ElasticContext",
+    "ElasticWorld",
+    "WorldChangedError",
+    "EvictedError",
+    "StragglerTracker",
+    "install_straggler_eviction",
+    "check_world",
+    "renegotiate_world",
+    "mark_evicted",
+    "evicted_ranks",
+]
+
+
+class WorldChangedError(RuntimeError):
+    """Membership changed: dead or evicted ranks were detected. Carries
+    the evidence; elastic_run renegotiates and re-enters training."""
+
+    def __init__(self, survivors, dead=(), evicted=()):
+        self.survivors = sorted(survivors)
+        self.dead = sorted(dead)
+        self.evicted = sorted(evicted)
+        super().__init__(
+            f"world changed: survivors={self.survivors} "
+            f"dead={self.dead} evicted={self.evicted}")
+
+
+class EvictedError(RuntimeError):
+    """THIS rank was evicted (persistent straggler verdict). The rank
+    must leave — the survivors checkpoint around it and resize."""
+
+    def __init__(self, rank):
+        self.rank = int(rank)
+        super().__init__(f"rank {rank} evicted from the training world")
 
 
 class HeartbeatMonitor:
     """heart_beat_monitor.cc at host granularity over a shared fs."""
 
     def __init__(self, job_dir: str, rank: int, world_size: int,
-                 interval: float = 5.0, timeout: float = 60.0):
+                 interval: float = 5.0, timeout: float = 60.0,
+                 grace: float | None = None):
+        self.root = job_dir
         self.job_dir = os.path.join(job_dir, "heartbeats")
         self.rank = int(rank)
         self.world_size = int(world_size)
         self.interval = float(interval)
         self.timeout = float(timeout)
+        # startup grace: a rank that has not beaten YET (job still
+        # booting, process scheduler lagging) is "not here yet", not
+        # "dead" — only after `grace` seconds of total silence since
+        # this monitor came up does absence become death. Defaults to
+        # the heartbeat timeout.
+        self.grace = self.timeout if grace is None else float(grace)
+        self._born = time.time()
         os.makedirs(self.job_dir, exist_ok=True)
         self._stop = threading.Event()
         self._thread = None
@@ -65,8 +123,11 @@ class HeartbeatMonitor:
             self._thread = None
 
     def dead_ranks(self, now=None):
-        """Ranks whose last beat is older than ``timeout`` (or that never
-        beat) — UpdateStatus/dead-node walk of heart_beat_monitor.cc."""
+        """Ranks whose last beat is older than ``timeout`` — the
+        UpdateStatus/dead-node walk of heart_beat_monitor.cc. A rank
+        that never beat counts as dead only once the startup ``grace``
+        has elapsed (a monitor that just came up must not declare the
+        whole fleet dead before anyone had a chance to join)."""
         now = time.time() if now is None else now
         dead = []
         for r in range(self.world_size):
@@ -74,7 +135,8 @@ class HeartbeatMonitor:
             try:
                 age = now - os.stat(p).st_mtime
             except FileNotFoundError:
-                dead.append(r)
+                if now - self._born > self.grace:
+                    dead.append(r)
                 continue
             if age > self.timeout:
                 dead.append(r)
@@ -90,17 +152,305 @@ class HeartbeatMonitor:
         self.stop()
 
 
-def elastic_run(train_fn, max_restarts: int = 3, exceptions=(Exception,)):
-    """Crash-and-resume driver: run ``train_fn()`` and restart it up to
-    ``max_restarts`` times on failure. Combined with the env-configured
-    auto-checkpoint (incubate.auto_checkpoint), each restart resumes
-    from the newest snapshot — the reference's checkpoint-based elastic
-    recovery contract.
+# ---------------------------------------------------------------------------
+# straggler eviction
+# ---------------------------------------------------------------------------
+
+
+class StragglerTracker:
+    """Consecutive-verdict counter over /clusterz straggler flags.
+
+    ``monitor/cluster.py`` flags a rank when its step time exceeds
+    ``FLAGS_straggler_threshold`` × the cluster median; one slow tick is
+    noise (GC pause, rebalancing), so eviction requires
+    ``FLAGS_eviction_threshold`` *consecutive* verdicts. A clean tick
+    resets the rank's streak; a rank missing from the report keeps its
+    streak (absence of evidence is not health).
+    """
+
+    def __init__(self, threshold=None):
+        self._threshold = threshold
+        self._counts: dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    @property
+    def threshold(self) -> int:
+        if self._threshold is not None:
+            return int(self._threshold)
+        from ..flags import flag
+
+        return int(flag("eviction_threshold"))
+
+    def observe(self, flagged, present=None):
+        """Feed one verdict round: ``flagged`` ranks bump their streak,
+        ranks in ``present`` but not flagged reset theirs."""
+        flagged = {int(r) for r in flagged}
+        with self._lock:
+            for r in flagged:
+                self._counts[r] = self._counts.get(r, 0) + 1
+            for r in set(int(x) for x in (present or ())) - flagged:
+                self._counts[r] = 0
+
+    def streak(self, rank) -> int:
+        with self._lock:
+            return self._counts.get(int(rank), 0)
+
+    def evictable(self):
+        """Ranks whose streak reached the eviction threshold."""
+        thr = self.threshold
+        with self._lock:
+            return sorted(r for r, c in self._counts.items() if c >= thr)
+
+    def reset(self, rank=None):
+        with self._lock:
+            if rank is None:
+                self._counts.clear()
+            else:
+                self._counts.pop(int(rank), None)
+
+
+def install_straggler_eviction(tracker: StragglerTracker):
+    """Wire /clusterz verdicts into the tracker: every
+    ``clusterz_payload`` evaluation feeds one round. Returns the
+    listener handle (pass to ``cluster.remove_verdict_listener``)."""
+    from ..monitor import cluster as _cluster
+
+    def _on_verdict(payload):
+        tracker.observe(
+            [s["rank"] for s in payload.get("stragglers", [])],
+            present=[row["rank"] for row in payload.get("ranks", [])])
+
+    _cluster.add_verdict_listener(_on_verdict)
+    return _on_verdict
+
+
+# ---------------------------------------------------------------------------
+# world membership / renegotiation (over the shared heartbeat fs)
+# ---------------------------------------------------------------------------
+
+
+def _evict_dir(root):
+    return os.path.join(root, "evicted")
+
+
+def mark_evicted(root, rank):
+    """Persist an eviction decision so every survivor (and the evicted
+    rank itself, post-restart) agrees — the fs analog of the KV channel."""
+    d = _evict_dir(root)
+    os.makedirs(d, exist_ok=True)
+    p = os.path.join(d, f"rank_{int(rank)}")
+    with open(p, "a"):
+        os.utime(p, None)
+
+
+def evicted_ranks(root):
+    try:
+        names = os.listdir(_evict_dir(root))
+    except FileNotFoundError:
+        return []
+    out = []
+    for n in names:
+        if n.startswith("rank_"):
+            try:
+                out.append(int(n[len("rank_"):]))
+            except ValueError:
+                continue
+    return sorted(out)
+
+
+def check_world(monitor: HeartbeatMonitor, tracker: StragglerTracker = None,
+                members=None):
+    """One membership check, called from the training loop at step
+    boundaries. Publishes fresh eviction decisions, then raises
+    :class:`EvictedError` (this rank must leave) or
+    :class:`WorldChangedError` (peers left — renegotiate + reshard);
+    returns the current member list when nothing changed."""
+    from ..monitor import flight_recorder as _flight
+    from ..monitor import registry as _reg
+
+    members = sorted(members if members is not None
+                     else range(monitor.world_size))
+    dead = set(monitor.dead_ranks()) & set(members)
+    evicted = set(evicted_ranks(monitor.root)) & set(members)
+    fresh = set()
+    if tracker is not None:
+        fresh = set(tracker.evictable()) & set(members) - evicted
+        for r in sorted(fresh):
+            mark_evicted(monitor.root, r)
+            _reg.counter("elastic/evictions").inc()
+            _flight.record_event("elastic_evicted", rank=r,
+                                 streak=tracker.streak(r))
+        evicted |= fresh
+    if monitor.rank in evicted:
+        raise EvictedError(monitor.rank)
+    gone = (dead | evicted) & set(members)
+    if gone:
+        survivors = [r for r in members if r not in gone]
+        raise WorldChangedError(survivors, dead=dead & gone,
+                                evicted=evicted & gone)
+    return members
+
+
+def renegotiate_world(monitor: HeartbeatMonitor, members=None,
+                      generation=1, timeout=300.0, poll=0.05):
+    """Survivors agree on the new world over the shared fs.
+
+    Each survivor recomputes the membership from live evidence
+    (heartbeats + eviction markers), publishes its vote under
+    ``world_gen_<generation>/vote_<rank>.json``, and polls until every
+    voted survivor published the *same* set. Evidence converges (dead
+    ranks stay dead past the timeout; eviction markers are persistent),
+    so disagreeing votes are re-derived until they match. Returns an
+    :class:`ElasticWorld` with this rank's new dense rank.
     """
     from ..errors import FatalError
+    from ..monitor import flight_recorder as _flight
 
+    members = sorted(members if members is not None
+                     else range(monitor.world_size))
+    vote_dir = os.path.join(monitor.root, f"world_gen_{int(generation)}")
+    os.makedirs(vote_dir, exist_ok=True)
+    deadline = time.monotonic() + float(timeout)
+    my_vote = None
+    while True:
+        dead = set(monitor.dead_ranks())
+        evicted = set(evicted_ranks(monitor.root))
+        survivors = [r for r in members if r not in dead and r not in evicted]
+        if monitor.rank not in survivors:
+            raise EvictedError(monitor.rank)
+        if survivors != my_vote:
+            my_vote = list(survivors)
+            _publish_vote(vote_dir, monitor.rank, my_vote)
+        agreed = _votes_agree(vote_dir, survivors)
+        if agreed is not None:
+            world = ElasticWorld(
+                generation=int(generation), survivors=agreed,
+                rank=agreed.index(monitor.rank), world_size=len(agreed))
+            _flight.record_event(
+                "elastic_world_agreed", generation=int(generation),
+                survivors=agreed, rank=world.rank)
+            return world
+        if time.monotonic() > deadline:
+            raise FatalError(
+                f"world renegotiation gen {generation} did not converge "
+                f"within {timeout}s (my vote: {my_vote})")
+        time.sleep(poll)
+
+
+def _publish_vote(vote_dir, rank, survivors):
+    tmp = os.path.join(vote_dir, f".vote_{rank}.tmp")
+    with open(tmp, "w") as f:
+        json.dump({"rank": rank, "survivors": survivors}, f)
+    os.replace(tmp, os.path.join(vote_dir, f"vote_{rank}.json"))
+
+
+def _votes_agree(vote_dir, survivors):
+    """All survivors' votes present and identical -> the agreed list."""
+    seen = []
+    for r in survivors:
+        try:
+            with open(os.path.join(vote_dir, f"vote_{r}.json")) as f:
+                seen.append(json.load(f)["survivors"])
+        except (FileNotFoundError, json.JSONDecodeError, KeyError):
+            return None
+    if not seen or any(v != seen[0] for v in seen[1:]):
+        return None
+    return [int(r) for r in seen[0]]
+
+
+# ---------------------------------------------------------------------------
+# restart driver
+# ---------------------------------------------------------------------------
+
+
+class ElasticWorld:
+    """An agreed membership: original rank ids of the survivors, plus
+    this process's dense rank within them."""
+
+    def __init__(self, generation, survivors, rank, world_size):
+        self.generation = int(generation)
+        self.survivors = [int(r) for r in survivors]
+        self.rank = rank
+        self.world_size = int(world_size)
+
+    def __repr__(self):
+        return (f"ElasticWorld(gen={self.generation}, rank={self.rank}/"
+                f"{self.world_size}, survivors={self.survivors})")
+
+
+class ElasticContext:
+    """Handed to ``train_fn`` (when it accepts an argument): the live
+    membership view plus the monitor/tracker for step-boundary checks."""
+
+    def __init__(self, monitor=None, tracker=None):
+        self.monitor = monitor
+        self.tracker = tracker
+        self.world: ElasticWorld | None = None
+        self.generation = 0
+        self.restarts = 0
+        self.world_changes = 0
+
+    @property
+    def members(self):
+        if self.world is not None:
+            return list(self.world.survivors)
+        if self.monitor is not None:
+            return list(range(self.monitor.world_size))
+        return [0]
+
+    def check(self):
+        """Raise WorldChangedError/EvictedError when membership moved;
+        harmless no-op without a monitor (single-process runs)."""
+        if self.monitor is None:
+            return self.members
+        return check_world(self.monitor, self.tracker,
+                           members=self.members)
+
+
+def _accepts_context(fn):
+    import inspect
+
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return False
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD,
+                      p.VAR_POSITIONAL):
+            return True
+    return False
+
+
+def elastic_run(train_fn, max_restarts: int = 3, exceptions=(Exception,),
+                monitor: HeartbeatMonitor = None,
+                tracker: StragglerTracker = None,
+                max_world_changes: int = 32,
+                renegotiate_timeout_s: float = 300.0):
+    """Preemption-tolerant training driver.
+
+    Runs ``train_fn`` (passing an :class:`ElasticContext` when it takes
+    an argument) and reacts to three distinct failure classes:
+
+    - **crash** (``exceptions``): restart, up to ``max_restarts`` times
+      — combined with auto-checkpoint each restart resumes from the
+      newest intact snapshot (the reference's checkpoint-based elastic
+      recovery contract);
+    - **world change** (:class:`WorldChangedError` raised from
+      ``ctx.check()``): renegotiate the membership with the survivors
+      over the heartbeat side channel and re-enter ``train_fn``, which
+      rebuilds its mesh at the new size and resumes resharded. Resizes
+      have their own (generous) budget — shrinking is recovery working,
+      not a failure;
+    - **own eviction** (:class:`EvictedError`): recorded, re-raised —
+      this process must leave the job.
+    """
+    from ..errors import FatalError
     from ..incubate import auto_checkpoint as acp
+    from ..monitor import flight_recorder as _flight
+    from ..monitor import registry as _reg
 
+    ctx = ElasticContext(monitor=monitor, tracker=tracker)
+    wants_ctx = _accepts_context(train_fn)
     attempt = 0
     while True:
         # each attempt is a logical process restart: reset the registry so
@@ -108,9 +458,44 @@ def elastic_run(train_fn, max_restarts: int = 3, exceptions=(Exception,)):
         # _load_latest restores into the new instances, not the dead ones
         acp.reset_registry()
         try:
-            return train_fn()
+            return train_fn(ctx) if wants_ctx else train_fn()
+        except EvictedError as e:
+            _reg.counter("elastic/self_evicted").inc()
+            _flight.record_event("elastic_self_evicted", rank=e.rank)
+            raise
+        except WorldChangedError as wc:
+            ctx.world_changes += 1
+            if ctx.world_changes > max_world_changes:
+                raise FatalError(
+                    f"elastic_run: world changed {ctx.world_changes} times"
+                    " — membership is thrashing, giving up") from wc
+            _reg.counter("elastic/world_changes").inc()
+            _flight.record_event(
+                "elastic_world_changed", survivors=wc.survivors,
+                dead=wc.dead, evicted=wc.evicted)
+            ctx.generation += 1
+            if monitor is not None:
+                # generous deadline (caller-tunable): a surviving peer
+                # may be mid-step — possibly recompiling after the
+                # previous resize — and must not be timed out into a
+                # job-killing FatalError by a fast-reacting rank
+                ctx.world = renegotiate_world(
+                    monitor, members=ctx.members,
+                    generation=ctx.generation,
+                    timeout=renegotiate_timeout_s)
+            else:
+                ctx.world = ElasticWorld(
+                    generation=ctx.generation, survivors=wc.survivors,
+                    rank=(wc.survivors.index(_flight._safe_rank())
+                          if _flight._safe_rank() in wc.survivors else None),
+                    world_size=len(wc.survivors))
         except exceptions as e:
             attempt += 1
+            ctx.restarts = attempt
+            _reg.counter("elastic/restarts").inc()
+            _flight.record_event(
+                "elastic_restart", attempt=attempt,
+                error=f"{type(e).__name__}: {e}"[:200])
             if attempt > max_restarts:
                 raise FatalError(
                     f"elastic_run: giving up after {max_restarts} restarts"

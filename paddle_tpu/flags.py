@@ -393,6 +393,50 @@ define_flag("serving_scaler_cooldown_s", 30.0,
             "seconds after a scale action during which the autoscaler "
             "makes no further decisions")
 
+# incubate/auto_checkpoint.py + distributed/checkpoint.py — serialize and
+# fsync snapshots in a background thread instead of on the step/epoch
+# critical path. The capture itself is a device-side copy (donation-safe)
+# dispatched asynchronously; publication stays atomic (tmp -> rename with
+# a checksummed manifest) either way, so a crash mid-save can never be
+# loaded — only detected and skipped.
+define_flag("checkpoint_async", True,
+            "serialize + fsync checkpoints in a background thread "
+            "(off the training step critical path)")
+
+# incubate/auto_checkpoint.py — minimum seconds between periodic
+# snapshots. Negative: defer to the PADDLE_EDL_SAVE_CHECKPOINT_INTER env
+# (the reference's knob); >= 0 overrides it at runtime without touching
+# the environment.
+define_flag("checkpoint_save_inter_s", -1.0,
+            "min seconds between auto-checkpoint snapshots "
+            "(< 0: use PADDLE_EDL_SAVE_CHECKPOINT_INTER env)")
+
+# incubate/auto_checkpoint.py + distributed/checkpoint.py — rotation
+# depth: newest N intact snapshots are kept, older ones deleted after a
+# successful publish. 2 = checkpoint_saver.py max_num_checkpoints.
+define_flag("checkpoint_keep", 2,
+            "intact snapshots kept by checkpoint rotation")
+
+# distributed/elastic.py StragglerTracker — consecutive /clusterz
+# straggler verdicts against the same rank before it is marked for
+# eviction (checkpointed around + world renegotiated). One slow tick
+# must not evict a healthy rank; a persistently slow one must not drag
+# the whole job to its pace.
+define_flag("eviction_threshold", 3,
+            "consecutive straggler verdicts before a rank is evicted "
+            "from the training world")
+
+# distributed/chaos.py — fault-injection directives for chaos testing,
+# ';'-separated `action:key=val,key=val` (actions kill|exit|delay|raise;
+# points step|mid_save). E.g. 'kill:point=step,step=3,rank=1;'
+# 'delay:point=step,step=2,ms=250;kill:point=mid_save,n=2'. Empty (the
+# default) disables — the hooks are a flag-read when idle. Consumed at
+# the train-step boundary (hapi.Model.fit, fixtures) and inside the
+# checkpoint writer (between data files and manifest publish).
+define_flag("fault_injection", "",
+            "chaos directives: 'action:k=v,...;...' with actions "
+            "kill|exit|delay|raise at points step|mid_save (empty: off)")
+
 # static/executor.py — JAX persistent compilation cache directory: repeated
 # process starts skip XLA recompilation of unchanged programs (the role of
 # TVM's ahead-of-time compiled module artifact). Empty string disables.

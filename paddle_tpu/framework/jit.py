@@ -551,6 +551,22 @@ class TrainStepFn:
         # rebuild: the pure fn closes over nothing stateful, but the pytree
         # structure of `state` changed, so recompilation happens naturally
 
+    def save_checkpoint(self, path, step=None, async_=None, keep=None):
+        """Snapshot the on-device state crash-consistently (async by
+        default — FLAGS_checkpoint_async); distributed/checkpoint.py."""
+        from ..distributed import checkpoint as _ckpt
+
+        return _ckpt.save_train_step(self, path, step=step, async_=async_,
+                                     keep=keep)
+
+    def load_checkpoint(self, path):
+        """Restore a snapshot written by ``save_checkpoint`` (also
+        accepts one saved from a sharded/multi-rank world — the global
+        arrays are reassembled from all shards). Returns the manifest."""
+        from ..distributed import checkpoint as _ckpt
+
+        return _ckpt.restore_train_step(self, path)
+
     def sync(self):
         # copy before restoring: restore_state aliases state arrays into
         # the live objects, and the next step() donates self.state — without

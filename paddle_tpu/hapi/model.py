@@ -197,11 +197,20 @@ class Model:
         # aggregation still runs, only the line is suppressed.
         from ..monitor import TrainingMonitor
 
+        # chaos harness hook: FLAGS_fault_injection directives fire at
+        # the train-step boundary (kill -9 / delay / hard-exit) so every
+        # recovery path is exercised by a real process death. Idle cost
+        # is one flag read per batch.
+        from ..distributed import chaos
+
         mon = TrainingMonitor("fit", interval=None if verbose else 0)
+        gstep = 0
         try:
             for epoch in epoch_iter:
                 cbks.on_epoch_begin(epoch)
                 for step, batch in enumerate(loader):
+                    chaos.inject("step", step=gstep)
+                    gstep += 1
                     cbks.on_train_batch_begin(step)
                     xs, ys = _split_batch(batch)
                     with mon.step(examples=_batch_examples(xs)):
@@ -222,6 +231,17 @@ class Model:
                     break
         finally:
             mon.close()
+            if acp.AutoCheckpointChecker().valid():
+                # even on an abnormal exit, in-flight async snapshots
+                # must become durable (or fail loudly) before fit returns
+                # — a silently dropped snapshot would widen the redo
+                # window of the NEXT crash. Writer errors re-raise only
+                # when the loop itself succeeded (never mask the
+                # training exception).
+                import sys as _sys
+
+                acp.wait_pending(
+                    raise_errors=_sys.exc_info()[0] is None)
         cbks.on_train_end(logs)
         return logs
 

@@ -37,8 +37,29 @@ from . import training_monitor as _tm
 __all__ = [
     "local_snapshot", "publish", "collect", "detect_stragglers",
     "clusterz_payload",
+    "add_verdict_listener", "remove_verdict_listener",
     "ClusterPublisher", "start_publisher", "stop_publisher", "publisher",
 ]
+
+# Straggler-verdict subscribers: every clusterz_payload evaluation feeds
+# its full payload to each listener. distributed/elastic.py's eviction
+# policy (StragglerTracker) rides this — a persistently flagged rank is
+# checkpointed around and the world renegotiated, instead of the whole
+# job running at the straggler's pace.
+_VERDICT_LISTENERS: list = []
+
+
+def add_verdict_listener(cb):
+    """Register ``cb(payload)`` to observe every straggler evaluation."""
+    _VERDICT_LISTENERS.append(cb)
+    return cb
+
+
+def remove_verdict_listener(cb):
+    try:
+        _VERDICT_LISTENERS.remove(cb)
+    except ValueError:
+        pass
 
 _KEY_PREFIX = "ptpu/cluster/metrics"
 
@@ -187,6 +208,12 @@ def clusterz_payload(timeout_s=5.0, channel=None, threshold=None) -> dict:
             missing_ranks=missing,
             median_step_ms=round(median, 3),
             threshold=thr)
+    for cb in list(_VERDICT_LISTENERS):
+        try:
+            cb(payload)
+        except Exception as e:  # a policy bug must not break /clusterz
+            _flight.record_event("verdict_listener_failed",
+                                 error=f"{type(e).__name__}: {e}"[:200])
     return payload
 
 

@@ -23,8 +23,42 @@ __all__ = [
     "shard_batch",
     "with_sharding_constraint",
     "zero1_shard_opt",
+    "spec_to_wire",
+    "spec_from_wire",
     "DEFAULT_RULES",
 ]
+
+
+def spec_to_wire(spec: P) -> list:
+    """PartitionSpec -> JSON-serializable form (checkpoint manifests).
+
+    Each entry is None (unsharded dim), an axis name string, or a list of
+    axis names (a dim sharded over multiple mesh axes). The wire form is
+    mesh-independent: a checkpoint saved from a 4-way dp mesh re-slices
+    onto a 2- or 8-way mesh by rebuilding the spec against the new mesh.
+    """
+    out = []
+    for part in tuple(spec):
+        if part is None:
+            out.append(None)
+        elif isinstance(part, (tuple, list)):
+            out.append([str(p) for p in part])
+        else:
+            out.append(str(part))
+    return out
+
+
+def spec_from_wire(parts) -> P:
+    """Inverse of :func:`spec_to_wire`."""
+    rebuilt = []
+    for part in parts or []:
+        if part is None:
+            rebuilt.append(None)
+        elif isinstance(part, (tuple, list)):
+            rebuilt.append(tuple(str(p) for p in part))
+        else:
+            rebuilt.append(str(part))
+    return P(*rebuilt)
 
 
 class ShardingRules:

@@ -231,6 +231,26 @@ class ShardedTrainStep(fjit.TrainStepFn):
         return metrics
 
 
+    def save_checkpoint(self, path, step=None, async_=None, keep=None,
+                        peer_timeout_s=None):
+        """Snapshot the device state (per-shard, with PartitionSpec
+        metadata) — see distributed/checkpoint.py. Async by default
+        (``FLAGS_checkpoint_async``): the step loop pays one device-side
+        copy; serialize/fsync/publish run on the writer thread."""
+        from ..distributed import checkpoint as _ckpt
+
+        return _ckpt.save_train_step(self, path, step=step, async_=async_,
+                                     keep=keep,
+                                     peer_timeout_s=peer_timeout_s)
+
+    def load_checkpoint(self, path):
+        """Restore a snapshot, re-slicing every leaf (including ZeRO-1
+        optimizer shards) onto THIS step's mesh — which may be a
+        different world size than the save. Returns the manifest."""
+        from ..distributed import checkpoint as _ckpt
+
+        return _ckpt.restore_train_step(self, path)
+
     def sync(self, gather=True):
         """Write device state back into the eager objects.
 

@@ -58,11 +58,16 @@ def _reset_telemetry():
     cannot leak across test files and order-couple assertions."""
     yield
     from paddle_tpu import monitor, profiler, serving
+    from paddle_tpu.distributed import chaos, checkpoint
 
     # serving first: live servers/pools/batchers own daemon threads that
     # keep bumping metrics — shut the subsystem down BEFORE zeroing, so
     # no thread leaks (or stray counter bump) crosses into the next test
     serving.shutdown_all()
+    # drain the checkpoint writer: an in-flight async save must not keep
+    # writing (and bumping counters) into the next test's tmp dirs
+    checkpoint.wait_pending(raise_errors=False)
+    chaos.reset()
     profiler.reset_counters()
     monitor.reset_registry(unregister=True)
     monitor.cost_model.reset_cost_records()

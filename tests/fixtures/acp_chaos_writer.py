@@ -1,0 +1,40 @@
+"""Auto-checkpoint chaos writer: trains epochs whose weights encode the
+epoch number, snapshotting each epoch, with FLAGS_fault_injection armed
+(typically ``kill:point=mid_save,n=K`` — die inside the Kth save, after
+its data files but before the manifest publish). The driving test
+asserts the next run resumes from the previous INTACT snapshot.
+
+Env: the PADDLE_EDL_AUTO_CHECKPOINT variables + ACP_EPOCHS (default 6).
+"""
+import os
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import auto_checkpoint as acp
+
+
+def main():
+    epochs = int(os.environ.get("ACP_EPOCHS", "6"))
+    paddle.seed(0)
+    m = nn.Linear(4, 2)
+    acp.register(m)
+    for epoch in acp.train_epoch_range(epochs):
+        # weights = f(epoch): a restored model proves WHICH snapshot fed it
+        m.set_state_dict({
+            "weight": paddle.to_tensor(
+                np.full((4, 2), float(epoch), np.float32)),
+            "bias": paddle.to_tensor(np.full((2,), float(epoch),
+                                             np.float32)),
+        })
+    print("completed")
+
+
+if __name__ == "__main__":
+    main()

@@ -59,12 +59,15 @@ def main():
     mesh = parallel.create_mesh(dp=len(jax.devices()))
     step = parallel.sharded_train_step(model, optimizer, loss_fn, mesh)
     losses = [float(step(X, Y)["loss"]) for _ in range(5)]
-    print(json.dumps({
+    # ONE write (payload < PIPE_BUF) — the launch CLI's children share
+    # the parent's stdout pipe, and print()'s separate payload/newline
+    # writes interleave across ranks under load, corrupting the line
+    sys.stdout.write(json.dumps({
         "rank": fleet.fleet.worker_index(),
         "world": fleet.fleet.worker_num(),
         "n_devices": len(jax.devices()),
         "losses": losses,
-    }))
+    }) + "\n")
     sys.stdout.flush()
 
 

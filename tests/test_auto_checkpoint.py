@@ -144,6 +144,172 @@ def test_sync_fn_called_before_save(monkeypatch, tmp_path):
     assert calls  # sync ran before snapshots
 
 
+# -- crash consistency ------------------------------------------------------
+
+
+def test_snapshot_has_checksummed_manifest(monkeypatch, tmp_path):
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    _env(monkeypatch, tmp_path)
+    m = nn.Linear(2, 2)
+    o = opt.SGD(learning_rate=0.1, parameters=m.parameters())
+    acp.register(m, o)
+    for _ in acp.train_epoch_range(2):
+        pass
+    checker = acp.AutoCheckpointChecker()
+    path = acp._snapshot_path(checker, 1)
+    manifest = ckpt.validate(path)  # manifest present, checksums hold
+    assert manifest["epoch"] == 1
+    assert set(manifest["files"]) == {"default.pdparams", "default.pdopt"}
+    for meta in manifest["files"].values():
+        assert meta["size"] > 0
+
+
+def test_load_latest_skips_corrupt_and_falls_back(monkeypatch, tmp_path):
+    _env(monkeypatch, tmp_path)
+    m = nn.Linear(2, 2)
+    acp.register(m)
+    for _ in acp.train_epoch_range(4):
+        pass
+    fs = LocalFS()
+    checker = acp.AutoCheckpointChecker()
+    kept = acp._list_snapshots(checker, fs)
+    assert kept == [2, 3]
+    w3 = np.asarray(m.weight.numpy()).copy()
+
+    # corrupt the newest snapshot's params file (bit flip)
+    f = os.path.join(acp._snapshot_path(checker, 3), "default.pdparams")
+    data = bytearray(open(f, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(f, "wb").write(bytes(data))
+
+    paddle.seed(9)
+    m2 = nn.Linear(2, 2)
+    acp.reset_registry()
+    acp.register(m2)
+    assert acp._load_latest(checker, fs) == 2  # fell back to next-newest
+    np.testing.assert_allclose(np.asarray(m2.weight.numpy()), w3)
+
+    # manifest-less snapshot (torn publish) is skipped the same way
+    os.remove(os.path.join(acp._snapshot_path(checker, 3), "MANIFEST.json"))
+    assert acp._load_latest(checker, fs) == 2
+
+
+def test_load_latest_reads_legacy_meta_snapshot(monkeypatch, tmp_path):
+    """Snapshots written by the pre-manifest code (name.pdparams + meta,
+    no MANIFEST.json) must still resume after an upgrade — a running job
+    must not silently restart from epoch 0."""
+    from paddle_tpu.framework.serialization import save as ser_save
+
+    _env(monkeypatch, tmp_path)
+    checker = acp.AutoCheckpointChecker()
+    path = acp._snapshot_path(checker, 3)
+    os.makedirs(path)
+    w = np.full((4, 2), 7.0, np.float32)
+    b = np.full((2,), 7.0, np.float32)
+    ser_save({"weight": w, "bias": b},
+             os.path.join(path, "default.pdparams"))
+    with open(os.path.join(path, "meta"), "w") as f:
+        f.write("3")
+
+    paddle.seed(4)
+    m = nn.Linear(4, 2)
+    acp.register(m)
+    assert acp._load_latest(checker, LocalFS()) == 3
+    np.testing.assert_allclose(np.asarray(m.weight.numpy()), w)
+
+
+def test_load_latest_sweeps_stale_tmp(monkeypatch, tmp_path):
+    _env(monkeypatch, tmp_path)
+    m = nn.Linear(2, 2)
+    acp.register(m)
+    for _ in acp.train_epoch_range(2):
+        pass
+    checker = acp.AutoCheckpointChecker()
+    stale = acp._snapshot_path(checker, 9) + ".tmp"
+    os.makedirs(stale)
+    open(os.path.join(stale, "default.pdparams"), "wb").write(b"partial")
+    assert acp._load_latest(checker, LocalFS()) == 1
+    assert not os.path.exists(stale)  # mid-save garbage swept on resume
+
+
+def test_mid_save_failure_keeps_previous_snapshot(monkeypatch, tmp_path):
+    """A save dying between data files and manifest leaves only a torn
+    .tmp; resume lands on the previous intact snapshot."""
+    from paddle_tpu.distributed import chaos
+    from paddle_tpu.flags import set_flags
+
+    _env(monkeypatch, tmp_path)
+    m = nn.Linear(2, 2)
+    acp.register(m)
+    for _ in acp.train_epoch_range(1):  # epoch 0 snapshotted cleanly
+        pass
+    fs = LocalFS()
+    checker = acp.AutoCheckpointChecker()
+    set_flags({"fault_injection": "raise:point=mid_save,n=1",
+               "checkpoint_async": False})
+    try:
+        chaos.reset()
+        with pytest.raises(chaos.ChaosInjected):
+            acp._save_snapshot(checker, 1, fs)
+    finally:
+        set_flags({"fault_injection": "", "checkpoint_async": True})
+        chaos.reset()
+    assert os.path.isdir(acp._snapshot_path(checker, 1) + ".tmp")
+    assert not os.path.exists(acp._snapshot_path(checker, 1))
+    assert acp._load_latest(checker, fs) == 0
+    # ... and the torn tmp was swept by the load
+    assert not os.path.exists(acp._snapshot_path(checker, 1) + ".tmp")
+
+
+@pytest.mark.slow
+def test_kill9_writer_mid_save_resumes_intact(monkeypatch, tmp_path):
+    """Real kill -9 inside the snapshot writer (subprocess): the process
+    dies mid-save of epoch 2; resume must land on epoch 1, restore its
+    exact weights, and sweep the torn tmp."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fixture = os.path.join(repo, "tests", "fixtures", "acp_chaos_writer.py")
+    env = dict(os.environ)
+    env.pop("PYTEST_CURRENT_TEST", None)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+        "PADDLE_RUNNING_ENV": "PADDLE_EDL_AUTO_CHECKPOINT",
+        "PADDLE_EDL_HDFS_CHECKPOINT_PATH": str(tmp_path),
+        "PADDLE_JOB_ID": "chaos_job",
+        "PADDLE_EDL_SAVE_CHECKPOINT_INTER": "0",
+        "ACP_EPOCHS": "6",
+        # die inside the 3rd save — epochs 0 and 1 are published intact
+        "FLAGS_fault_injection": "kill:point=mid_save,n=3",
+    })
+    p = subprocess.run([sys.executable, fixture], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert p.returncode == -9, (p.returncode, p.stderr[-2000:])
+
+    # resume in-process against the same job dir
+    _env(monkeypatch, tmp_path)
+    monkeypatch.setenv("PADDLE_JOB_ID", "chaos_job")
+    acp.reset_registry()
+    paddle.seed(3)
+    m = nn.Linear(4, 2)
+    acp.register(m)
+    fs = LocalFS()
+    checker = acp.AutoCheckpointChecker()
+    epoch = acp._load_latest(checker, fs)
+    assert epoch == 1, (epoch, fs.ls_dir(checker.job_dir))
+    # the restored weights are exactly epoch 1's (weights encode epoch)
+    np.testing.assert_allclose(np.asarray(m.weight.numpy()),
+                               np.full((4, 2), 1.0), rtol=0, atol=0)
+    dirs, _ = fs.ls_dir(checker.job_dir)
+    assert not any(d.endswith(".tmp") for d in dirs)  # torn save swept
+    # and the job completes from there
+    seen = list(acp.train_epoch_range(6))
+    assert seen == [2, 3, 4, 5]
+
+
 def test_hapi_fit_auto_checkpoint(monkeypatch, tmp_path):
     """Model.fit resumes mid-training via the env configuration."""
     _env(monkeypatch, tmp_path)

@@ -82,13 +82,32 @@ def test_heartbeat_detects_dead_peers(tmp_path):
                           timeout=0.5)
     m0.beat()
     m1.beat()
-    # rank 2 never beat
+    # rank 2 never beat — but the monitor just came up, so it gets the
+    # startup grace period ("not here yet", not "dead")
+    assert m0.dead_ranks() == []
+    # once the grace elapses, sustained silence IS death
+    m0._born = time.time() - 10
     assert m0.dead_ranks() == [2]
     # rank 1 goes silent past the timeout
     old = time.time() - 10
     os.utime(m1._path(1), (old, old))
     assert m0.dead_ranks() == [1, 2]
     assert not m0.all_alive()
+
+
+def test_heartbeat_startup_grace(tmp_path):
+    """A never-beaten rank is dead only after the grace window: the
+    monitor coming up before its peers must not declare them dead."""
+    m = HeartbeatMonitor(str(tmp_path), rank=0, world_size=2,
+                         interval=0.1, timeout=10.0, grace=0.3)
+    m.beat()
+    assert m.dead_ranks() == []          # rank 1 still booting
+    time.sleep(0.35)
+    assert m.dead_ranks() == [1]         # grace elapsed, still silent
+    m2 = HeartbeatMonitor(str(tmp_path), rank=1, world_size=2,
+                          interval=0.1, timeout=10.0, grace=0.3)
+    m2.beat()
+    assert m.dead_ranks() == []          # joined late, alive now
 
 
 def test_heartbeat_thread(tmp_path):

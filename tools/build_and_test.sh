@@ -18,6 +18,8 @@
 #             KV-cache parity, streaming /generate, drain)
 #           + router smoke (fleet tier: backend processes + router,
 #             kill -9 mid-burst survival, eviction, clean drain)
+#           + chaos smoke (elastic training: kill -9 mid-checkpoint-save,
+#             resume resharded at a new world size, identical loss curve)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -91,6 +93,10 @@ case "$MODE" in
     # router smoke: 2 backend processes + router, kill -9 one mid-burst
     # (zero client-visible failures), eviction counters, clean drain
     JAX_PLATFORMS=cpu python tools/router_smoke.py
+    # chaos smoke: elastic training — kill -9 inside a checkpoint save,
+    # resume at a DIFFERENT world size with ZeRO-1 state resharded, and
+    # a loss curve identical to the uninterrupted run
+    JAX_PLATFORMS=cpu python tools/chaos_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2
