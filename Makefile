@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -58,6 +58,9 @@ router-smoke:  # serving fleet: 2 backend processes + router, kill -9 survival, 
 
 chaos-smoke:  # elastic training: kill -9 mid-save + world resizes, loss-curve-identical resume
 	JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+
+tracez-smoke:  # distributed tracing: cross-process trace continuity, tail retention of deadline+retry
+	JAX_PLATFORMS=cpu python tools/tracez_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

@@ -456,6 +456,129 @@ def bench_flight_recorder_overhead(iters=300):
     }
 
 
+def bench_tracing_overhead(requests=160, iters_direct=4000):
+    """Per-request tracing cost on the serving path (target < 2%).
+
+    Every served request records a span tree (root + queue-wait +
+    assemble + dispatch and its fan-in copy) through the tail-sampled
+    trace store; tracing ships always-on, so the cost must be certified
+    the way ``monitor_overhead``/``flight_recorder_overhead`` are.
+
+    Discipline: the certified number is the DIRECT decomposition — the
+    per-span cost of a representative span tree (enabled minus disabled,
+    tight loop, best-of-3: the quantity box noise cannot bury) scaled by
+    the spans a real request actually records, over the measured
+    per-request period of a live batcher+replica loop. The whole-loop
+    A/B (alternating, best-of-5) ships alongside as corroboration.
+    """
+    import tempfile
+    import time as _time
+
+    import paddle_tpu.static as static
+    from paddle_tpu.flags import get_flags, set_flags
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.monitor import tracing
+    from paddle_tpu.serving import DynamicBatcher, ReplicaPool
+
+    # a 5-span tree per iteration: the serving request's shape
+    def _per_tree_us(n=iters_direct):
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            with tracing.start_trace("bench::request"):
+                with tracing.start_span("bench::queue_wait"):
+                    pass
+                with tracing.start_span("bench::assemble", bucket=4,
+                                        fill=1.0):
+                    pass
+                with tracing.start_span("bench::dispatch", flops=1.0):
+                    pass
+                with tracing.start_span("bench::reply", status=200):
+                    pass
+        return (_time.perf_counter() - t0) / n * 1e6
+
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        x = static.data("x", [None, 32], "float32")
+        y = static.nn.fc(static.nn.fc(x, 64, name="tr_fc1"), 8,
+                         name="tr_fc2")
+        exe = static.Executor()
+        exe.run_startup()
+        model_dir = tempfile.mkdtemp(prefix="ptpu_bench_trace_")
+        static.save_inference_model(model_dir, ["x"], [y], exe)
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+    pred = create_predictor(Config(model_dir))
+    batcher = DynamicBatcher(["x"], buckets=(1, 2, 4),
+                             queue_capacity=64, batch_timeout_ms=0.5)
+    pool = ReplicaPool(pred, batcher, replicas=2)
+    pool.warmup()
+    pool.start()
+    rng = np.random.RandomState(0)
+    feeds = [rng.randn((i % 3) + 1, 32).astype("float32")
+             for i in range(requests)]
+
+    def _request_loop():
+        """One closed-loop client, a trace root per request — the HTTP
+        frontend's shape without the socket noise."""
+        t0 = _time.perf_counter()
+        for a in feeds:
+            with tracing.start_trace("serving::bench"):
+                batcher.predict({"x": a}, timeout=30)
+        return (_time.perf_counter() - t0) / len(feeds) * 1e6
+
+    prev = get_flags("trace_enabled")["trace_enabled"]
+    traced, untraced = [], []
+    try:
+        set_flags({"trace_enabled": True})
+        on_us = min(_per_tree_us() for _ in range(3))
+        # spans per request, measured not assumed: flag one live trace
+        # so the sampler must retain it, then count its spans
+        with tracing.start_trace("serving::bench_probe") as root:
+            tracing.flag_current_trace("bench")
+            batcher.predict({"x": feeds[0]}, timeout=30)
+        payload = tracing.store().get(root.trace_id)
+        spans_per_request = len(payload["spans"]) if payload else 5
+        period_us = _request_loop()
+        set_flags({"trace_enabled": False})
+        off_us = min(_per_tree_us() for _ in range(3))
+        # whole-loop A/B corroboration (alternating so drift hits both)
+        for _ in range(5):
+            set_flags({"trace_enabled": True})
+            traced.append(_request_loop())
+            set_flags({"trace_enabled": False})
+            untraced.append(_request_loop())
+    finally:
+        set_flags({"trace_enabled": prev})
+        pool.stop(drain=False)
+        tracing.reset_store()
+    per_span_delta_us = max(0.0, on_us - off_us) / 5.0
+    overhead = per_span_delta_us * spans_per_request / period_us
+    t_best, u_best = float(min(traced)), float(min(untraced))
+    return {
+        "metric": "tracing_overhead",
+        "value": round(overhead * 100, 3),
+        "unit": "percent",
+        "target_pct": 2.0,
+        "within_target": bool(overhead < 0.02),
+        "per_span_us": {"traced": round(on_us / 5.0, 3),
+                        "disabled": round(off_us / 5.0, 3),
+                        "delta": round(per_span_delta_us, 3)},
+        "spans_per_request": spans_per_request,
+        "request_period_us": round(period_us, 1),
+        "ab_corroboration": {
+            "overhead_pct": round((t_best - u_best) / u_best * 100, 2),
+            "traced_request_us": round(t_best, 1),
+            "untraced_request_us": round(u_best, 1),
+            "best_of": 5,
+            "samples": {"traced": [round(v, 1) for v in traced],
+                        "untraced": [round(v, 1) for v in untraced]},
+        },
+    }
+
+
 def bench_serving_throughput(requests=120, rows_cycle=(1, 2, 3, 4),
                              levels=(1, 4, 16)):
     """Online-serving throughput: the dynamic batcher + replica pool vs
@@ -1123,6 +1246,8 @@ def main():
     result["monitor_overhead"] = bench_monitor_overhead()
     # always-on flight-recorder cost, recording on vs off (target < 2%)
     result["flight_recorder_overhead"] = bench_flight_recorder_overhead()
+    # per-request trace spans + tail-sampled store, on vs off (target < 2%)
+    result["tracing_overhead"] = bench_tracing_overhead()
     # online serving: batcher+replicas vs sequential single-request calls
     result["serving_throughput"] = bench_serving_throughput()
     # generative decoding: continuous vs static batching, mixed lengths

@@ -206,6 +206,35 @@ define_flag("debug_port", 0,
             "base port for the loopback HTTP debug endpoint "
             "(bound at port+rank; 0: disabled)")
 
+# monitor/tracing.py — distributed request tracing: contextvar trace
+# context, traceparent propagation router->backend, spans through the
+# serving/executor path, step-scoped training traces. Span creation is
+# cheap (bench.py tracing_overhead < 2%); disable only to rule the
+# instrumentation out of a measurement.
+define_flag("trace_enabled", True,
+            "record per-request trace spans (traceparent propagation, "
+            "/tracez, /statz slowest table)")
+
+# monitor/tracing.py TraceStore — TAIL sampling: the retention decision
+# happens at trace completion, when the outcome is known. Error /
+# deadline / retried / timed-out traces are ALWAYS kept; of the boring
+# rest, only the slowest K per window survive.
+define_flag("trace_sample_slowest_k", 5,
+            "retain the K slowest traces per sampling window in "
+            "addition to every errored/flagged trace (0: flagged only)")
+
+# monitor/tracing.py TraceStore — the slowest-K competition window; a
+# new window forgets the old champions so a quiet hour cannot pin the
+# store to stale outliers
+define_flag("trace_sample_window_s", 30.0,
+            "tail-sampling window in seconds for the slowest-K "
+            "retention race")
+
+# monitor/tracing.py TraceStore — bound on RETAINED traces (FIFO
+# eviction past it); active (in-flight) traces are bounded at 4x this
+define_flag("trace_store_capacity", 256,
+            "maximum retained traces in the in-process trace store")
+
 # static/executor.py _scan_nan_inf + framework/jit.py checkify path —
 # what detection does: 'raise' (FatalError, the historical behavior),
 # 'warn' (bump debug/nan_events, log the first offending variable, keep

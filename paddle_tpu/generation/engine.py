@@ -41,6 +41,7 @@ from ..flags import flag
 from ..framework.jit import functional_call
 from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
+from ..monitor import tracing as _tracing
 from ..profiler import RecordEvent, bump_counter, counters as _counters
 from . import cache as _cache
 from .sampling import sample_logits
@@ -165,6 +166,7 @@ class GenerationEngine:
         sig = (label,) + tuple(
             (tuple(x.shape), str(x.dtype)) for x in leaves)
         slot = self._compiled.get(sig)
+        compiled_now = slot is None
         if slot is None:
             bump_counter(COMPILE_COUNTER)
             _flight.record_event(
@@ -179,6 +181,12 @@ class GenerationEngine:
             except Exception:  # backend without the AOT surface
                 compiled, rec = None, None
             slot = self._compiled[sig] = (compiled, rec)
+        # the slot-admission / dispatch span (if one is current) learns
+        # whether this call compiled and what the program costs — the
+        # compile-vs-execute attribution a /tracez reader needs
+        _tracing.annotate(
+            program_cache="miss" if compiled_now else "hit",
+            flops=slot[1].flops if slot[1] is not None else None)
         out = (slot[0] or jitted)(*args)
         _cost.note_run(slot[1])
         return out

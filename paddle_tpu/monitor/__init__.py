@@ -27,6 +27,12 @@ Three layers:
   snapshots over the jax.distributed KV side channel, rank-0
   ``/clusterz`` fleet view with straggler verdicts
   (``FLAGS_straggler_threshold``).
+- :mod:`monitor.tracing` — distributed request tracing: contextvar
+  trace context with W3C-style ``traceparent`` propagation across the
+  router->backend hop, structured spans through batcher/executor/
+  generation, and a tail-sampled trace store (always keep error/
+  deadline/retried traces plus the slowest-K per window) served on
+  ``/tracez``.
 - :mod:`monitor.flight_recorder` — fault diagnosis: ring-buffer flight
   recorder (executor runs, collectives with per-group sequence numbers
   and fingerprints, PS RPCs, dataloader lifecycle, flag changes, XLA
@@ -83,6 +89,18 @@ from .training_monitor import (  # noqa: F401
     active_monitor,
     record_input_wait_ms,
 )
+from . import tracing  # noqa: F401
+from .tracing import (  # noqa: F401
+    SpanContext,
+    TraceStore,
+    annotate,
+    current_context,
+    current_span,
+    format_traceparent,
+    parse_traceparent,
+    start_span,
+    start_trace,
+)
 from . import cluster  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import debug_server  # noqa: F401
@@ -110,6 +128,9 @@ __all__ = [
     "TrainingMonitor", "record_input_wait_ms", "active_monitor",
     "cost_model", "CostRecord", "device_peaks", "mfu", "hbm_bw_util",
     "roofline_class", "cluster",
+    "tracing", "SpanContext", "TraceStore", "annotate",
+    "current_context", "current_span", "format_traceparent",
+    "parse_traceparent", "start_span", "start_trace",
     "flight_recorder", "debug_server",
     "FlightRecorder", "HangWatchdog", "dump_now", "install_from_flags",
     "DebugServer", "start_debug_server", "stop_debug_server",

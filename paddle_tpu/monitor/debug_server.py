@@ -17,6 +17,9 @@ ports; serving-scale TPU jobs (Gemma-on-Cloud-TPU ops runbooks) expect a
   footprint) + the device peak table (monitor.cost_model).
 - ``/clusterz``      — every rank's published metric snapshot (step time,
   MFU, input-wait) + straggler verdicts (monitor.cluster).
+- ``/tracez``        — the tail-sampled trace store (monitor.tracing):
+  retained-trace list, one span tree by ``?id=``, chrome-trace view via
+  ``?id=...&format=chrome``.
 
 Loopback-bound on purpose: the debug surface exposes run internals, so
 reaching it from outside the host goes through whatever port-forwarding
@@ -121,8 +124,18 @@ class _Handler(BaseHTTPRequestHandler):
         routes = self._routes()
         try:
             if path in ("/", "/debugz", "/index"):
-                body, ctype = _index_text(routes), "text/plain"
-                status = 200
+                body = _index_text(list(routes) + ["/tracez"])
+                ctype, status = "text/plain", 200
+            elif path == "/tracez":
+                # query-carrying route (?id=, ?format=chrome): handled
+                # outside the zero-arg routes table so the 404 for a
+                # sampled-away trace keeps its real status
+                from . import tracing as _tracing
+
+                status, payload = _tracing.tracez_payload(
+                    _tracing.parse_query(self.path))
+                body = json.dumps(payload, indent=1, default=str)
+                ctype = "application/json"
             elif path in routes:
                 body, ctype = routes[path]()
                 status = 200

@@ -24,6 +24,7 @@ import json
 import math
 import os
 import re
+import time
 
 from .. import profiler
 from . import registry as _reg
@@ -185,12 +186,40 @@ def _align_clock_bases(host, device):
     return device
 
 
+def _retained_trace_events(host):
+    """Retained per-request traces (monitor.tracing) as chrome events,
+    one synthetic thread per trace, re-based onto the host span clock.
+
+    Trace spans stamp epoch time; host spans stamp perf_counter_ns/1e3.
+    Unlike the device trace, a retained trace does NOT start when the
+    recording starts (a p99 outlier may be retained hours in), so the
+    earliest-to-earliest anchoring of ``_align_clock_bases`` would slide
+    it to the front of the profile. Both clocks are readable NOW, so one
+    paired sample gives the exact offset instead.
+    """
+    from . import tracing as _tracing
+
+    st = _tracing.store()
+    events = []
+    for row in st.summaries():
+        payload = st.get(row["trace_id"])
+        if payload is not None:
+            events.extend(_tracing.chrome_events(payload))
+    if not host:
+        return events  # no host track: epoch timestamps stand alone
+    offset_us = time.perf_counter_ns() / 1e3 - time.time() * 1e6
+    for e in events:
+        if "ts" in e:
+            e["ts"] = e["ts"] + offset_us
+    return events
+
+
 def export_merged_chrome_trace(path, device_trace_dir=None) -> str:
-    """Write host RecordEvent spans + jax device trace as one
-    chrome://tracing JSON (device clock re-based onto the host track —
-    see _align_clock_bases). ``device_trace_dir`` defaults to the
-    directory of the most recent device trace
-    (profiler.device_trace_dir())."""
+    """Write host RecordEvent spans + jax device trace + retained
+    request/step traces as one chrome://tracing JSON (device and trace
+    clocks re-based onto the host track — see _align_clock_bases).
+    ``device_trace_dir`` defaults to the directory of the most recent
+    device trace (profiler.device_trace_dir())."""
     if device_trace_dir is None:
         device_trace_dir = profiler.device_trace_dir()
     host = profiler.host_events()
@@ -200,6 +229,9 @@ def export_merged_chrome_trace(path, device_trace_dir=None) -> str:
     events.extend(host)
     events.extend(_align_clock_bases(
         host, _device_trace_events(device_trace_dir)))
+    # the tail-sampled traces ride along: a p99 outlier's span tree
+    # lands next to the host/device timeline it happened inside
+    events.extend(_retained_trace_events(host))
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

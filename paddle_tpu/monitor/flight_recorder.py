@@ -44,6 +44,7 @@ import time
 import traceback
 
 from ..flags import flag
+from . import tracing as _tracing
 
 __all__ = [
     "FlightRecorder", "HangWatchdog",
@@ -146,10 +147,18 @@ class FlightRecorder:
     # -- recording -----------------------------------------------------------
 
     def record(self, kind, **fields):
-        """Append one structured event; no-op (None) when disabled."""
+        """Append one structured event; no-op (None) when disabled.
+
+        Events recorded inside an active trace cite its ``trace_id`` —
+        a flight-recorder post-mortem (NaN dump, watchdog trip) can
+        name the exact request/step whose trace to pull from
+        ``/tracez``, and a trace can be grepped out of a dump."""
         if not self.enabled:
             return None
         ev = {"i": 0, "t": time.time(), "kind": kind}
+        ctx = _tracing.current_context()
+        if ctx is not None:
+            ev["trace_id"] = ctx.trace_id
         ev.update(fields)
         with self._lock:
             ev["i"] = self._seq

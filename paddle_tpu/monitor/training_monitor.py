@@ -26,6 +26,7 @@ from .. import profiler
 from ..flags import flag
 from . import cost_model as _cost
 from . import registry as _reg
+from . import tracing as _tracing
 
 __all__ = ["TrainingMonitor", "record_input_wait_ms", "active_monitor"]
 
@@ -112,6 +113,7 @@ class TrainingMonitor:
         _reg.install_jax_listeners()
         self._t_begin = None
         self._span = None
+        self._tscope = None
         self._closed = False
         self._reset_window()
         _active[0] = weakref.ref(self)
@@ -158,8 +160,23 @@ class TrainingMonitor:
     def step_begin(self):
         self._span = profiler.RecordEvent(
             f"monitor::{self.name}::step").begin()
+        # step-scoped trace: everything the step touches (executor runs,
+        # flight-recorder events, a NaN or watchdog dump) can cite this
+        # trace_id; retention rides the same tail sampler as serving
+        # (aborted steps are flagged errored and always kept)
+        self._tscope = _tracing.start_trace(
+            f"train::{self.name}::step", step=self.step_count + 1)
+        self._tscope.__enter__()
         self._t_begin = time.perf_counter()
         return self
+
+    def _trace_end(self, error=None):
+        ts, self._tscope = self._tscope, None
+        if ts is None:
+            return
+        if error is not None and ts.span:
+            ts.span.set_error(error)
+        ts.__exit__(None, None, None)
 
     def step_abort(self):
         """Discard an in-flight step (the body raised): drop its span,
@@ -167,6 +184,7 @@ class TrainingMonitor:
         self._t_begin = None
         if self._span is not None:
             self._span = None  # never end()ed: the span is not recorded
+        self._trace_end(error="step aborted")
         _reg.counter(f"monitor/{self.name}/aborted_steps").inc()
 
     def step_end(self, examples=None):
@@ -179,6 +197,7 @@ class TrainingMonitor:
         if self._span is not None:
             self._span.end()
             self._span = None
+        self._trace_end()
         self.step_count += 1
         self._steps.inc()
         self._step_ms.observe(dt_ms)

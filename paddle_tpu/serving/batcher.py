@@ -49,6 +49,7 @@ from ..errors import (
 from ..flags import flag
 from ..monitor import counter, gauge, histogram
 from ..monitor import flight_recorder as _flight
+from ..monitor import tracing as _tracing
 from ..profiler import RecordEvent
 
 __all__ = [
@@ -95,7 +96,7 @@ class _Request:
     waits on."""
 
     __slots__ = ("inputs", "rows", "deadline", "t_submit", "result",
-                 "error", "_done")
+                 "error", "trace", "_done")
 
     def __init__(self, inputs, rows, deadline, t_submit):
         self.inputs = inputs
@@ -104,6 +105,10 @@ class _Request:
         self.t_submit = t_submit
         self.result = None
         self.error = None
+        # the submitter's trace context (the HTTP handler's server
+        # span): queue-wait/assemble/dispatch spans recorded by worker
+        # threads hang under it — the identity crosses the thread hop
+        self.trace = _tracing.current_context()
         self._done = threading.Event()
 
     def expired(self, now) -> bool:
@@ -292,6 +297,14 @@ class DynamicBatcher:
             _flight.record_event(
                 "serving_deadline_expired", rows=req.rows,
                 queued_ms=round((now - req.t_submit) * 1e3, 3))
+            # the queue-wait span IS the whole story of this request:
+            # record it errored and flag the trace so tail sampling
+            # retains it unconditionally (the satellite/acceptance
+            # contract: a deadline miss is never the trace you drop)
+            _tracing.record_interval(
+                "serving::queue_wait", req.trace, req.t_submit, now,
+                error="deadline exceeded in queue", rows=req.rows)
+            _tracing.flag_trace(req.trace, "deadline")
             req.done(error=DeadlineExceededError(
                 f"request deadline passed after "
                 f"{(now - req.t_submit) * 1e3:.1f}ms in queue; "
@@ -361,6 +374,7 @@ class DynamicBatcher:
             return self._assemble(picked, rows, t_first)
         except Exception as e:  # noqa: BLE001 — workers must survive
             for req in picked:
+                _tracing.flag_trace(req.trace, "error")
                 req.done(error=e)
                 self._m_errors.inc()
             _flight.record_event(
@@ -374,7 +388,13 @@ class DynamicBatcher:
             now = self._clock()
             for req in picked:
                 self._h_queue.observe((now - req.t_submit) * 1e3)
+                # queue-wait is knowable only now: record it backwards
+                # into each member's trace
+                _tracing.record_interval(
+                    "serving::queue_wait", req.trace, req.t_submit, now,
+                    rows=req.rows)
             bucket = next(b for b in self.buckets if b >= rows)
+            asp = _tracing.begin_span("serving::assemble")
             feed = {}
             for n in self.feed_names:
                 arr = (picked[0].inputs[n] if len(picked) == 1
@@ -385,6 +405,13 @@ class DynamicBatcher:
                     arr = np.concatenate([arr, pad])
                 feed[n] = arr
             t_ready = self._clock()
+            # one assembly serves every member: the span lands in each
+            # member trace, carrying the batch-fill / padding-waste
+            # attribution the p99 post-mortem needs
+            asp.set_attributes(
+                bucket=bucket, rows=rows, requests=len(picked),
+                fill=round(rows / bucket, 4), padded_rows=bucket - rows)
+            _tracing.record_fanin(asp, [r.trace for r in picked])
             self._h_assemble.observe((t_ready - t_first) * 1e3)
             self._m_batches.inc()
             self._m_rows.inc(rows)
@@ -413,6 +440,7 @@ class DynamicBatcher:
     def fail(self, batch, error):
         """Complete every request of a failed dispatch with ``error``."""
         for req in batch.requests:
+            _tracing.flag_trace(req.trace, "error")
             req.done(error=error)
             self._m_errors.inc()
         _flight.record_event(

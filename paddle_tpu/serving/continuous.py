@@ -37,6 +37,7 @@ from ..errors import InvalidArgumentError
 from ..flags import flag
 from ..monitor import counter, gauge, histogram
 from ..monitor import flight_recorder as _flight
+from ..monitor import tracing as _tracing
 from .batcher import (
     DeadlineExceededError,
     QueueFullError,
@@ -52,11 +53,15 @@ class GenerationRequest:
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "deadline",
                  "t_submit", "t_first_token", "tokens", "finish_reason",
-                 "on_token", "error", "_done")
+                 "on_token", "error", "trace", "_done")
 
     def __init__(self, prompt, max_new_tokens, temperature, deadline,
                  t_submit, on_token=None):
         self.prompt = prompt
+        # the submitter's trace context (the HTTP handler's server
+        # span): queue-wait / slot-admission / decode spans recorded by
+        # the decode-loop thread hang under it
+        self.trace = _tracing.current_context()
         self.max_new_tokens = max_new_tokens
         self.temperature = temperature
         self.deadline = deadline  # absolute monotonic seconds, or None
@@ -214,6 +219,14 @@ class ContinuousBatcher:
             _flight.record_event(
                 "generation_deadline_expired",
                 queued_ms=round((now - req.t_submit) * 1e3, 3))
+            # queue-wait is this request's whole story: record it
+            # errored and flag the trace — a deadline miss is never the
+            # trace the tail sampler drops
+            _tracing.record_interval(
+                "serving::queue_wait", req.trace, req.t_submit, now,
+                error="deadline exceeded in queue",
+                prompt_tokens=len(req.prompt))
+            _tracing.flag_trace(req.trace, "deadline")
             req.done(error=DeadlineExceededError(
                 f"generation deadline passed after "
                 f"{(now - req.t_submit) * 1e3:.1f}ms in queue; "
@@ -239,6 +252,13 @@ class ContinuousBatcher:
     def _complete(self, req, reason):
         req.finish_reason = reason
         now = self._clock()
+        # one decode span per REQUEST (first token -> finish), not per
+        # token: a long generation must not eat the trace's span budget
+        _tracing.record_interval(
+            "serving::decode", req.trace,
+            req.t_first_token if req.t_first_token is not None
+            else req.t_submit,
+            now, tokens=len(req.tokens), finish_reason=reason)
         self._h_e2e.observe((now - req.t_submit) * 1e3)
         self._m_responses.inc()
         _flight.record_event(
@@ -264,12 +284,32 @@ class ContinuousBatcher:
                 req = self._q.popleft()
                 self._m_depth.set(len(self._q))
             midbatch = self.live_slots > 0
+            t_admit = self._clock()
+            # queue-wait is knowable only now: record it backwards into
+            # the member trace, then time the prefill as a
+            # slot-admission span carrying the bucket-padding waste the
+            # p99 post-mortem needs (engine._dispatch annotates it with
+            # the cache disposition + FLOPs while it is current)
+            _tracing.record_interval(
+                "serving::queue_wait", req.trace, req.t_submit, t_admit,
+                prompt_tokens=len(req.prompt))
+            bucket = engine.bucket_for(len(req.prompt))
+            asp = _tracing.begin_span(
+                "serving::slot_admission", slot=free, midbatch=midbatch,
+                bucket=bucket, prompt_tokens=len(req.prompt),
+                padded_tokens=bucket - len(req.prompt),
+                fill=round(len(req.prompt) / bucket, 4))
             try:
-                tok = engine.admit(free, req.prompt, req.temperature)
+                with _tracing.use_span(asp):
+                    tok = engine.admit(free, req.prompt, req.temperature)
             except Exception as e:  # noqa: BLE001 — the loop must survive
+                asp.set_error(f"{type(e).__name__}: {e}")
+                _tracing.record_fanin(asp, [req.trace])
+                _tracing.flag_trace(req.trace, "error")
                 self._m_errors.inc()
                 req.done(error=e)
                 continue
+            _tracing.record_fanin(asp, [req.trace])
             with self._lock:
                 if self._closed and not self._drain:
                     # stop(drain=False) landed while this request was in
@@ -320,6 +360,13 @@ class ContinuousBatcher:
                 for s in busy:
                     req, self._slots[s] = self._slots[s], None
                     self._m_errors.inc()
+                    _tracing.record_interval(
+                        "serving::decode", req.trace,
+                        req.t_first_token if req.t_first_token is not None
+                        else req.t_submit,
+                        error=f"{type(e).__name__}: {e}",
+                        tokens=len(req.tokens))
+                    _tracing.flag_trace(req.trace, "error")
                     req.done(error=e)
                 self._m_busy.set(0)
                 _flight.record_event(

@@ -23,6 +23,7 @@ from ..errors import InvalidArgumentError, PreconditionNotMetError
 from ..flags import flag
 from ..monitor import counter, histogram
 from ..monitor import flight_recorder as _flight
+from ..monitor import tracing as _tracing
 from ..profiler import RecordEvent, counters as _profiler_counters
 
 __all__ = ["ReplicaPool", "CompileWatch", "predictor_input_specs"]
@@ -202,17 +203,37 @@ class ReplicaPool:
                 if batcher.closed:
                     break  # closed AND drained
                 continue
+            # ONE dispatch span serves the whole co-batch: made current
+            # while the executor runs (so it annotates the span with
+            # its plan/jit cache disposition and CostRecord FLOPs),
+            # then fanned into every member trace with links naming all
+            # members — each trace shows both its own dispatch cost and
+            # who it shared the program with
+            dsp = _tracing.begin_span(
+                "serving::dispatch", bucket=batch.bucket,
+                rows=batch.rows, requests=len(batch.requests),
+                replica=idx)
+            fanned = False
             try:
-                with RecordEvent("serving::dispatch"):
+                with RecordEvent("serving::dispatch"), \
+                        _tracing.use_span(dsp):
                     outs = pred.run([batch.feed[n] for n in names])
                     # materialize before slicing (lazy fetch list)
                     outs = [np.asarray(o) for o in outs]
+                dsp.end()
                 self._h_dispatch.observe(
                     (batcher._clock() - batch.t_ready) * 1e3)
                 if self.warmed:
                     self._note_unexpected_compiles(idx, batch.bucket)
+                _tracing.record_fanin(
+                    dsp, [r.trace for r in batch.requests])
+                fanned = True
                 batcher.complete(batch, outs)
             except Exception as e:  # noqa: BLE001 — worker must survive
+                dsp.set_error(f"{type(e).__name__}: {e}")
+                if not fanned:  # complete() failing must not double-fan
+                    _tracing.record_fanin(
+                        dsp, [r.trace for r in batch.requests])
                 batcher.fail(batch, e)
 
     def _note_unexpected_compiles(self, replica_idx, bucket):

@@ -65,6 +65,7 @@ from ..flags import flag
 from ..monitor import counter, gauge, histogram
 from ..monitor import flight_recorder as _flight
 from ..monitor import histogram_quantile, merge_histogram_snapshots
+from ..monitor import tracing as _tracing
 from .server import _BaseHandler
 
 __all__ = ["Router", "BackendState", "NoBackendError",
@@ -151,6 +152,7 @@ class BackendState:
 
 class _RouterHandler(_BaseHandler):
     def _reply_raw(self, status, data: bytes, ctype):
+        _tracing.note_status(status)
         self.send_response(status)
         self.send_header("Content-Type", ctype or "application/json")
         self.send_header("Content-Length", str(len(data)))
@@ -169,7 +171,7 @@ class _RouterHandler(_BaseHandler):
                 "service": "paddle_tpu serving router",
                 "routes": ["/predict (POST)", "/generate (POST)",
                            "/healthz", "/statz", "/loadz", "/histz",
-                           "/metrics"]})
+                           "/tracez", "/metrics"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -182,6 +184,15 @@ class _RouterHandler(_BaseHandler):
         if kind is None:
             self._reply(404, {"error": f"unknown path {path!r}"})
             return
+        # the router is where a fleet trace is BORN (or continued, when
+        # the client itself propagates a traceparent): every dispatch
+        # attempt becomes a child span, and the chosen backend's whole
+        # span tree hangs under the winning attempt
+        with self._trace_request("serving::router"):
+            _tracing.annotate(kind=kind)
+            self._proxy(path, kind, body)
+
+    def _proxy(self, path, kind, body):
         srv = self._srv
         if srv.draining:
             self._reply(503, {"error": "router draining"})
@@ -195,6 +206,7 @@ class _RouterHandler(_BaseHandler):
         except BackendTimeoutError as e:
             self._reply(504, {"error": str(e)})
             return
+        _tracing.annotate(backend=backend.url)
         status = resp.status
         try:
             if (resp.getheader("Transfer-Encoding") or "").lower() \
@@ -223,6 +235,9 @@ class _RouterHandler(_BaseHandler):
         """Re-chunk a streaming backend response to the client as the
         bytes arrive (one ``read1`` per backend chunk — per-token
         streaming survives the hop)."""
+        # the chunked path bypasses _reply/_reply_raw, so the trace
+        # must learn its status here
+        _tracing.note_status(resp.status)
         self.send_response(resp.status)
         self.send_header("Content-Type",
                          resp.getheader("Content-Type")
@@ -242,7 +257,10 @@ class _RouterHandler(_BaseHandler):
                     # backend died mid-stream: the status line is long
                     # gone, so terminate the chunked stream PROPERLY
                     # with an error line — a bare connection drop would
-                    # leave the client hanging on a dechunk
+                    # leave the client hanging on a dechunk. The trace
+                    # is exactly the one the incident post-mortem needs:
+                    # mark it errored so the tail sampler keeps it.
+                    _tracing.note_status(502)
                     srv.note_backend_died(backend, "died_mid_stream")
                     chunk_out(json.dumps({
                         "error": "backend connection lost mid-stream "
@@ -462,7 +480,7 @@ class Router:
         for conn in pool:
             conn.close()
 
-    def _dispatch_send(self, b: BackendState, path, body):
+    def _dispatch_send(self, b: BackendState, path, body, headers=None):
         """POST over a pooled keep-alive connection. A failure on a
         REUSED connection is retried once on a fresh one — the backend
         may simply have timed the idle socket out, which is not evidence
@@ -471,7 +489,8 @@ class Router:
         conn = self._pool_pop(b)
         if conn is not None:
             try:
-                return conn, self._request_on(conn, "POST", path, body)
+                return conn, self._request_on(conn, "POST", path, body,
+                                              extra_headers=headers)
             except BackendTimeoutError:
                 conn.close()
                 raise
@@ -480,7 +499,8 @@ class Router:
                 conn.close()  # stale keep-alive: fall through to fresh
         conn = self._connect(b)
         try:
-            return conn, self._request_on(conn, "POST", path, body)
+            return conn, self._request_on(conn, "POST", path, body,
+                                          extra_headers=headers)
         except BackendTimeoutError:
             conn.close()
             raise
@@ -490,9 +510,11 @@ class Router:
             raise BackendUnavailableError(
                 "no_response", f"{type(e).__name__}: {e}") from None
 
-    def _request_on(self, conn, method, path, body):
+    def _request_on(self, conn, method, path, body, extra_headers=None):
         try:
             headers = {"Content-Type": "application/json"} if body else {}
+            if extra_headers:
+                headers.update(extra_headers)
             conn.request(method, path, body=body, headers=headers)
             return conn.getresponse()
         except socket.timeout:
@@ -543,7 +565,12 @@ class Router:
         """Pick-and-forward with the retry policy. Returns ``(backend,
         conn, resp)`` — response unread so the handler can stream it;
         the handler MUST call :meth:`finish` when done. Raises
-        :class:`NoBackendError` after the retry budget."""
+        :class:`NoBackendError` after the retry budget.
+
+        Every attempt is its own child span under the request's trace
+        (the trace_id survives retries; each attempt is distinct), and
+        the attempt's ``traceparent`` rides the proxied request so the
+        backend's span tree hangs under it."""
         tried: set = set()
         while len(tried) < self.retries:
             b = self._pick(kind, tried)
@@ -553,40 +580,66 @@ class Router:
             with self._lock:
                 b.inflight += 1
                 b.admitted += 1
-            try:
-                conn, resp = self._dispatch_send(b, path, body)
-            except BackendTimeoutError:
-                with self._lock:
-                    b.inflight -= 1
-                raise  # dispatched: surfaces as 504, never retried
-            except BackendUnavailableError as e:
-                with self._lock:
-                    b.inflight -= 1
-                # never answered -> the work never ran to completion
-                # anywhere; evict the silent backend and retry the
-                # request on the next one
-                self._evict(b, reason=e.reason)
-                self._m_retries.inc()
-                _flight.record_event("router_retry", url=b.url,
-                                     reason=e.reason, path=path)
-                continue
-            if resp.status == 503:
-                # refused at admission (draining / not ready): the
-                # backend did NOT take the work — evict immediately
-                # (readiness re-admits it later) and retry elsewhere
+            # per-attempt span: bound under the handler's router root
+            # (NULL outside a trace — direct dispatch() callers pay one
+            # flag read). The span is recorded on scope exit whatever
+            # the outcome, so even a timed-out attempt leaves a record.
+            with _tracing.start_span(
+                    "serving::attempt", backend=b.url,
+                    attempt=len(tried)) as asp:
+                headers = None
+                if asp:
+                    headers = {
+                        _tracing.TRACEPARENT_HEADER:
+                            _tracing.format_traceparent(asp.context)}
                 try:
-                    resp.read()
-                finally:
-                    conn.close()
-                with self._lock:
-                    b.inflight -= 1
-                    b.draining = True
-                self._evict(b, reason="admission_503")
-                self._m_retries.inc()
-                _flight.record_event("router_retry", url=b.url,
-                                     reason="admission_503", path=path)
-                continue
-            return b, conn, resp
+                    conn, resp = self._dispatch_send(b, path, body,
+                                                     headers=headers)
+                except BackendTimeoutError as e:
+                    with self._lock:
+                        b.inflight -= 1
+                    # the work may still be running over there: no
+                    # retry, but the orphaned attempt span (with the
+                    # backend identity) is recorded and the trace is
+                    # retained — an operator inspecting the 504 can see
+                    # WHICH backend swallowed the request
+                    asp.set_error(f"read timeout: {e}")
+                    _tracing.flag_current_trace("timeout")
+                    raise  # dispatched: surfaces as 504, never retried
+                except BackendUnavailableError as e:
+                    with self._lock:
+                        b.inflight -= 1
+                    # never answered -> the work never ran to completion
+                    # anywhere; evict the silent backend and retry the
+                    # request on the next one
+                    asp.set_error(f"unavailable ({e.reason})")
+                    _tracing.flag_current_trace("retry")
+                    self._evict(b, reason=e.reason)
+                    self._m_retries.inc()
+                    _flight.record_event("router_retry", url=b.url,
+                                         reason=e.reason, path=path)
+                    continue
+                if resp.status == 503:
+                    # refused at admission (draining / not ready): the
+                    # backend did NOT take the work — evict immediately
+                    # (readiness re-admits it later) and retry elsewhere
+                    try:
+                        resp.read()
+                    finally:
+                        conn.close()
+                    with self._lock:
+                        b.inflight -= 1
+                        b.draining = True
+                    asp.set_attributes(status=503, refused=True)
+                    _tracing.flag_current_trace("retry")
+                    self._evict(b, reason="admission_503")
+                    self._m_retries.inc()
+                    _flight.record_event("router_retry", url=b.url,
+                                         reason="admission_503",
+                                         path=path)
+                    continue
+                asp.set_attributes(status=resp.status)
+                return b, conn, resp
         self._m_no_backend.inc()
         _flight.record_event("router_no_backend", path=path,
                              tried=sorted(tried))

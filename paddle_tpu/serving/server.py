@@ -39,6 +39,7 @@ from ..monitor import all_metrics, counter
 from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
 from ..monitor import histogram_quantile, registry_snapshot
+from ..monitor import tracing as _tracing
 from .batcher import (
     DeadlineExceededError,
     DynamicBatcher,
@@ -174,6 +175,10 @@ class _BaseHandler(BaseHTTPRequestHandler):
         return self.server._inference_server
 
     def _reply(self, status, payload, ctype="application/json"):
+        # status lands on the current request span (>=500 marks the
+        # trace errored, so the tail sampler keeps it); a no-op on the
+        # untraced GET routes
+        _tracing.note_status(status)
         body = (payload if isinstance(payload, str)
                 else json.dumps(payload, default=_json_default))
         data = body.encode("utf-8")
@@ -201,6 +206,16 @@ class _BaseHandler(BaseHTTPRequestHandler):
             return None
         return self.rfile.read(length) if length > 0 else b"{}"
 
+    def _trace_request(self, name):
+        """Open this request's local trace root. An incoming
+        ``traceparent`` (the router's per-attempt span) parents this
+        process's span tree under the caller's — one trace_id, correct
+        parentage, across the process hop."""
+        parent = _tracing.parse_traceparent(
+            self.headers.get(_tracing.TRACEPARENT_HEADER))
+        return _tracing.start_trace(name, parent=parent,
+                                    client=self.client_address[0])
+
     def _try_submit(self, fn):
         """Run an admission call, mapping the shared backpressure
         contract onto statuses: full queue 429, draining/closed 503,
@@ -227,6 +242,10 @@ class _BaseHandler(BaseHTTPRequestHandler):
             self._reply(200, srv.loadz())
         elif path == "/histz":
             self._reply(200, _histz_payload())
+        elif path == "/tracez":
+            status, payload = _tracing.tracez_payload(
+                _tracing.parse_query(self.path))
+            self._reply(status, payload)
         elif path == "/metrics":
             from ..monitor.export import (
                 PROMETHEUS_CONTENT_TYPE,
@@ -248,7 +267,7 @@ class _ServingHandler(_BaseHandler):
             self._reply(200, {
                 "service": "paddle_tpu serving",
                 "routes": ["/predict (POST)", "/healthz", "/statz",
-                           "/loadz", "/histz", "/metrics"]})
+                           "/loadz", "/histz", "/tracez", "/metrics"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -260,6 +279,12 @@ class _ServingHandler(_BaseHandler):
         if path != "/predict":
             self._reply(404, {"error": f"unknown path {path!r}"})
             return
+        # the request's local trace root: batcher/replica/executor spans
+        # nest under it; exiting runs the tail-sampling retention
+        with self._trace_request("serving::predict"):
+            self._predict(raw)
+
+    def _predict(self, raw):
         srv = self._srv
         if not srv.ready:
             self._reply(503, {"error": "not ready"
@@ -282,6 +307,7 @@ class _ServingHandler(_BaseHandler):
             lambda: srv.batcher.submit(inputs, deadline_ms=deadline_ms))
         if req is None:
             return
+        _tracing.annotate(rows=int(req.rows))
         try:
             outs = req.wait(srv.request_timeout_s)
         except DeadlineExceededError as e:
@@ -483,6 +509,9 @@ class InferenceServer:
                 "buckets": len(self.batcher.buckets),
                 "unexpected": val("serving/unexpected_compiles"),
             },
+            # top-5 end-to-end requests from the trace store: trace_id +
+            # per-stage breakdown, the jump-off point to /tracez?id=...
+            "slowest": _tracing.slowest_table(5, root_prefix="serving::"),
         }
         _, out["utilization"] = _utilization(self._t0, self._flops0, val)
         return out
@@ -502,7 +531,7 @@ class _GenerationHandler(_BaseHandler):
             self._reply(200, {
                 "service": "paddle_tpu generation",
                 "routes": ["/generate (POST)", "/healthz", "/statz",
-                           "/loadz", "/histz", "/metrics"]})
+                           "/loadz", "/histz", "/tracez", "/metrics"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -514,6 +543,10 @@ class _GenerationHandler(_BaseHandler):
         if path != "/generate":
             self._reply(404, {"error": f"unknown path {path!r}"})
             return
+        with self._trace_request("serving::generate"):
+            self._generate(raw)
+
+    def _generate(self, raw):
         srv = self._srv
         if not srv.ready:
             self._reply(503, {"error": "not ready"
@@ -543,6 +576,7 @@ class _GenerationHandler(_BaseHandler):
         except (ValueError, TypeError, InvalidArgumentError) as e:
             self._reply(400, {"error": str(e)})
             return
+        _tracing.annotate(prompt_tokens=len(prompt), stream=stream)
         if stream:
             self._generate_stream(srv, prompt, max_new, temperature,
                                   deadline_ms)
@@ -580,6 +614,8 @@ class _GenerationHandler(_BaseHandler):
             deadline_ms=deadline_ms, on_token=q.put))
         if req is None:
             return
+        # the chunked path bypasses _reply — record the status here
+        _tracing.note_status(200)
         self.send_response(200)
         self.send_header("Content-Type",
                          "application/x-ndjson; charset=utf-8")
@@ -604,9 +640,19 @@ class _GenerationHandler(_BaseHandler):
             while not q.empty():  # tokens landed between poll and finish
                 chunk({"token": q.get_nowait()})
             if req.error is not None:
+                # the 200 status line is long gone: mark the trace
+                # errored so the tail sampler keeps this stream
+                sp = _tracing.current_span()
+                if sp is not None:
+                    sp.set_error(f"{type(req.error).__name__}: "
+                                 f"{req.error}")
                 chunk({"error": f"{type(req.error).__name__}: "
                                 f"{req.error}"})
             elif not req.finished:
+                sp = _tracing.current_span()
+                if sp is not None:
+                    sp.set_error("stream timeout")
+                _tracing.flag_current_trace("timeout")
                 chunk({"error": "stream timeout"})
             else:
                 chunk({"done": True, "tokens": req.tokens,
@@ -800,6 +846,7 @@ class GenerationServer:
                 "decode": 1,
                 "unexpected": val("serving/gen_unexpected_compiles"),
             },
+            "slowest": _tracing.slowest_table(5, root_prefix="serving::"),
             "utilization": utilization,
         }
         return out

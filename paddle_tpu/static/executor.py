@@ -36,6 +36,7 @@ from ..flags import flag, watch_flag
 from ..framework import random as _random
 from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
+from ..monitor import tracing as _tracing
 from ..framework.place import Place, _default_place
 from ..framework.tensor import Tensor
 from ..ops.registry import kernel
@@ -829,6 +830,13 @@ class Executor:
             jit_cache="miss" if first_run else "hit",
             feeds=len(feed_names), fetches=len(fetch_names),
             donated=len(donate_names))
+        # a serving dispatch (or any traced caller) sees compile-vs-
+        # execute without threading a handle down here: the cache
+        # disposition lands on whatever span is current (no-op outside
+        # a trace — one contextvar read)
+        _tracing.annotate(
+            program=program_id, plan_cache=plan_disposition,
+            jit_cache="miss" if first_run else "hit")
 
         donated = [scope.get(n) for n in donate_names]
         held = [scope.get(n) for n in hold_names]
@@ -908,6 +916,11 @@ class Executor:
         # executed-work ledger: this run dispatched the captured program
         # once (feeds the MFU window math; None record is a free no-op)
         _cost.note_run(aot_slot[1])
+        if aot_slot[1] is not None:
+            # the cost sheet makes the trace self-contained: a /tracez
+            # reader sees what the dispatch COST, not just how long
+            _tracing.annotate(flops=aot_slot[1].flops,
+                              cost_bytes=aot_slot[1].bytes_accessed)
         if donate_names:
             bump_counter("executor::donated_buffers", len(donate_names))
             # a fetch may share its buffer with a value the scope holds and

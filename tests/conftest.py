@@ -71,6 +71,7 @@ def _reset_telemetry():
     profiler.reset_counters()
     monitor.reset_registry(unregister=True)
     monitor.cost_model.reset_cost_records()
+    monitor.tracing.reset_store()
     monitor.cluster.stop_publisher()
     monitor.flight_recorder.reset_recorder()
     monitor.flight_recorder.stop_watchdog()

@@ -69,6 +69,10 @@ def test_static_save_load_inference_model(tmp_path):
 
     static.reset_default_programs()
     static.enable_static()
+    # a fresh program restarts the param_N name counter, but the GLOBAL
+    # scope persists across tests and run_startup skips names it already
+    # holds — an earlier suite's stale param_0 would shadow this one's
+    static.global_scope().clear()
     try:
         x = static.data("x", [None, 4], "float32")
         w_init = np.random.RandomState(0).randn(4, 3).astype("float32")

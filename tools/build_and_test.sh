@@ -20,6 +20,8 @@
 #             kill -9 mid-burst survival, eviction, clean drain)
 #           + chaos smoke (elastic training: kill -9 mid-checkpoint-save,
 #             resume resharded at a new world size, identical loss curve)
+#           + tracez smoke (distributed tracing: one trace across
+#             router->backend processes, tail retention of deadline+retry)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -97,6 +99,10 @@ case "$MODE" in
     # resume at a DIFFERENT world size with ZeRO-1 state resharded, and
     # a loss curve identical to the uninterrupted run
     JAX_PLATFORMS=cpu python tools/chaos_smoke.py
+    # tracez smoke: router + 2 backend processes — one trace_id across the
+    # process hop with queue/dispatch stage spans, deadline-missed and
+    # retried traces retained while the fast-path bulk is dropped
+    JAX_PLATFORMS=cpu python tools/tracez_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

@@ -11,6 +11,7 @@ Usage:
     python tools/trace_summary.py trace.json
     python tools/trace_summary.py --sort calls --top 20 trace.json
     python tools/trace_summary.py --prefix executor:: trace.json
+    python tools/trace_summary.py --trace-id 3f2a... merged.json
 
 Reads complete-duration events (ph=X); sort keys mirror
 profiler.print_summary (total/calls/max/ave descending, min ascending).
@@ -30,6 +31,16 @@ def load_trace(path):
     if isinstance(trace, list):  # bare traceEvents array is also legal
         return trace
     return trace.get("traceEvents", [])
+
+
+def filter_trace_id(events, trace_id):
+    """Only events belonging to one distributed trace (the tracing
+    spans embedded by export_merged_chrome_trace / ``/tracez`` carry
+    their trace_id in ``args``). Prefix match, so the first 8+ hex
+    chars from a /statz slowest row are enough."""
+    return [ev for ev in events
+            if str(ev.get("args", {}).get("trace_id", ""))
+            .startswith(trace_id)]
 
 
 def aggregate(events, prefix=None):
@@ -85,8 +96,17 @@ def main(argv=None):
     p.add_argument("--prefix", default=None,
                    help="only events whose name starts with this "
                         "(e.g. executor:: / dataloader:: / collective::)")
+    p.add_argument("--trace-id", default=None,
+                   help="only spans of one distributed trace (hex id or "
+                        "unique prefix, from /tracez or /statz slowest)")
     args = p.parse_args(argv)
     events = load_trace(args.trace)
+    if args.trace_id:
+        events = filter_trace_id(events, args.trace_id)
+        if not events:
+            print(f"no spans for trace_id {args.trace_id!r} in "
+                  f"{args.trace}", file=sys.stderr)
+            return 1
     agg = aggregate(events, prefix=args.prefix)
     render(agg, sort=args.sort, top=args.top)
     return 0
