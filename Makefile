@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -61,6 +61,9 @@ chaos-smoke:  # elastic training: kill -9 mid-save + world resizes, loss-curve-i
 
 tracez-smoke:  # distributed tracing: cross-process trace continuity, tail retention of deadline+retry
 	JAX_PLATFORMS=cpu python tools/tracez_smoke.py
+
+kernel-smoke:  # fused pallas kernels: numeric parity, zero extra compiles, h2d overlap
+	JAX_PLATFORMS=cpu python tools/kernel_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

@@ -473,3 +473,43 @@ define_flag("fault_injection", "",
 define_flag("persistent_compile_cache_dir", "",
             "directory for the XLA persistent compilation cache "
             "(empty: disabled)")
+
+# runtime/compiled.py CompiledStore — ONE bound for every compiled-
+# executable LRU cache (executor jit entries, TrainStepFn per-batch-
+# signature executables, generation prefill/decode programs). Before the
+# shared runtime each site hardcoded its own (executor 128 vs TrainStepFn
+# 16 — many batch signatures silently evicted/recompiled under the small
+# one). Evictions bump `<label>::cache_evict` so an undersized cache
+# shows in the counters instead of as mystery recompiles. Read at insert
+# time, so set_flags applies to live stores.
+define_flag("compiled_cache_capacity", 128,
+            "LRU bound shared by every compiled-executable cache "
+            "(executor / train step / generation); evictions counted "
+            "per store as <label>::cache_evict")
+
+# optimizer/__init__.py Momentum + ops/pallas/optimizer_update.py — fuse
+# the momentum + L2 weight-decay parameter update into one pallas kernel
+# on TPU (one HBM read/write pass over param+velocity instead of the
+# op-by-op chain). The jnp fallback used elsewhere computes the identical
+# expression, so the flag is numerically free to leave on.
+define_flag("use_fused_optimizer", True,
+            "fused pallas momentum/weight-decay parameter update on TPU "
+            "(jnp fallback elsewhere; identical math)")
+
+# nn/transformer.py + ops/pallas/layernorm_residual.py — fuse the
+# residual-add + LayerNorm pair (the post-norm transformer's hottest
+# pointwise chain) into one pallas kernel on TPU: one VMEM pass computes
+# x+residual, the f32 statistics, and the affine output. The jnp
+# fallback is the same math XLA fuses today.
+define_flag("use_fused_layernorm", True,
+            "fused pallas residual-add + LayerNorm on TPU "
+            "(jnp fallback elsewhere; identical math)")
+
+# io/dataloader.py _DevicePrefetcher — issue the NEXT batches' host
+# fetch + jax.device_put from a background thread while the consumer's
+# step runs (double-buffered h2d/compute overlap). Off: the legacy
+# synchronous refill (the consumer's __next__ pays the upstream parse
+# and the device_put enqueue inline).
+define_flag("io_prefetch_overlap", True,
+            "overlap dataloader H2D transfers with compute via a "
+            "background prefetch thread (double-buffered)")

@@ -20,10 +20,15 @@ compiled.
 from __future__ import annotations
 
 import contextlib
+import itertools
 from collections import OrderedDict
 
 import jax
 import jax.numpy as jnp
+
+# deterministic TrainStepFn instance ids (cache-key stability; see
+# TrainStepFn.__init__)
+_step_fn_counter = itertools.count()
 
 from . import autograd
 from .random import default_generator
@@ -288,13 +293,23 @@ class TrainStepFn:
             )
         else:
             self.compiled = self.pure
-        # per-batch-signature AOT executables + their cost-model records:
-        # sig -> [Compiled|None, CostRecord|None, attempted] (see
-        # _dispatch — the compile is captured for utilization accounting).
-        # LRU-bounded like the executor's jit cache: variable-shape
-        # batches must not accumulate compiled executables unboundedly.
-        self._exec = {}
-        self._exec_limit = 16
+        # per-batch-signature executables through the SHARED compiled-
+        # callable runtime (runtime/compiled.py): AOT compile + cost
+        # capture + LRU bound (FLAGS_compiled_cache_capacity — the same
+        # knob the executor obeys; the old hardcoded 16 here silently
+        # evicted/recompiled under many batch signatures) + the
+        # donation-safe demote-to-jit fallback, all one policy.
+        from ..runtime.compiled import CompiledStore
+
+        self._exec = CompiledStore(
+            "train_step", cost_label="train_step",
+            hit_counter="train_step::exec_cache_hit",
+            miss_counter="train_step::exec_cache_miss")
+        # deterministic per-instance index (not id()): the derived
+        # cache_key must be stable across runs for log correlation, yet
+        # distinct per step fn so two models with identical batch avals
+        # don't collide in the global CostRecord registry
+        self._instance = next(_step_fn_counter)
         self._rng = default_generator().split()
 
     def _build_pure(self):
@@ -422,14 +437,11 @@ class TrainStepFn:
         return metrics
 
     def _dispatch(self, batch, lr, sub):
-        """Run one step, AOT-compiling per batch signature so the
-        compiled module's own cost_analysis()/memory_analysis() feed the
-        utilization accounting (monitor.cost_model) — the same single
-        XLA compile jax.jit's first call would pay, captured instead of
-        hidden. Falls back to the plain jit path on backends without the
-        AOT/analysis surface."""
-        from ..monitor import cost_model as _cost
-
+        """Run one step through the shared compiled-callable runtime:
+        per-batch-signature AOT compile (the same single XLA compile
+        jax.jit's first call would pay, captured for the utilization
+        accounting), LRU caching, and the donation-safe demote-to-jit
+        fallback all follow the one policy in runtime/compiled.py."""
         if not self._jit:
             self.state, metrics = self.compiled(self.state, batch, lr, sub)
             return metrics
@@ -437,43 +449,15 @@ class TrainStepFn:
         # gradient-merge slot changes the state pytree — both change the
         # compiled signature, so they key the executable cache alongside
         # the batch avals
-        sig = (len(self.state["params"]), "gm" in self.state) + tuple(
+        sig = (self._instance, len(self.state["params"]),
+               "gm" in self.state) + tuple(
             (tuple(b.shape), str(b.dtype)) for b in batch)
-        slot = self._exec.get(sig)
-        if slot is None:
-            slot = self._exec[sig] = [None, None, False]
-            while len(self._exec) > self._exec_limit:
-                self._exec.pop(next(iter(self._exec)))
-        else:
-            self._exec[sig] = self._exec.pop(sig)  # refresh LRU order
-        if not slot[2]:
-            slot[2] = True
-            try:
-                lowered = self.compiled.lower(self.state, batch, lr, sub)
-                slot[0] = lowered.compile()
-                slot[1] = _cost.capture(
-                    "train_step", lowered=lowered, compiled=slot[0],
-                    key=("train_step", id(self), sig))
-            except Exception:
-                slot[0] = None
-        runner = slot[0] if slot[0] is not None else self.compiled
-        try:
-            new_state, metrics = runner(self.state, batch, lr, sub)
-        except Exception:
-            # AOT is stricter than jax.jit (aval drift raises instead of
-            # recompiling): demote and retry — unless donation already
-            # consumed the state buffers, where a retry cannot be safe
-            if runner is self.compiled or any(
-                    getattr(a, "is_deleted", lambda: False)()
-                    for a in jax.tree_util.tree_leaves(self.state)):
-                raise
-            # the record described the pre-drift program — crediting it
-            # against jax.jit's recompile would corrupt the MFU ledger
-            slot[0] = None
-            slot[1] = None
-            new_state, metrics = self.compiled(self.state, batch, lr, sub)
+        entry, _ = self._exec.get_or_build(
+            sig, lambda: (self.compiled, None))
+        new_state, metrics = self._exec.dispatch(
+            entry, self.state, batch, lr, sub,
+            donated=lambda: jax.tree_util.tree_leaves(self.state))
         self.state = new_state
-        _cost.note_run(slot[1])
         return metrics
 
     def _run_checked(self, batch, lr, sub):

@@ -29,6 +29,7 @@ slot loop inline for offline use (bench, tests, parity goldens).
 """
 from __future__ import annotations
 
+import itertools
 from collections import OrderedDict, deque
 
 import numpy as np
@@ -39,16 +40,18 @@ import jax.numpy as jnp
 from ..errors import InvalidArgumentError
 from ..flags import flag
 from ..framework.jit import functional_call
-from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
 from ..monitor import tracing as _tracing
-from ..profiler import RecordEvent, bump_counter, counters as _counters
+from ..profiler import RecordEvent, counters as _counters
 from . import cache as _cache
 from .sampling import sample_logits
 
 __all__ = ["GenerationEngine", "COMPILE_COUNTER"]
 
 COMPILE_COUNTER = "generation::compile"
+
+# deterministic engine instance ids (cache-key stability; see __init__)
+_engine_counter = itertools.count()
 
 
 class GenerationEngine:
@@ -71,7 +74,8 @@ class GenerationEngine:
         # lazy: serving imports generation's scheduler, so module-level
         # imports the other way would cycle
         from ..serving.batcher import parse_buckets
-        from ..serving.replica import CompileWatch
+
+        from ..runtime.compiled import CompiledStore, CompileWatch
 
         self.model = model
         model.eval()  # generation never wants dropout
@@ -118,7 +122,20 @@ class GenerationEngine:
         self._named = None
         self._prefill_jit = jax.jit(self._prefill_pure)
         self._decode_jit = jax.jit(self._decode_pure)
-        self._compiled = {}
+        # compiled prefill/decode programs live in the SHARED compiled-
+        # callable runtime: AOT compile + cost capture (decode MFU in the
+        # /statz ledger) + the flag-governed LRU bound, with every new
+        # signature counted through ``generation::compile`` — the
+        # bounded-compile discipline the batch-bucket ladder established,
+        # on the sequence axis
+        self._stores = {
+            label: CompiledStore(f"generation_{label}",
+                                 miss_counter=COMPILE_COUNTER)
+            for label in ("prefill", "decode")}
+        # deterministic per-engine index for the cache signature (stable
+        # cache_key across runs, distinct per engine in the CostRecord
+        # registry — two engines may share avals but not weights)
+        self._instance = next(_engine_counter)
         self.warmed = False
         # the serving-wide warmup-snapshot discipline; the continuous
         # batcher notes growth through this same watch
@@ -157,39 +174,22 @@ class GenerationEngine:
     # -- compile accounting ---------------------------------------------------
 
     def _dispatch(self, label, jitted, args):
-        """Run one compiled step, AOT-compiling new signatures so the
-        cost model captures them (MFU in ``/statz``) and every compile is
-        COUNTED (``generation::compile``) — the bounded-compile
-        discipline the batch-bucket ladder established, on the sequence
-        axis."""
+        """Run one compiled step through the shared compiled-callable
+        runtime: new signatures are AOT-compiled and cost-captured (MFU
+        in ``/statz``) under the one policy every dispatch site shares,
+        and every compile is COUNTED (``generation::compile``, the
+        store's miss counter)."""
+        store = self._stores[label]
         leaves = jax.tree_util.tree_leaves(args)
-        sig = (label,) + tuple(
+        sig = (self._instance,) + tuple(
             (tuple(x.shape), str(x.dtype)) for x in leaves)
-        slot = self._compiled.get(sig)
-        compiled_now = slot is None
-        if slot is None:
-            bump_counter(COMPILE_COUNTER)
-            _flight.record_event(
-                "generation_compile", label=label,
-                known_programs=len(self._compiled) + 1)
-            try:
-                lowered = jitted.lower(*args)
-                compiled = lowered.compile()
-                rec = _cost.capture(
-                    f"generation_{label}", lowered=lowered,
-                    compiled=compiled, key=("generation", id(self), sig))
-            except Exception:  # backend without the AOT surface
-                compiled, rec = None, None
-            slot = self._compiled[sig] = (compiled, rec)
+        entry, disposition = store.get_or_build(
+            sig, lambda: (jitted, None))
         # the slot-admission / dispatch span (if one is current) learns
-        # whether this call compiled and what the program costs — the
-        # compile-vs-execute attribution a /tracez reader needs
-        _tracing.annotate(
-            program_cache="miss" if compiled_now else "hit",
-            flops=slot[1].flops if slot[1] is not None else None)
-        out = (slot[0] or jitted)(*args)
-        _cost.note_run(slot[1])
-        return out
+        # whether this call compiled — the compile-vs-execute attribution
+        # a /tracez reader needs (the runtime adds cache_key + flops)
+        _tracing.annotate(program_cache=disposition)
+        return store.dispatch(entry, *args)
 
     def extra_compiles(self) -> int:
         """Compiles since warmup — steady state must keep this at 0."""

@@ -20,6 +20,7 @@ import os
 import queue as queue_mod
 import threading
 import time
+import weakref
 
 import numpy as np
 
@@ -208,51 +209,156 @@ class _MultiprocessIter:
 
 
 class _DevicePrefetcher:
-    """buffered_reader.cc equivalent: keep N batches already on device.
+    """buffered_reader.cc equivalent: keep N batches already on device,
+    with the host fetch + H2D enqueue OVERLAPPING the consumer's step.
 
-    jax.device_put enqueues the H2D copy asynchronously, so refilling
-    after each pop puts the NEXT batches' transfers in flight while the
-    consumer's step runs — shared by the DataLoader's buffer reader and
-    Executor.train_from_dataset (via DatasetBase._iter_device_batches)."""
+    Under ``FLAGS_io_prefetch_overlap`` (default) a background thread
+    owns the upstream ``next()`` (parse/collate wait) and the
+    ``jax.device_put`` enqueue, double-buffered through a bounded queue
+    of ``depth`` device-resident batches — the consumer's ``__next__``
+    is a queue pop, so batch N+1's transfer is in flight while step N
+    computes and the only consumer-visible input wait is a genuine
+    underrun (visible as the monitor's ``input_wait_ratio``). With the
+    flag off, the legacy synchronous refill runs inline in ``__next__``
+    (the consumer pays parse + enqueue on the step path) — the A/B the
+    bench's ``input_overlap`` sub-metric measures. Shared by the
+    DataLoader's buffer reader and Executor.train_from_dataset (via
+    DatasetBase._iter_device_batches)."""
+
+    _DONE = object()
 
     def __init__(self, it, depth=2, to_device=None):
+        from ..flags import flag
+
         self.it = it
-        self.depth = depth
+        self.depth = max(1, int(depth))
         self.to_device = to_device
-        self.buf = []
-        self._fill()
+        self._overlap = bool(flag("io_prefetch_overlap"))
+        if self._overlap:
+            self._q = queue_mod.Queue(maxsize=self.depth)
+            self._stop = threading.Event()
+            self._done = False
+            # the fill thread closes ONLY over (it, q, stop) — never
+            # self: a thread frame referencing the prefetcher would keep
+            # it reachable forever, so an abandoned iterator could never
+            # be collected and the finalizer below could never fire
+            self._thread = threading.Thread(
+                target=_prefetch_fill_loop,
+                args=(self.it, self.to_device, self._q, self._stop,
+                      self._DONE),
+                name="ptpu-h2d-prefetch", daemon=True)
+            self._thread.start()
+            # abandonment shutdown: when the consumer drops the iterator
+            # mid-epoch, GC runs this and the fill thread exits at its
+            # next 0.1s stop-check instead of spinning forever
+            self._finalizer = weakref.finalize(self, self._stop.set)
+        else:
+            self.buf = []
+            self._fill()
+
+    def close(self):
+        """Stop the background fill (idempotent)."""
+        if self._overlap:
+            self._stop.set()
+
+    # -- legacy synchronous path --------------------------------------------
 
     def _fill(self):
         while len(self.buf) < self.depth:
             try:
-                with RecordEvent("dataloader::prefetch_fill"):
-                    batch = next(self.it)
+                self.buf.append(
+                    _prefetch_prepare(self.it, self.to_device))
             except StopIteration:
                 return
-            if self.to_device:
-                import jax
-
-                # async enqueue of the H2D copy (the actual transfer
-                # overlaps the consumer's step; the span shows enqueue
-                # stalls when the transfer queue backs up)
-                with RecordEvent("dataloader::h2d"):
-                    batch = jax.tree_util.tree_map(jax.device_put, batch)
-            self.buf.append(batch)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        # consumer-side wall time in here is input wait: the refill after
-        # the pop is where an underrun blocks on upstream parse/collate
+        # consumer-side wall time in here is input wait: with overlap on
+        # it is the queue-pop wait (a true underrun); with it off, the
+        # inline refill's upstream parse/collate + enqueue
         t0 = time.perf_counter()
-        if not self.buf:
-            raise StopIteration
-        batch = self.buf.pop(0)
-        self._fill()
+        if self._overlap:
+            if self._done:
+                raise StopIteration  # terminal: never block again
+            while True:
+                try:
+                    item = self._q.get(timeout=0.1)
+                    break
+                except queue_mod.Empty:
+                    # after close() the fill thread refuses further puts
+                    # (even its DONE tail), so an empty queue is
+                    # terminal — without this check a consumer would
+                    # block forever waiting for a sentinel that can
+                    # never arrive
+                    if self._stop.is_set():
+                        self._done = True
+                        raise StopIteration
+            if item is self._DONE:
+                self._done = True
+                self.close()
+                raise StopIteration
+            if isinstance(item, BaseException):
+                self._done = True
+                self.close()
+                raise item
+            batch = item
+        else:
+            if not self.buf:
+                raise StopIteration
+            batch = self.buf.pop(0)
+            self._fill()
         _mon.counter("io/batches").inc()
         record_input_wait_ms((time.perf_counter() - t0) * 1e3)
         return batch
+
+
+def _prefetch_prepare(it, to_device):
+    """One upstream fetch + device enqueue (both prefetcher paths)."""
+    with RecordEvent("dataloader::prefetch_fill"):
+        batch = next(it)
+    if to_device:
+        import jax
+
+        # async enqueue of the H2D copy (the actual transfer overlaps
+        # the consumer's step; the span shows enqueue stalls when the
+        # transfer queue backs up)
+        with RecordEvent("dataloader::h2d"):
+            batch = jax.tree_util.tree_map(jax.device_put, batch)
+    return batch
+
+
+def _prefetch_fill_loop(it, to_device, q, stop, done_sentinel):
+    """_DevicePrefetcher's background fill (module-level on purpose —
+    see the constructor: the thread must not keep the prefetcher
+    alive). Exceptions travel to the consumer through the queue."""
+
+    def put(item) -> bool:
+        # bounded put that stays responsive to shutdown: an abandoned
+        # consumer must not leave the thread parked on a full queue
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    tail = done_sentinel
+    try:
+        while not stop.is_set():
+            try:
+                item = _prefetch_prepare(it, to_device)
+            except StopIteration:
+                break
+            except BaseException as e:  # surface on the consumer side
+                tail = e
+                break
+            if not put(item):
+                return  # consumer abandoned the iterator
+    finally:
+        put(tail)
 
 
 class _AccountedIter:

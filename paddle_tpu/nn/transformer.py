@@ -26,6 +26,24 @@ from .layers import Dropout, LayerList, LayerNorm, Linear
 FLASH_ATTENTION_MIN_SEQ = 512
 
 
+def _residual_norm(norm, residual, y):
+    """Post-norm ``LayerNorm(residual + y)`` through the fused pallas
+    residual-add+layernorm kernel (``FLAGS_use_fused_layernorm``) when
+    the norm is a plain last-dim LayerNorm with affine params — the jnp
+    fallback and the unfused path execute the identical primitive
+    sequence, so this is a scheduling choice, never a numeric one."""
+    from ..flags import flag
+
+    if (flag("use_fused_layernorm") and isinstance(norm, LayerNorm)
+            and norm.weight is not None and norm.bias is not None
+            and len(norm.normalized_shape) == 1):
+        from ..ops.pallas import layernorm_residual
+
+        return layernorm_residual(y, residual, norm.weight, norm.bias,
+                                  norm.epsilon)
+    return norm(residual + y)
+
+
 def _convert_attention_mask(attn_mask, dtype):
     """Normalize a mask to an ADDITIVE mask broadcastable against the
     [B, H, Lq, Lk] score tensor.
@@ -294,17 +312,19 @@ class TransformerEncoderLayer(Layer):
             src = self.self_attn(src, src, src, src_mask)
         else:
             src, new_cache = self.self_attn(src, src, src, src_mask, cache)
-        src = residual + self.dropout1(src)
-        if not self.normalize_before:
-            src = self.norm1(src)
+        if self.normalize_before:
+            src = residual + self.dropout1(src)
+        else:
+            src = _residual_norm(self.norm1, residual, self.dropout1(src))
 
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
         src = self.linear2(self.dropout(self.activation(self.linear1(src))))
-        src = residual + self.dropout2(src)
-        if not self.normalize_before:
-            src = self.norm2(src)
+        if self.normalize_before:
+            src = residual + self.dropout2(src)
+        else:
+            src = _residual_norm(self.norm2, residual, self.dropout2(src))
         return src if cache is None else (src, new_cache)
 
 
@@ -368,9 +388,10 @@ class TransformerDecoderLayer(Layer):
             tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
         else:
             tgt, new_cache = self.self_attn(tgt, tgt, tgt, tgt_mask, cache)
-        tgt = residual + self.dropout1(tgt)
-        if not self.normalize_before:
-            tgt = self.norm1(tgt)
+        if self.normalize_before:
+            tgt = residual + self.dropout1(tgt)
+        else:
+            tgt = _residual_norm(self.norm1, residual, self.dropout1(tgt))
 
         if self.cross_attn is not None:
             if memory is None:
@@ -382,17 +403,20 @@ class TransformerDecoderLayer(Layer):
             if self.normalize_before:
                 tgt = self.norm2(tgt)
             tgt = self.cross_attn(tgt, memory, memory, memory_mask)
-            tgt = residual + self.dropout2(tgt)
-            if not self.normalize_before:
-                tgt = self.norm2(tgt)
+            if self.normalize_before:
+                tgt = residual + self.dropout2(tgt)
+            else:
+                tgt = _residual_norm(self.norm2, residual,
+                                     self.dropout2(tgt))
 
         residual = tgt
         if self.normalize_before:
             tgt = self.norm3(tgt)
         tgt = self.linear2(self.dropout(self.activation(self.linear1(tgt))))
-        tgt = residual + self.dropout3(tgt)
-        if not self.normalize_before:
-            tgt = self.norm3(tgt)
+        if self.normalize_before:
+            tgt = residual + self.dropout3(tgt)
+        else:
+            tgt = _residual_norm(self.norm3, residual, self.dropout3(tgt))
         return tgt if cache is None else (tgt, new_cache)
 
 

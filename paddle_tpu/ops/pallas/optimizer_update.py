@@ -1,0 +1,141 @@
+"""Fused momentum / weight-decay optimizer update (TPU pallas kernel).
+
+The Momentum update is the textbook memory-bound chain: read param,
+grad, velocity; write param, velocity — with L2 weight decay it lowers
+to four elementwise HBM passes when left to op-by-op dispatch. On TPU
+the whole update runs as ONE pallas kernel: a single VMEM pass computes
+
+    g' = grad + wd * param
+    v' = mu * v + g'
+    p' = p - lr * (g' + mu * v')      (nesterov)
+        | p - lr * v'                  (plain)
+
+with ``input_output_aliases`` so param and velocity update in place
+(zero extra HBM allocation — the same discipline as the executor's
+buffer donation). Off-TPU (and for shapes/dtypes the kernel does not
+admit) a jnp fallback computes the IDENTICAL expression in the same
+order, so the fused path is bit-compatible everywhere and
+``FLAGS_use_fused_optimizer`` is numerically free to leave on.
+
+Design per /opt/skills/guides/pallas_guide.md: operands flatten to
+``[R, 128]`` lane-major tiles (sublane padding per dtype), the grid
+walks row blocks, and ``lr`` (a traced scalar — the LR schedule feeds a
+fresh value every step without recompiling) rides in SMEM as ``[1, 1]``.
+Padding rows compute garbage that is never written back (masked block
+writes), which is safe because the update is purely elementwise.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ._platform import on_tpu_platform
+
+__all__ = ["fused_momentum_update"]
+
+_LANES = 128
+# minimum sublane multiple per dtype (pallas_guide.md tiling table)
+_SUBLANES = {"float32": 8, "bfloat16": 16}
+
+
+def _jnp_update(param, grad, velocity, lr, mu, wd, nesterov):
+    """Reference/fallback path: the exact expression the kernel fuses,
+    in the same operation order (bit-identical off-TPU)."""
+    g = grad + wd * param if wd else grad
+    v = mu * velocity + g
+    if nesterov:
+        new_p = param - lr * (g + mu * v)
+    else:
+        new_p = param - lr * v
+    return new_p, v
+
+
+def _kernel(lr_ref, p_ref, g_ref, v_ref, p_out, v_out, *, mu, wd,
+            nesterov):
+    lr = lr_ref[0, 0]
+    p = p_ref[:]
+    g = g_ref[:]
+    if wd:
+        g = g + wd * p
+    v = mu * v_ref[:] + g
+    v_out[:] = v
+    if nesterov:
+        p_out[:] = p - lr * (g + mu * v)
+    else:
+        p_out[:] = p - lr * v
+
+
+def _supported(param, grad, velocity) -> bool:
+    if str(param.dtype) not in _SUBLANES:
+        return False
+    return (param.shape == grad.shape == velocity.shape
+            and param.size >= _LANES)
+
+
+def _pallas_update(param, grad, velocity, lr, mu, wd, nesterov,
+                   interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    shape, dtype, n = param.shape, param.dtype, param.size
+    sub = _SUBLANES[str(dtype)]
+    tile = sub * _LANES
+    padded = ((n + tile - 1) // tile) * tile
+    rows = padded // _LANES
+
+    def flat(a):
+        a = a.reshape(-1)
+        if padded != n:
+            a = jnp.pad(a, (0, padded - n))
+        return a.reshape(rows, _LANES)
+
+    pf, gf, vf = flat(param), flat(grad), flat(velocity)
+    block_r = min(rows, 2048)  # ≤ 2048×128 f32 = 1 MB per operand block
+    grid = (pl.cdiv(rows, block_r),)
+    row_spec = pl.BlockSpec((block_r, _LANES), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+
+    def kernel(lr_ref, p_ref, g_ref, v_ref, p_out, v_out):
+        return _kernel(lr_ref, p_ref, g_ref, v_ref, p_out, v_out,
+                       mu=mu, wd=wd, nesterov=nesterov)
+
+    new_p, new_v = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i: (0, 0),
+                         memory_space=pltpu.SMEM),
+            row_spec, row_spec, row_spec,
+        ],
+        out_specs=[row_spec, row_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, _LANES), dtype),
+            jax.ShapeDtypeStruct((rows, _LANES), dtype),
+        ],
+        # param/velocity update IN PLACE (XLA aliases the dead inputs)
+        input_output_aliases={1: 0, 3: 1},
+        interpret=interpret,
+    )(lr_arr, pf, gf, vf)
+    unflat = lambda a: a.reshape(-1)[:n].reshape(shape)
+    return unflat(new_p), unflat(new_v)
+
+
+def fused_momentum_update(param, grad, velocity, lr, momentum=0.9,
+                          weight_decay=0.0, use_nesterov=False):
+    """One fused momentum(+L2 decay) parameter update.
+
+    Returns ``(new_param, new_velocity)``. Dispatches to the pallas
+    kernel on TPU for admitted shapes/dtypes; elsewhere the jnp fallback
+    computes the identical expression (same order, same dtypes). Safe
+    inside a jitted train step (``lr`` may be a traced scalar).
+    """
+    param = jnp.asarray(param)
+    grad = jnp.asarray(grad, param.dtype)
+    velocity = jnp.asarray(velocity, param.dtype)
+    mu = float(momentum)
+    wd = float(weight_decay)
+    nesterov = bool(use_nesterov)
+    if on_tpu_platform() and _supported(param, grad, velocity):
+        return _pallas_update(param, grad, velocity, lr, mu, wd, nesterov)
+    return _jnp_update(param, grad, velocity, lr, mu, wd, nesterov)

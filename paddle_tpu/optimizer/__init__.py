@@ -145,9 +145,20 @@ class Optimizer:
 
     clear_gradients = clear_grad
 
+    def _fused_decay_coeff(self):
+        """L2-decay coefficient an optimizer's fused update kernel will
+        fold in itself (``None``: decay is pre-applied to the grad here
+        in ``step()``, the historical path). Only optimizers with a
+        fused pallas update override this (Momentum)."""
+        return None
+
     # main entry points -----------------------------------------------------
     @no_grad()
     def step(self):
+        # when the update kernel fuses L2 decay (Momentum on the fused
+        # path), skip the separate decay pass here — but only for params
+        # without a per-param regularizer (those keep their own)
+        fused_wd = self._fused_decay_coeff()
         params_grads = []
         for i, p in enumerate(self._parameter_list):
             if p.grad is None or not getattr(p, "trainable", True):
@@ -155,7 +166,8 @@ class Optimizer:
             g = p.grad._array.astype(p._array.dtype)
             if self._weight_decay is not None and getattr(p, "regularizer", None) is None \
                     and not isinstance(self, AdamW):
-                g = self._weight_decay(p._array, g)
+                if fused_wd is None:
+                    g = self._weight_decay(p._array, g)
             elif getattr(p, "regularizer", None) is not None:
                 g = p.regularizer(p._array, g)
             params_grads.append(((i, p), g))
@@ -216,7 +228,14 @@ class SGD(Optimizer):
 
 
 class Momentum(Optimizer):
-    """operators/optimizers/momentum_op.cc (+ use_nesterov)"""
+    """operators/optimizers/momentum_op.cc (+ use_nesterov).
+
+    The update runs through the fused pallas momentum/weight-decay
+    kernel (``ops/pallas/optimizer_update.py``) behind
+    ``FLAGS_use_fused_optimizer``: one VMEM pass, param/velocity updated
+    in place on TPU; the jnp fallback computes the identical expression
+    (bit-compatible), so eager and compiled steps agree everywhere.
+    """
 
     def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
                  use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
@@ -224,8 +243,33 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
+    def _fused_decay_coeff(self):
+        from ..flags import flag
+
+        # decay folds into the kernel only when it is a plain L2Decay
+        # and no grad clip exists (clipping must see the decayed grad —
+        # deferring decay past the clip would change numerics)
+        if (not flag("use_fused_optimizer") or self._grad_clip is not None
+                or type(self._weight_decay) is not L2Decay
+                or not self._weight_decay.coeff):
+            return None
+        return self._weight_decay.coeff
+
     def _apply_one(self, index, param, grad, lr):
+        from ..flags import flag
+
         vel = self._ensure_accumulator("velocity")
+        if flag("use_fused_optimizer"):
+            from ..ops.pallas import fused_momentum_update
+
+            wd = self._fused_decay_coeff() or 0.0
+            if wd and getattr(self._parameter_list[index], "regularizer",
+                              None) is not None:
+                wd = 0.0  # per-param regularizer already applied in step()
+            new_p, vel[index] = fused_momentum_update(
+                param, grad, vel[index], lr, momentum=self._momentum,
+                weight_decay=wd, use_nesterov=self._use_nesterov)
+            return new_p
         v = self._momentum * vel[index] + grad
         vel[index] = v
         if self._use_nesterov:

@@ -1,0 +1,26 @@
+"""Shared compiled-callable runtime.
+
+One module owns the lifecycle every compiled dispatch site used to
+re-implement: cache-key construction, the one-time AOT lower+compile
+(double-checked per-entry lock), CostRecord capture, the LRU-bounded
+executable cache, the donation-safe demote-to-jit fallback, and
+recompile/unexpected-compile accounting. ``static/executor.py``,
+``framework/jit.py`` (TrainStepFn), and the generation engine all
+dispatch through :class:`runtime.compiled.CompiledStore`, so a speed or
+correctness change here reaches every workload at once.
+"""
+from .compiled import (  # noqa: F401
+    CompiledEntry,
+    CompiledStore,
+    CompileWatch,
+    any_deleted,
+    cache_capacity,
+)
+
+__all__ = [
+    "CompiledEntry",
+    "CompiledStore",
+    "CompileWatch",
+    "any_deleted",
+    "cache_capacity",
+]

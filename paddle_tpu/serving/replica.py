@@ -19,65 +19,20 @@ import threading
 
 import numpy as np
 
-from ..errors import InvalidArgumentError, PreconditionNotMetError
+from ..errors import InvalidArgumentError
 from ..flags import flag
-from ..monitor import counter, histogram
+from ..monitor import histogram
 from ..monitor import flight_recorder as _flight
 from ..monitor import tracing as _tracing
 from ..profiler import RecordEvent, counters as _profiler_counters
+# CompileWatch now lives in the shared compiled-callable runtime (it is
+# the unexpected-compile half of the runtime's accounting); re-exported
+# here for the historical import path
+from ..runtime.compiled import CompileWatch  # noqa: F401
 
 __all__ = ["ReplicaPool", "CompileWatch", "predictor_input_specs"]
 
 _JIT_MISS = "executor::jit_cache_miss"
-
-
-class CompileWatch:
-    """Warmup-snapshot compile accounting, shared by the replica pool
-    and the continuous-batching generation worker.
-
-    ``arm()`` after warmup snapshots a compile counter (read through
-    ``read``); any later growth is an UNEXPECTED compile — the bounded-
-    compile invariant broke — counted loudly into ``metric`` plus a
-    flight-recorder event instead of silently re-growing the cache.
-    ``note()`` is an atomic read-compare-bump: N workers may observe the
-    same miss concurrently and it must count once.
-    """
-
-    def __init__(self, read, metric="serving/unexpected_compiles",
-                 event="serving_unexpected_compile"):
-        self._read = read
-        self._event = event
-        self._baseline = None
-        self._seen = 0
-        self._metric = counter(metric)
-        self._lock = threading.Lock()
-
-    def arm(self):
-        self._baseline = self._read()
-        self._seen = 0
-        return self
-
-    @property
-    def armed(self) -> bool:
-        return self._baseline is not None
-
-    def extra(self) -> int:
-        """Compiles since ``arm()`` — steady state must keep this 0."""
-        if self._baseline is None:
-            raise PreconditionNotMetError(
-                "extra_compiles() before warmup(): nothing to compare")
-        return self._read() - self._baseline
-
-    def note(self, **fields):
-        """Record any NEW growth since the last note (no-op when flat)."""
-        with self._lock:
-            extra = self.extra()
-            grew = extra - self._seen
-            if grew <= 0:
-                return
-            self._seen = extra
-            self._metric.inc(grew)
-            _flight.record_event(self._event, total=extra, **fields)
 
 
 def predictor_input_specs(predictor) -> dict:

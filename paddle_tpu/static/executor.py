@@ -34,9 +34,9 @@ from jax import lax
 
 from ..flags import flag, watch_flag
 from ..framework import random as _random
-from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
 from ..monitor import tracing as _tracing
+from ..runtime.compiled import CompiledStore
 from ..framework.place import Place, _default_place
 from ..framework.tensor import Tensor
 from ..ops.registry import kernel
@@ -270,18 +270,6 @@ def _sync_persistent_cache():
 # set_flags must take effect immediately — clearing the flag restores the
 # ambient jax cache config right away, not at the next jit-cache miss
 watch_flag("persistent_compile_cache_dir", lambda _v: _sync_persistent_cache())
-
-
-def _any_deleted(arrays) -> bool:
-    """Whether any array's buffer has been consumed (donation): decides
-    if a failed dispatch may be retried on the fallback path."""
-    for a in arrays:
-        try:
-            if a.is_deleted():
-                return True
-        except Exception:
-            continue
-    return False
 
 
 def _plan_key(program):
@@ -690,21 +678,43 @@ def _trace_block(program, block, op_list, feed_names, fetch_names,
 class Executor:
     """fluid.Executor equivalent. Two-level cache: a RunPlan per (program
     identity, version) holds the one-time op-walk analysis; compiled
-    jax.jit entries are keyed separately by (plan key, fetch/feed/persist
-    signature) so re-feeding new shapes recompiles without re-planning."""
+    executables are keyed separately by (plan key, fetch/feed/persist
+    signature) in the SHARED compiled-callable runtime
+    (:mod:`paddle_tpu.runtime.compiled`) so re-feeding new shapes
+    recompiles without re-planning — and so AOT compile, cost capture,
+    LRU bounding, and the donation-safe demote-to-jit fallback follow
+    the one policy every dispatch site shares."""
 
     def __init__(self, place: Place | None = None):
         self.place = place or _default_place()
-        self._cache = {}
-        self._cache_limit = 128  # compiled-block LRU bound
+        # the compiled-block cache: serving replica pools run one
+        # Executor from N worker threads (Predictor.clone shares it so
+        # compiles are shared) — the store's bookkeeping lock makes the
+        # LRU pop-and-reinsert safe while dispatch stays unlocked
+        # (concurrent device execution is the point of the pool)
+        self._compiled = CompiledStore(
+            "executor", cost_label="executor",
+            hit_counter="executor::jit_cache_hit",
+            miss_counter="executor::jit_cache_miss")
         self._plans = {}
         self._plan_cache_limit = 64  # RunPlan LRU bound
-        # serving replica pools run one Executor from N worker threads
-        # (Predictor.clone shares it so compiles are shared); the LRU
-        # pop-and-reinsert refreshes are not atomic, so cache BOOKKEEPING
-        # takes this lock. Dispatch itself stays outside it — concurrent
-        # device execution is the point of the pool.
-        self._cache_lock = threading.Lock()
+        self._cache_lock = threading.Lock()  # RunPlan bookkeeping
+
+    # legacy cache surface (tests and notebooks poke these): a LIVE
+    # mutable view of the entries (clear/del invalidate for real, so
+    # the historical force-a-recompile workflow still works) and the
+    # flag-governed LRU bound, both owned by the shared runtime store
+    @property
+    def _cache(self):
+        return self._compiled.mapping()
+
+    @property
+    def _cache_limit(self):
+        return self._compiled.capacity
+
+    @_cache_limit.setter
+    def _cache_limit(self, value):
+        self._compiled.capacity = value
 
     def _plan_for(self, program):
         """RunPlan cache lookup (LRU, counter-instrumented). Returns
@@ -777,57 +787,42 @@ class Executor:
                 tuple((tuple(a.shape), str(a.dtype)) for a in feed_arrays),
                 persist_in, donate_enabled,
             )
-        with self._cache_lock:
-            entry = self._cache.get(sig)
-            first_run = entry is None
-            if entry is None:
-                bump_counter("executor::jit_cache_miss")
-                _sync_persistent_cache()
-                # donate the persistables the program statically writes
-                # (params, optimizer state): XLA aliases each update into
-                # the input buffer. Read-only persistables are held
-                # undonated.
-                if donate_enabled:
-                    donate_names = tuple(
-                        n for n in persist_in if n in plan.written_names)
-                else:
-                    donate_names = ()
-                hold_names = tuple(
-                    n for n in persist_in if n not in donate_names)
-                traced = _trace_block(program, block, plan.op_list,
-                                      feed_names, fetch_names,
-                                      donate_names, hold_names)
-                jitted = jax.jit(
-                    traced, donate_argnums=(1,) if donate_names else ())
-                # [AOT executable, CostRecord, aot-attempted, per-entry
-                # lock]: filled on the first run (lower/compile once,
-                # cost-captured); a backend that rejects the AOT path
-                # leaves [None, None, True] and the entry dispatches
-                # through jax.jit forever after. The lock serializes the
-                # one-time compile across replica worker threads racing
-                # the same cold signature — without it both pay a full
-                # duplicated XLA compile (and double cost-capture).
-                entry = (jitted, donate_names, hold_names,
-                         [None, None, False, threading.Lock()])
-                self._cache[sig] = entry
-                # LRU-style eviction: a long-lived Executor fed many
-                # program versions (notebooks, unit-test loops) must not
-                # grow the cache unboundedly
-                while len(self._cache) > self._cache_limit:
-                    self._cache.pop(next(iter(self._cache)))
+        def _build():
+            _sync_persistent_cache()
+            # donation POLICY (shared flag semantics, one compile key):
+            # donate the persistables the program statically writes
+            # (params, optimizer state) — XLA aliases each update into
+            # the input buffer. Read-only persistables are held
+            # undonated.
+            if donate_enabled:
+                dn = tuple(
+                    n for n in persist_in if n in plan.written_names)
             else:
-                bump_counter("executor::jit_cache_hit")
-                self._cache[sig] = self._cache.pop(sig)  # refresh LRU
-        jitted, donate_names, hold_names, aot_slot = entry
+                dn = ()
+            hn = tuple(n for n in persist_in if n not in dn)
+            traced = _trace_block(program, block, plan.op_list,
+                                  feed_names, fetch_names, dn, hn)
+            jitted = jax.jit(
+                traced, donate_argnums=(1,) if dn else ())
+            return jitted, (dn, hn)
+
+        # the shared runtime owns the rest: LRU bookkeeping (thread-safe
+        # for replica pools), the double-checked one-time AOT compile with
+        # cost capture, and the donation-safe demote-to-jit fallback
+        entry, jit_disposition = self._compiled.get_or_build(sig, _build)
+        donate_names, hold_names = entry.meta
+        first_run = jit_disposition == "miss"
 
         # flight-recorder breadcrumb: which program ran, and whether the
         # caches served it — a post-mortem can see a retrace storm (jit
-        # misses racing run counts) or an unexpected re-plan at a glance
+        # misses racing run counts) or an unexpected re-plan at a glance.
+        # cache_key is the shared runtime identity the CostRecord ledger
+        # and /tracez cite for the same dispatch.
         program_id = f"{plan.key[0]}@v{plan.key[1]}"
         _flight.record_event(
             "executor_run_begin", program=program_id,
-            plan_cache=plan_disposition,
-            jit_cache="miss" if first_run else "hit",
+            plan_cache=plan_disposition, jit_cache=jit_disposition,
+            cache_key=entry.cache_key,
             feeds=len(feed_names), fetches=len(fetch_names),
             donated=len(donate_names))
         # a serving dispatch (or any traced caller) sees compile-vs-
@@ -836,7 +831,7 @@ class Executor:
         # a trace — one contextvar read)
         _tracing.annotate(
             program=program_id, plan_cache=plan_disposition,
-            jit_cache="miss" if first_run else "hit")
+            jit_cache=jit_disposition, cache_key=entry.cache_key)
 
         donated = [scope.get(n) for n in donate_names]
         held = [scope.get(n) for n in hold_names]
@@ -855,46 +850,10 @@ class Executor:
                          else RecordEvent("executor::dispatch"))
         try:
             with RecordEvent(phase), compile_span, dispatch_span:
-                if not aot_slot[2]:
-                    # one-time AOT lower+compile (the same work jax.jit's
-                    # first call would do) so the compiled module's own
-                    # cost_analysis/memory_analysis land in the cost-model
-                    # registry — utilization from what XLA actually built,
-                    # not an estimate. Double-checked under the per-entry
-                    # lock: a second worker on the same cold signature
-                    # waits for the executable instead of recompiling.
-                    with aot_slot[3]:
-                        if not aot_slot[2]:
-                            try:
-                                lowered = jitted.lower(
-                                    feed_arrays, donated, held, base_key)
-                                aot_slot[0] = lowered.compile()
-                                aot_slot[1] = _cost.capture(
-                                    "executor", lowered=lowered,
-                                    compiled=aot_slot[0], key=sig,
-                                    program=program_id)
-                            except Exception:
-                                aot_slot[0] = None  # no AOT: jit path
-                            aot_slot[2] = True
-                runner = aot_slot[0] if aot_slot[0] is not None else jitted
-                try:
-                    fetches, donated_out, extra = runner(
-                        feed_arrays, donated, held, base_key)
-                except Exception:
-                    # the AOT executable is stricter than jax.jit (an
-                    # aval/layout drift raises where jit would silently
-                    # recompile): demote this entry to the jit path and
-                    # retry — but never after a donation consumed buffers
-                    if runner is jitted or _any_deleted(donated):
-                        raise
-                    # drop the cost record too: jax.jit recompiles for
-                    # the drifted avals, so the captured numbers no
-                    # longer describe what runs — crediting them would
-                    # silently corrupt the MFU ledger
-                    aot_slot[0] = None
-                    aot_slot[1] = None
-                    fetches, donated_out, extra = jitted(
-                        feed_arrays, donated, held, base_key)
+                fetches, donated_out, extra = self._compiled.dispatch(
+                    entry, feed_arrays, donated, held, base_key,
+                    donated=donated,
+                    capture_meta={"program": program_id})
         except Exception as e:
             _flight.record_event(
                 "executor_run_error", program=program_id,
@@ -913,14 +872,8 @@ class Executor:
                 head = e.args[0] if e.args else ""
                 e.args = (f"{head}\n  {note}",) + tuple(e.args[1:])
             raise
-        # executed-work ledger: this run dispatched the captured program
-        # once (feeds the MFU window math; None record is a free no-op)
-        _cost.note_run(aot_slot[1])
-        if aot_slot[1] is not None:
-            # the cost sheet makes the trace self-contained: a /tracez
-            # reader sees what the dispatch COST, not just how long
-            _tracing.annotate(flops=aot_slot[1].flops,
-                              cost_bytes=aot_slot[1].bytes_accessed)
+        # (the executed-work ledger bump and the trace's flops/cache_key
+        # annotation happened inside the shared runtime's dispatch)
         if donate_names:
             bump_counter("executor::donated_buffers", len(donate_names))
             # a fetch may share its buffer with a value the scope holds and

@@ -1,0 +1,418 @@
+"""Fused pallas kernels (optimizer update, layernorm+residual) and the
+overlapped device prefetcher.
+
+The pallas paths are gated to TPU, so the CPU suite certifies them two
+ways: interpret-mode pallas vs the jnp reference (the kernels' math is
+right, including the masked row tails), and flag-on vs flag-off parity
+through the REAL call sites (Momentum, the post-norm transformer) — the
+jnp fallback computes the identical primitive sequence, so enabling the
+flags must never change numerics anywhere.
+"""
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as popt
+from paddle_tpu.flags import set_flags
+from paddle_tpu.framework.tensor import to_tensor
+
+# the package re-exports shadow the submodule names; reach the modules
+from paddle_tpu.ops.pallas import optimizer_update as _  # noqa: F401
+from paddle_tpu.ops.pallas import layernorm_residual as _  # noqa: F401
+
+ou = sys.modules["paddle_tpu.ops.pallas.optimizer_update"]
+lnr = sys.modules["paddle_tpu.ops.pallas.layernorm_residual"]
+
+
+@pytest.fixture
+def _flags_restored():
+    yield
+    set_flags({"use_fused_optimizer": True, "use_fused_layernorm": True,
+               "io_prefetch_overlap": True})
+
+
+# -- fused momentum update ----------------------------------------------------
+
+
+@pytest.mark.parametrize("nesterov", [False, True])
+@pytest.mark.parametrize("wd", [0.0, 0.01])
+def test_momentum_kernel_interpret_parity(nesterov, wd):
+    """Pallas (interpret) == jnp reference, including a size that needs
+    lane padding (1000*130 is no multiple of 8*128)."""
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(1000, 130).astype("f4"))
+    g = jnp.asarray(rng.randn(1000, 130).astype("f4"))
+    v = jnp.asarray(rng.randn(1000, 130).astype("f4"))
+    ref_p, ref_v = ou._jnp_update(p, g, v, 0.1, 0.9, wd, nesterov)
+    out_p, out_v = ou._pallas_update(p, g, v, 0.1, 0.9, wd, nesterov,
+                                     interpret=True)
+    np.testing.assert_allclose(np.asarray(ref_p), np.asarray(out_p),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ref_v), np.asarray(out_v),
+                               rtol=1e-6, atol=1e-6)
+
+
+def _momentum_net_steps(steps=4, **mom_kw):
+    paddle.seed(7)
+    net = nn.Linear(16, 4)
+    opt = popt.Momentum(learning_rate=0.05, momentum=0.9,
+                        parameters=net.parameters(), **mom_kw)
+    rng = np.random.RandomState(1)
+    X = to_tensor(rng.randn(8, 16).astype("f4"))
+    Y = to_tensor(rng.randn(8, 4).astype("f4"))
+    for _ in range(steps):
+        loss = F.mse_loss(net(X), Y).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return [np.asarray(p) for p in net.parameters()]
+
+
+@pytest.mark.parametrize("mom_kw", [
+    {}, {"weight_decay": 0.01}, {"use_nesterov": True},
+    {"weight_decay": 0.02, "use_nesterov": True},
+])
+def test_momentum_fused_flag_is_numerically_free(mom_kw, _flags_restored):
+    """Flag on vs off: bit-compatible through the real optimizer (the
+    fused jnp fallback is the same expression in the same order)."""
+    set_flags({"use_fused_optimizer": True})
+    fused = _momentum_net_steps(**mom_kw)
+    set_flags({"use_fused_optimizer": False})
+    unfused = _momentum_net_steps(**mom_kw)
+    for a, b in zip(fused, unfused):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_momentum_fused_with_grad_clip_keeps_decay_before_clip(
+        _flags_restored):
+    """grad_clip must see the DECAYED grad: the fused-wd fold is
+    disabled under clipping and parity still holds."""
+    kw = {"weight_decay": 0.05,
+          "grad_clip": popt.ClipGradByGlobalNorm(0.5)}
+    set_flags({"use_fused_optimizer": True})
+    fused = _momentum_net_steps(**kw)
+    set_flags({"use_fused_optimizer": False})
+    unfused = _momentum_net_steps(**kw)
+    for a, b in zip(fused, unfused):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_momentum_fused_inside_compiled_train_step(_flags_restored):
+    """The fused update traces into TrainStepFn: same loss trajectory
+    with the flag on and off (the ResNet bench's configuration)."""
+    from paddle_tpu.framework import jit as fjit
+
+    def run():
+        paddle.seed(3)
+        net = nn.Linear(12, 3)
+        opt = popt.Momentum(learning_rate=0.1, momentum=0.9,
+                            weight_decay=0.01,
+                            parameters=net.parameters())
+        step = fjit.train_step(
+            net, opt, lambda m, x, y: F.mse_loss(m(x), y).mean())
+        rng = np.random.RandomState(0)
+        X = rng.randn(8, 12).astype("f4")
+        Y = rng.randn(8, 3).astype("f4")
+        return [float(np.asarray(step(X, Y)["loss"])) for _ in range(5)]
+
+    set_flags({"use_fused_optimizer": True})
+    fused = run()
+    set_flags({"use_fused_optimizer": False})
+    unfused = run()
+    np.testing.assert_allclose(fused, unfused, rtol=1e-6)
+    assert fused[-1] < fused[0]  # it actually trains
+
+
+# -- fused layernorm + residual ----------------------------------------------
+
+
+def test_layernorm_residual_interpret_parity_fwd_bwd():
+    """Pallas (interpret) forward AND backward == the jnp reference,
+    with a row count that exercises the masked tail tile."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(37, 256).astype("f4"))
+    r = jnp.asarray(rng.randn(37, 256).astype("f4"))
+    w = jnp.asarray(rng.randn(256).astype("f4"))
+    b = jnp.asarray(rng.randn(256).astype("f4"))
+    eps = 1e-5
+    ref = lnr._reference(x, r, w, b, eps)
+    y, mean, rstd = lnr._pallas_fwd(x, r, w, b, eps, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+    dy = jnp.asarray(rng.randn(37, 256).astype("f4"))
+    _, vjp = jax.vjp(lambda x, r, w, b: lnr._reference(x, r, w, b, eps),
+                     x, r, w, b)
+    dx_ref, dr_ref, dw_ref, db_ref = vjp(dy)
+    da, dw, db = lnr._pallas_bwd(x, r, w, mean, rstd, dy, interpret=True)
+    np.testing.assert_allclose(np.asarray(dx_ref), np.asarray(da),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dr_ref), np.asarray(da),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(dw_ref), np.asarray(dw),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(db_ref), np.asarray(db),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_layernorm_residual_bf16_parity_within_ulp():
+    """bf16 parity: the kernel expresses the residual add in the INPUT
+    dtype (same expression as the unfused path), so fused and unfused
+    agree to bf16 rounding noise. Bit-exactness is NOT achievable even
+    between the unfused path's own jitted and eager forms — XLA keeps
+    or drops the bf16 rounding of fused intermediates per fusion
+    decision — so 1-ulp agreement is the contract, like AMP's."""
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(16, 128).astype("f4")).astype(jnp.bfloat16)
+    r = jnp.asarray(rng.randn(16, 128).astype("f4")).astype(jnp.bfloat16)
+    w = jnp.asarray(rng.randn(128).astype("f4"))
+    b = jnp.asarray(rng.randn(128).astype("f4"))
+    ref = lnr._reference(x, r, w, b, 1e-5)
+    y, mean, rstd = lnr._pallas_fwd(x, r, w, b, 1e-5, interpret=True)
+    assert y.dtype == jnp.bfloat16
+    yf = np.asarray(y, np.float32)
+    rf = np.asarray(ref, np.float32)
+    # the bound is the bf16 ulp of the PRE-normalization sum propagated
+    # through the affine: ulp(|a|_row) * rstd_row * |w| (+ one output
+    # rounding) — near-zero outputs legitimately carry the full input
+    # rounding, so an output-relative bound would be wrong
+    a = np.asarray((x + r).astype(jnp.float32))
+    ulp_in = 2.0 ** -8 * np.abs(a).max(axis=-1, keepdims=True)
+    bound = (2.0 * ulp_in * np.asarray(rstd) * (np.abs(np.asarray(w)) + 1.0)
+             + 2.0 ** -8 * np.abs(rf))
+    d = np.abs(yf - rf)
+    assert np.all(d <= bound), (d.max(), (d - bound).max())
+
+
+def test_layernorm_block_rows_scale_with_h(monkeypatch):
+    """Row blocks shrink as H grows so the bwd kernel's live blocks fit
+    VMEM; _supported rejects H past the floor's budget."""
+    assert lnr._block_rows(1024, 2048) == 256  # historical tiling kept
+    assert lnr._block_rows(1024, 4096) == 128
+    assert lnr._block_rows(1024, 8192) == 64
+    assert lnr._block_rows(1024, 16384) == 32
+    assert lnr._block_rows(4, 256) == 4  # tiny inputs: one short tile
+    monkeypatch.setattr(lnr, "on_tpu_platform", lambda: True)
+    ok = jnp.zeros((2, lnr._MAX_H), jnp.float32)
+    wok = jnp.zeros((lnr._MAX_H,), jnp.float32)
+    assert lnr._supported(ok, wok, wok)
+    big = jnp.zeros((2, lnr._MAX_H * 2), jnp.float32)
+    wbig = jnp.zeros((lnr._MAX_H * 2,), jnp.float32)
+    assert not lnr._supported(big, wbig, wbig)
+
+
+def test_layernorm_residual_tensor_autograd_matches_unfused():
+    """Tensor-level fused op == norm(residual + y), forward and grads
+    (through the framework op tape)."""
+    from paddle_tpu.ops.pallas import layernorm_residual
+
+    rng = np.random.RandomState(2)
+    ln = nn.LayerNorm(64)
+    x = to_tensor(rng.randn(5, 7, 64).astype("f4"), stop_gradient=False)
+    r = to_tensor(rng.randn(5, 7, 64).astype("f4"), stop_gradient=False)
+
+    out_f = layernorm_residual(x, r, ln.weight, ln.bias, ln.epsilon)
+    out_f.sum().backward()
+    gx_f, gr_f = np.asarray(x.grad), np.asarray(r.grad)
+    gw_f = np.asarray(ln.weight.grad)
+    x.clear_grad(), r.clear_grad(), ln.weight.clear_grad()
+
+    out_u = ln(r + x)
+    out_u.sum().backward()
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_u),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(gx_f, np.asarray(x.grad),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gr_f, np.asarray(r.grad),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(gw_f, np.asarray(ln.weight.grad),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_post_norm_encoder_layer_flag_parity(_flags_restored):
+    """The post-norm TransformerEncoderLayer routes its residual+norm
+    pairs through the fused op — flag on/off outputs are identical."""
+    def run():
+        paddle.seed(11)
+        layer = nn.TransformerEncoderLayer(
+            64, 4, 128, dropout=0.0, normalize_before=False)
+        layer.eval()
+        x = to_tensor(np.random.RandomState(5)
+                      .randn(2, 9, 64).astype("f4"))
+        return np.asarray(layer(x))
+
+    set_flags({"use_fused_layernorm": True})
+    fused = run()
+    set_flags({"use_fused_layernorm": False})
+    unfused = run()
+    np.testing.assert_allclose(fused, unfused, rtol=1e-6, atol=1e-6)
+
+
+def test_pre_norm_layer_unaffected_by_flag(_flags_restored):
+    """normalize_before=True has no add+norm pair to fuse: both flag
+    states run the identical pre-norm graph."""
+    def run():
+        paddle.seed(12)
+        layer = nn.TransformerEncoderLayer(
+            32, 2, 64, dropout=0.0, normalize_before=True)
+        layer.eval()
+        x = to_tensor(np.random.RandomState(6)
+                      .randn(2, 5, 32).astype("f4"))
+        return np.asarray(layer(x))
+
+    set_flags({"use_fused_layernorm": True})
+    a = run()
+    set_flags({"use_fused_layernorm": False})
+    b = run()
+    np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+
+# -- overlapped device prefetch ----------------------------------------------
+
+
+def _slow_source(n, delay_s):
+    for i in range(n):
+        time.sleep(delay_s)
+        yield np.full((4, 4), i, np.float32)
+
+
+def _drive(n, source_delay, step_delay):
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+
+    pf = _DevicePrefetcher(_slow_source(n, source_delay), depth=2,
+                           to_device=True)
+    seen = []
+    t0 = time.perf_counter()
+    for batch in pf:
+        time.sleep(step_delay)  # the consumer's "compute"
+        seen.append(int(np.asarray(batch)[0, 0]))
+    return seen, time.perf_counter() - t0
+
+
+def test_prefetch_overlap_delivers_all_batches_in_order(_flags_restored):
+    set_flags({"io_prefetch_overlap": True})
+    seen, _ = _drive(6, 0.0, 0.0)
+    assert seen == list(range(6))
+    set_flags({"io_prefetch_overlap": False})
+    seen, _ = _drive(6, 0.0, 0.0)
+    assert seen == list(range(6))
+
+
+@pytest.mark.slow
+def test_prefetch_overlap_hides_source_latency(_flags_restored):
+    """With overlap the producer works during the consumer's step, so
+    the loop approaches max(source, step) per batch; the synchronous
+    path pays source + step. Generous margins for a loaded box."""
+    n, src, step = 6, 0.03, 0.03
+    set_flags({"io_prefetch_overlap": False})
+    seen_s, sync_wall = _drive(n, src, step)
+    set_flags({"io_prefetch_overlap": True})
+    seen_o, overlap_wall = _drive(n, src, step)
+    assert seen_s == seen_o == list(range(n))
+    assert overlap_wall < sync_wall * 0.85, (overlap_wall, sync_wall)
+
+
+def test_prefetch_propagates_source_errors(_flags_restored):
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+
+    def bad():
+        yield np.zeros((2, 2), np.float32)
+        raise ValueError("parse failure")
+
+    set_flags({"io_prefetch_overlap": True})
+    pf = _DevicePrefetcher(bad(), depth=2, to_device=True)
+    next(pf)
+    with pytest.raises(ValueError, match="parse failure"):
+        next(pf)
+
+
+def test_prefetch_abandoned_iterator_does_not_leak_thread(_flags_restored):
+    """Dropping the iterator mid-epoch must let the fill thread exit:
+    the thread closes only over (it, q, stop) — never the prefetcher —
+    so GC can collect it and the finalizer stops the loop."""
+    import gc
+
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+
+    set_flags({"io_prefetch_overlap": True})
+    pf = _DevicePrefetcher(_slow_source(100, 0.0), depth=2, to_device=True)
+    next(pf)
+    del pf
+    gc.collect()
+    deadline = time.perf_counter() + 2.0
+    while time.perf_counter() < deadline:
+        alive = [t for t in threading.enumerate()
+                 if t.name == "ptpu-h2d-prefetch" and t.is_alive()]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, "abandoned prefetch thread still running"
+
+
+def test_prefetch_exhaustion_and_error_are_terminal(_flags_restored):
+    """Iterator protocol on the overlap path: once exhausted (or after
+    the source's error has been raised) every later next() raises
+    StopIteration immediately instead of blocking on an empty queue."""
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+
+    set_flags({"io_prefetch_overlap": True})
+    pf = _DevicePrefetcher(_slow_source(1, 0.0), depth=2, to_device=True)
+    next(pf)
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(pf)
+
+    def bad():
+        yield np.zeros((2, 2), np.float32)
+        raise ValueError("boom")
+
+    pf = _DevicePrefetcher(bad(), depth=2, to_device=True)
+    next(pf)
+    with pytest.raises(ValueError, match="boom"):
+        next(pf)
+    for _ in range(3):
+        with pytest.raises(StopIteration):
+            next(pf)
+
+
+def test_prefetch_close_then_iterate_terminates(_flags_restored):
+    """close() mid-consumption must end iteration, not deadlock: the
+    fill thread refuses every post-stop put (including its DONE tail),
+    so the consumer's queue wait has to treat stop+empty as terminal.
+    Batches already enqueued still drain first."""
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+
+    set_flags({"io_prefetch_overlap": True})
+    pf = _DevicePrefetcher(_slow_source(50, 0.0), depth=2, to_device=True)
+    next(pf)
+    pf.close()
+    got, deadline = 0, time.perf_counter() + 5.0
+    try:
+        while time.perf_counter() < deadline:
+            next(pf)
+            got += 1
+    except StopIteration:
+        pass
+    else:
+        pytest.fail("close()d prefetcher never raised StopIteration")
+    assert got <= 3  # at most the buffered depth drains
+    with pytest.raises(StopIteration):
+        next(pf)  # and it stays terminal
+
+
+def test_prefetch_accounts_input_wait(_flags_restored):
+    from paddle_tpu.monitor import registry as _reg
+
+    set_flags({"io_prefetch_overlap": True})
+    g = _reg.gauge("io/input_wait_ms")
+    before = g.value
+    _drive(3, 0.005, 0.0)
+    assert g.value >= before  # the pop wait feeds the monitor's ratio

@@ -22,6 +22,8 @@
 #             resume resharded at a new world size, identical loss curve)
 #           + tracez smoke (distributed tracing: one trace across
 #             router->backend processes, tail retention of deadline+retry)
+#           + kernel smoke (fused pallas kernels: numeric parity,
+#             bounded compiles, prefetch-overlap input-wait drop)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -103,6 +105,10 @@ case "$MODE" in
     # process hop with queue/dispatch stage spans, deadline-missed and
     # retried traces retained while the fast-path bulk is dropped
     JAX_PLATFORMS=cpu python tools/tracez_smoke.py
+    # kernel smoke: fused optimizer-update + layernorm/residual numeric
+    # parity (pallas interpret vs jnp, flag on/off through real call
+    # sites), one-compile steady state, prefetch-overlap input-wait drop
+    JAX_PLATFORMS=cpu python tools/kernel_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""CI smoke for the fused pallas kernels + shared compiled runtime
+(`make kernel-smoke`).
+
+Asserts the three contracts the fused-kernel work rests on:
+
+1. **Numeric parity** — the fused momentum/weight-decay update and the
+   fused residual+layernorm produce the SAME numbers as the unfused op
+   chains, both at the kernel level (pallas interpret mode vs the jnp
+   reference, exercising the masked row tails) and through the real
+   call sites (Momentum inside a compiled train step, the post-norm
+   transformer layer) with the flags flipped.
+2. **Zero extra compiles after warmup** — a steady-state compiled train
+   loop with the fused kernels on pays exactly ONE executable through
+   the shared runtime store (``train_step::exec_cache_miss == 1``, no
+   later misses, no cache evictions at the default capacity).
+3. **Overlap** — the double-buffered device prefetcher drops the
+   monitor's input-wait ratio vs the synchronous refill on the same
+   slow source.
+
+Exit 0 on success. Only the overlap check involves timing, with a wide
+margin; everything else is exact.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _kernel_parity():
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import pallas as _pk  # noqa: F401 (bind modules)
+
+    ou = sys.modules["paddle_tpu.ops.pallas.optimizer_update"]
+    lnr = sys.modules["paddle_tpu.ops.pallas.layernorm_residual"]
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(700, 130).astype("f4"))  # needs padding
+    g = jnp.asarray(rng.randn(700, 130).astype("f4"))
+    v = jnp.asarray(rng.randn(700, 130).astype("f4"))
+    for nesterov in (False, True):
+        ref = ou._jnp_update(p, g, v, 0.1, 0.9, 0.01, nesterov)
+        out = ou._pallas_update(p, g, v, 0.1, 0.9, 0.01, nesterov,
+                                interpret=True)
+        for a, b in zip(ref, out):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6, atol=1e-6)
+
+    x = jnp.asarray(rng.randn(37, 256).astype("f4"))  # tail tile
+    r = jnp.asarray(rng.randn(37, 256).astype("f4"))
+    w = jnp.asarray(rng.randn(256).astype("f4"))
+    b = jnp.asarray(rng.randn(256).astype("f4"))
+    ref = lnr._reference(x, r, w, b, 1e-5)
+    y, mean, rstd = lnr._pallas_fwd(x, r, w, b, 1e-5, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(y),
+                               rtol=1e-5, atol=1e-5)
+    dy = jnp.asarray(rng.randn(37, 256).astype("f4"))
+    _, vjp = jax.vjp(lambda x, r, w, b: lnr._reference(x, r, w, b, 1e-5),
+                     x, r, w, b)
+    refs = vjp(dy)
+    da, dw, db = lnr._pallas_bwd(x, r, w, mean, rstd, dy, interpret=True)
+    for a, b_ in zip(refs, (da, da, dw, db)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)
+    print("kernel parity OK (pallas interpret == jnp reference)")
+
+
+def _train_parity_and_bounded_compiles():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu import profiler
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.framework import jit as fjit
+
+    def losses(fused):
+        set_flags({"use_fused_optimizer": fused,
+                   "use_fused_layernorm": fused})
+        paddle.seed(9)
+        net = nn.TransformerEncoderLayer(64, 4, 128, dropout=0.0,
+                                         normalize_before=False)
+        opt = popt.Momentum(learning_rate=0.02, momentum=0.9,
+                            weight_decay=1e-4,
+                            parameters=net.parameters())
+
+        def loss_fn(m, x):
+            return (m(x) ** 2).mean()
+
+        step = fjit.train_step(net, opt, loss_fn)
+        rng = np.random.RandomState(3)
+        X = rng.randn(4, 9, 64).astype("f4")
+        return [float(np.asarray(step(X)["loss"])) for _ in range(6)]
+
+    try:
+        fused = losses(True)
+        profiler.reset_counters()
+        # steady state with fused kernels: ONE executable, zero evictions
+        set_flags({"use_fused_optimizer": True,
+                   "use_fused_layernorm": True})
+        paddle.seed(9)
+        net = nn.Linear(32, 8)
+        opt = popt.Momentum(learning_rate=0.05, momentum=0.9,
+                            weight_decay=1e-4,
+                            parameters=net.parameters())
+        step = fjit.train_step(
+            net, opt, lambda m, x, y: F.mse_loss(m(x), y).mean())
+        rng = np.random.RandomState(1)
+        X, Y = rng.randn(8, 32).astype("f4"), rng.randn(8, 8).astype("f4")
+        for _ in range(12):
+            step(X, Y)
+        c = profiler.counters()
+        assert c.get("train_step::exec_cache_miss", 0) == 1, c
+        assert c.get("train_step::exec_cache_hit", 0) == 11, c
+        assert "train_step::cache_evict" not in c, c
+        unfused = losses(False)
+    finally:
+        set_flags({"use_fused_optimizer": True,
+                   "use_fused_layernorm": True})
+    np.testing.assert_allclose(fused, unfused, rtol=1e-6)
+    assert fused[-1] < fused[0], "the fused loop must still train"
+    print("train parity OK; warmup = 1 compile, 0 extra, 0 evictions")
+
+
+def _overlap():
+    from paddle_tpu.flags import set_flags
+    from paddle_tpu.io.dataloader import _DevicePrefetcher
+    from paddle_tpu.monitor import registry as _reg
+
+    def drive(overlap):
+        def source():
+            for i in range(20):
+                time.sleep(0.003)
+                yield np.full((8, 8), i, np.float32)
+
+        set_flags({"io_prefetch_overlap": overlap})
+        gauge = _reg.gauge("io/input_wait_ms")
+        w0 = gauge.value
+        t0 = time.perf_counter()
+        n = 0
+        for _ in _DevicePrefetcher(source(), depth=2, to_device=True):
+            time.sleep(0.003)
+            n += 1
+        wall = time.perf_counter() - t0
+        assert n == 20
+        return (gauge.value - w0) / (wall * 1e3)
+
+    try:
+        ratio_sync = drive(False)
+        ratio_overlap = drive(True)
+    finally:
+        set_flags({"io_prefetch_overlap": True})
+    assert ratio_overlap < ratio_sync, (ratio_sync, ratio_overlap)
+    print(f"overlap OK: input_wait_ratio {ratio_sync:.3f} -> "
+          f"{ratio_overlap:.3f}")
+
+
+def main():
+    _kernel_parity()
+    _train_parity_and_bounded_compiles()
+    _overlap()
+    print("kernel smoke OK")
+
+
+if __name__ == "__main__":
+    main()

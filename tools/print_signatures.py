@@ -47,6 +47,7 @@ MODULES = [
     "paddle_tpu.device",
     "paddle_tpu.reader",
     "paddle_tpu.nets",
+    "paddle_tpu.runtime",
 ]
 
 
