@@ -592,11 +592,18 @@ def bench_serving_throughput(requests=120, rows_cycle=(1, 2, 3, 4),
     requests/sec per level, mean batch fill, p50/p99 end-to-end latency
     from the serving histograms, and the compile accounting (bounded at
     the bucket-ladder length, asserted).
+
+    fp32-vs-int8 sub-metric: the same model is PTQ-calibrated, saved
+    through ``save_int8_model`` and driven through the same sequential
+    steady-state loop — reporting int8 requests/sec, the speed ratio,
+    and the max output delta vs the fp32 program (the accuracy half of
+    the cost-per-token tradeoff; on the CPU smoke the speedup is noise,
+    on TPU the int8 HBM/MXU savings are the point).
     """
     import tempfile
 
     import paddle_tpu.static as static
-    from paddle_tpu import monitor, profiler
+    from paddle_tpu import monitor, profiler, slim
     from paddle_tpu.inference import Config, create_predictor
     from paddle_tpu.monitor import histogram_quantile
     from paddle_tpu.serving import DynamicBatcher, ReplicaPool
@@ -613,9 +620,20 @@ def bench_serving_throughput(requests=120, rows_cycle=(1, 2, 3, 4),
         exe.run_startup()
         model_dir = tempfile.mkdtemp(prefix="ptpu_bench_serve_")
         static.save_inference_model(model_dir, ["x"], [y], exe)
+        # int8 twin of the same program: calibrate on the request
+        # distribution, fold the scales into a deployable int8 save
+        rng_cal = np.random.RandomState(7)
+        calib = [{"x": rng_cal.randn(8, 64).astype("float32")}
+                 for _ in range(4)]
+        ptq = slim.PostTrainingQuantization(exe, static
+                                            .default_main_program(), calib)
+        ptq.quantize()
+        int8_dir = tempfile.mkdtemp(prefix="ptpu_bench_serve_int8_")
+        ptq.save_int8_model(int8_dir, ["x"], [y])
     finally:
         static.disable_static()
         static.reset_default_programs()
+        static.global_scope().clear()
     pred = create_predictor(Config(model_dir))
 
     rng = np.random.RandomState(0)
@@ -626,9 +644,23 @@ def bench_serving_throughput(requests=120, rows_cycle=(1, 2, 3, 4),
     for r in sorted(set(rows_cycle)):
         pred.run([rng.randn(r, 64).astype("float32")])
     t0 = time.perf_counter()
+    fp32_outs = []
     for a in reqs:
-        np.asarray(pred.run([a])[0])
+        fp32_outs.append(np.asarray(pred.run([a])[0]))
     seq_rps = requests / (time.perf_counter() - t0)
+
+    # -- int8 A/B on the same loop ----------------------------------------
+    pred8 = create_predictor(Config(int8_dir))
+    for r in sorted(set(rows_cycle)):
+        pred8.run([rng.randn(r, 64).astype("float32")])
+    t0 = time.perf_counter()
+    int8_outs = []
+    for a in reqs:
+        int8_outs.append(np.asarray(pred8.run([a])[0]))
+    int8_rps = requests / (time.perf_counter() - t0)
+    out_scale = max(np.abs(o).max() for o in fp32_outs)
+    max_delta = max(np.abs(a - b).max()
+                    for a, b in zip(fp32_outs, int8_outs))
 
     # -- batched path through the serving stack ---------------------------
     import threading
@@ -675,6 +707,13 @@ def bench_serving_throughput(requests=120, rows_cycle=(1, 2, 3, 4),
             "unit": "requests/sec",
             "sequential_req_per_sec": round(seq_rps, 1),
             "speedup_vs_sequential": round(best / seq_rps, 3),
+            "int8_ab": {
+                "int8_req_per_sec": round(int8_rps, 1),
+                "int8_vs_fp32": round(int8_rps / seq_rps, 3),
+                "max_output_delta": round(float(max_delta), 6),
+                "max_output_delta_rel": round(
+                    float(max_delta / out_scale), 6),
+            },
             "offered_load_sweep": sweep,
             "mean_batch_fill": round(rows_done / slots, 4) if slots else 0.0,
             "p50_ms": round(histogram_quantile(h_e2e, 0.5), 3),
@@ -944,6 +983,13 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
     latency, the continuous/static speedup, compile accounting (exactly
     len(prefill ladder) + 1 programs), and decode MFU from the
     cost-model ledger.
+
+    KV-cache economics sub-metric: the same sweep re-runs on an int8-KV
+    engine (``FLAGS_generation_kv_cache_dtype=int8`` semantics) over the
+    same weights — reporting ``kv_bytes_per_token`` per mode and
+    ``slots_at_equal_hbm`` (how many int8 slots the fp32 cache's HBM
+    buys, measured on the real cache arrays), the capacity multiplier
+    decode capacity is bound by.
     """
     import paddle_tpu as paddle
     from paddle_tpu import monitor, profiler
@@ -977,7 +1023,7 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
     # static admits a new group only once EVERY slot has drained
     from collections import deque
 
-    def drive(continuous):
+    def drive(eng, continuous):
         pending = deque(zip(prompts, budgets))
         active = {}
         last = np.zeros(slots, np.int32)
@@ -990,7 +1036,7 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
             while can_admit and pending and len(active) < slots:
                 free = next(s for s in range(slots) if s not in active)
                 p, b = pending.popleft()
-                tok = engine.admit(free, p)
+                tok = eng.admit(free, p)
                 done_tokens += 1
                 if b <= 1:
                     continue
@@ -998,7 +1044,7 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
                 last[free] = tok
             if not active:
                 continue
-            nxt = engine.step(last, temps)
+            nxt = eng.step(last, temps)
             steps += 1
             for s in list(active):
                 done_tokens += 1
@@ -1011,12 +1057,26 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
 
     flops0 = monitor.registry_snapshot().get(
         "cost/executed_flops", {}).get("value", 0.0)
-    static_tokens, static_steps, static_dt = drive(continuous=False)
-    cont_tokens, cont_steps, cont_dt = drive(continuous=True)
+    static_tokens, static_steps, static_dt = drive(engine, continuous=False)
+    cont_tokens, cont_steps, cont_dt = drive(engine, continuous=True)
     executed = (monitor.registry_snapshot().get(
         "cost/executed_flops", {}).get("value", 0.0) - flops0)
     assert static_tokens == cont_tokens, "both modes decode the sweep"
     extra = engine.extra_compiles()
+
+    # -- int8 KV cache on the same sweep (after the fp32 accounting
+    # closes: the int8 engine's own warmup compiles and drive FLOPs must
+    # not pollute the fp32 row's extra-compile/MFU numbers) -------------
+    fp32_cache_bytes = engine.cache_nbytes()
+    engine8 = GenerationEngine(model, slots=slots, cache_len=cache_len,
+                               prefill_buckets=prefill_buckets,
+                               kv_cache_dtype="int8")
+    engine8.warmup()
+    int8_tokens, int8_steps, int8_dt = drive(engine8, continuous=True)
+    assert int8_tokens == cont_tokens, "int8 KV decodes the same sweep"
+    assert engine8.extra_compiles() == 0, "int8 decode stays compile-bound"
+    int8_cache_bytes = engine8.cache_nbytes()
+    slots_at_equal_hbm = int(slots * fp32_cache_bytes / int8_cache_bytes)
     peaks = _cost.device_peaks()
     cont_tps = cont_tokens / cont_dt
     static_tps = static_tokens / static_dt
@@ -1038,6 +1098,16 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
             "ms_per_token": round(1e3 * static_dt / static_tokens, 3),
         },
         "speedup_continuous_vs_static": round(cont_tps / static_tps, 3),
+        "kv_cache": {
+            "fp32_bytes_per_token": engine.kv_bytes_per_token(),
+            "int8_bytes_per_token": engine8.kv_bytes_per_token(),
+            "fp32_cache_bytes": fp32_cache_bytes,
+            "int8_cache_bytes": int8_cache_bytes,
+            "slots_at_equal_hbm": slots_at_equal_hbm,
+            "int8_tokens_per_sec": round(int8_tokens / int8_dt, 1),
+            "int8_vs_fp32_tokens_per_sec": round(
+                (int8_tokens / int8_dt) / cont_tps, 3),
+        },
         "compiles": {
             "warmup": warm_compiles,
             "expected": len(prefill_buckets) + 1,

@@ -32,3 +32,5 @@ from .launch import spawn  # noqa: F401
 from . import elastic  # noqa: F401  (heartbeat monitor + restart driver)
 from . import checkpoint  # noqa: F401  (async reshardable snapshots)
 from . import chaos  # noqa: F401  (FLAGS_fault_injection hooks)
+from . import quantized  # noqa: F401  (int8 gradient all-reduce)
+from .quantized import quantized_all_reduce  # noqa: F401

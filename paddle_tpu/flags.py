@@ -313,6 +313,17 @@ define_flag("generation_kv_cache_len", 256,
             "per-slot ring KV cache capacity (tokens) for autoregressive "
             "decoding; also the sliding attention window width")
 
+# generation/engine.py + nn/transformer.py QuantizedStaticCache — storage
+# dtype of the ring KV cache. "int8" stores K/V as int8 with per-head
+# dynamic scales (quantize on ring write, dequantize inside the
+# attention read): ~3.8x fewer KV bytes per token at head_dim 64, so the
+# same HBM holds ~1.9x the decode slots — a direct capacity multiplier
+# for the continuous batcher, certified against the full-forward parity
+# goldens at the envelope documented in README "Quantization".
+define_flag("generation_kv_cache_dtype", "float32",
+            "KV cache storage dtype for decoding: float32 | int8 "
+            "(int8: per-head dynamic scales, ~4x fewer cache bytes)")
+
 # generation/engine.py — the sequence-length bucket ladder for prefill.
 # Prompts pad up to the smallest covering bucket, so prefill costs at
 # most len(ladder) compiles ever — the serving batch-bucket discipline,
@@ -504,6 +515,33 @@ define_flag("use_fused_optimizer", True,
 define_flag("use_fused_layernorm", True,
             "fused pallas residual-add + LayerNorm on TPU "
             "(jnp fallback elsewhere; identical math)")
+
+# ops/quantize_kernels.py matmul_int8/mul_int8 + ops/pallas/
+# int8_matmul.py — run the int8×int8→int32 contraction of deployed int8
+# inference programs as a pallas MXU kernel on TPU. The jnp fallback is
+# the identical dot_general (integer math: bit-equal), so the flag never
+# changes numerics — same discipline as the other pallas gates.
+define_flag("use_int8_matmul", True,
+            "pallas int8 matmul kernel for deployed int8 programs on TPU "
+            "(jnp int8 dot_general fallback elsewhere; bit-equal)")
+
+# framework/jit.py TrainStepFn/ShardedTrainStep + distributed/
+# quantized.py — EQuARX-style quantized DP gradient all-reduce: gradients
+# cross the wire as int8 with per-block f32 scales (alltoall the
+# quantized shards, dequant-accumulate, requantize, all-gather), cutting
+# gradient-sync wire bytes ~4x (certified by the collective/<prim>/
+# traced_algo_bytes ledger and ici_bus_util gauges). Read at train-step
+# CONSTRUCTION (like donate): set it before building the step.
+define_flag("quantized_allreduce", False,
+            "int8-with-per-block-scales DP gradient all-reduce "
+            "(~4x fewer gradient-sync wire bytes; read at step build)")
+
+# distributed/quantized.py — elements per quantization block (one f32
+# scale each). Larger blocks amortize scale wire bytes; smaller blocks
+# track outliers tighter. 2048 keeps scale overhead at 0.2% of payload.
+define_flag("quantized_allreduce_block", 2048,
+            "elements per int8 quantization block in the quantized "
+            "all-reduce (one f32 scale per block)")
 
 # io/dataloader.py _DevicePrefetcher — issue the NEXT batches' host
 # fetch + jax.device_put from a background thread while the consumer's

@@ -317,6 +317,17 @@ class TrainStepFn:
         recompute = getattr(self, "recompute", False)
         k = getattr(self, "grad_accum_steps", 1)
         avg = getattr(self, "grad_accum_avg", True)
+        # FLAGS_quantized_allreduce, read at step CONSTRUCTION (like
+        # donate): gradients route through the int8-with-per-block-scales
+        # sync (distributed/quantized.py) — on a bound-axis SPMD world
+        # the real quantized collectives, under GSPMD/single-controller
+        # the same two quantization hops with the wire bytes accounted in
+        # the collective ledger. Capturing the flag here (not at trace
+        # time) keeps a compiled step's behavior fixed: flipping the flag
+        # later builds a NEW step fn with its own cache keys.
+        from ..flags import flag as _flag
+
+        quantized_sync = bool(_flag("quantized_allreduce"))
 
         def pure(state, batch, lr, rng):
             frozen, buffers = state["frozen"], state["buffers"]
@@ -349,6 +360,19 @@ class TrainStepFn:
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True
             )(state["params"])
+
+            if quantized_sync:
+                # int8 gradient sync: under GSPMD the grads at this point
+                # are already the global mean, so the hook applies the
+                # wire-precision rounding (and books the quantized wire
+                # bytes); in a bound-axis SPMD body it IS the all-reduce.
+                from ..distributed import quantized as _qar
+
+                # quantized=True pins the construction-time capture: the
+                # default would re-read the flag at trace time, and a
+                # flag flip before a retrace would silently swap modes
+                grads = _qar.sync_grads(grads, average=False,
+                                        quantized=True)
 
             if k <= 1:
                 new_params, new_opt = _apply_optimizer(

@@ -28,11 +28,18 @@ Quickstart::
 """
 from __future__ import annotations
 
-from ..nn.transformer import StaticCache, causal_mask  # noqa: F401
+from ..nn.transformer import (  # noqa: F401
+    QuantizedStaticCache,
+    StaticCache,
+    causal_mask,
+)
 from .cache import (  # noqa: F401
+    cache_nbytes,
     decode_mask,
     init_cache,
     insert_slot,
+    insert_slot_kv,
+    kv_bytes_per_token,
     layer_caches,
     prefill_mask,
     stack_layer_caches,
@@ -41,8 +48,10 @@ from .engine import COMPILE_COUNTER, GenerationEngine  # noqa: F401
 from .sampling import decode_loop, sample_logits, top_k_filter  # noqa: F401
 
 __all__ = [
-    "GenerationEngine", "COMPILE_COUNTER", "StaticCache", "causal_mask",
+    "GenerationEngine", "COMPILE_COUNTER", "StaticCache",
+    "QuantizedStaticCache", "causal_mask",
     "sample_logits", "top_k_filter", "decode_loop",
     "init_cache", "layer_caches", "stack_layer_caches", "insert_slot",
+    "insert_slot_kv", "cache_nbytes", "kv_bytes_per_token",
     "decode_mask", "prefill_mask",
 ]

@@ -23,38 +23,77 @@ sequence length, so steady-state generation is compile-bound at
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax.numpy as jnp
 
-from ..nn.transformer import StaticCache
+from ..nn.transformer import QuantizedStaticCache, StaticCache
 
 __all__ = [
     "init_cache", "layer_caches", "stack_layer_caches", "insert_slot",
-    "decode_mask", "prefill_mask",
+    "insert_slot_kv", "fresh_layer_caches", "cache_nbytes",
+    "kv_bytes_per_token", "decode_mask", "prefill_mask",
 ]
 
 NEG_INF = -1e9
 
+#: storage dtypes the KV cache supports (FLAGS_generation_kv_cache_dtype)
+KV_CACHE_DTYPES = ("float32", "int8")
+
 
 def init_cache(num_layers, batch, num_heads, cache_len, head_dim,
                dtype="float32"):
-    """Zeroed whole-model cache: ``(k [L,B,H,C,D], v [...], pos [B])``."""
+    """Zeroed whole-model cache.
+
+    ``dtype="float32"``: ``(k [L,B,H,C,D], v [...], pos [B])`` — the
+    historical 3-tuple. ``dtype="int8"``: a 5-tuple that additionally
+    carries the per-head dynamic scale planes ``(k, v, k_scale
+    [L,B,H,C], v_scale [...], pos)`` with int8 K/V storage
+    (:class:`nn.QuantizedStaticCache` per layer). Every helper below
+    dispatches on the tuple arity, so engine code is dtype-agnostic.
+    """
     shape = (int(num_layers), int(batch), int(num_heads), int(cache_len),
              int(head_dim))
-    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype),
-            jnp.zeros((int(batch),), jnp.int32))
+    pos = jnp.zeros((int(batch),), jnp.int32)
+    if str(dtype) == "int8":
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape[:-1], jnp.float32),
+                jnp.zeros(shape[:-1], jnp.float32), pos)
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), pos
 
 
-def layer_caches(ck, cv, pos):
-    """Slice the stacked cache into per-layer :class:`StaticCache` views
-    (``pos`` is shared — every layer writes the same step)."""
-    return [StaticCache(ck[i], cv[i], pos) for i in range(ck.shape[0])]
+def layer_caches(*kv):
+    """Slice the stacked cache into per-layer views (``pos`` is shared —
+    every layer writes the same step): :class:`StaticCache` for the
+    3-tuple form, :class:`nn.QuantizedStaticCache` for the 5-tuple."""
+    if len(kv) == 1:  # whole-cache tuple passed as one argument
+        kv = tuple(kv[0])
+    pos, arrays = kv[-1], kv[:-1]
+    cls = StaticCache if len(arrays) == 2 else QuantizedStaticCache
+    return [cls(*(a[i] for a in arrays), pos)
+            for i in range(arrays[0].shape[0])]
 
 
 def stack_layer_caches(caches):
     """Re-stack per-layer caches returned by the model into the
-    ``(k, v)`` whole-model arrays."""
+    whole-model arrays: ``(k, v)`` for :class:`StaticCache` layers,
+    ``(k, v, k_scale, v_scale)`` for quantized ones."""
+    if isinstance(caches[0], QuantizedStaticCache):
+        return (jnp.stack([c.k for c in caches]),
+                jnp.stack([c.v for c in caches]),
+                jnp.stack([c.k_scale for c in caches]),
+                jnp.stack([c.v_scale for c in caches]))
     return (jnp.stack([c.k for c in caches]),
             jnp.stack([c.v for c in caches]))
+
+
+def fresh_layer_caches(num_layers, batch, num_heads, cache_len, head_dim,
+                       dtype="float32"):
+    """Zeroed per-layer cache list for a prefill forward (the engine
+    prefills ONE sequence into fresh caches, then installs the result
+    into the admitted slot)."""
+    return layer_caches(*init_cache(num_layers, batch, num_heads,
+                                    cache_len, head_dim, dtype))
 
 
 def insert_slot(ck, cv, pos, slot, new_k, new_v, length):
@@ -65,6 +104,35 @@ def insert_slot(ck, cv, pos, slot, new_k, new_v, length):
     ck = ck.at[:, slot].set(new_k)
     cv = cv.at[:, slot].set(new_v)
     return ck, cv, pos.at[slot].set(length)
+
+
+def insert_slot_kv(kv, slot, new_arrays, length):
+    """Arity-generic :func:`insert_slot`: ``kv`` is the whole-model
+    cache tuple (3 or 5 arrays, ``pos`` last) and ``new_arrays`` the
+    matching per-slot planes (``[L, H, C, D]`` values, ``[L, H, C]``
+    scales) from a prefill's :func:`stack_layer_caches`."""
+    pos = kv[-1]
+    updated = tuple(a.at[:, slot].set(n)
+                    for a, n in zip(kv[:-1], new_arrays))
+    return updated + (pos.at[slot].set(length),)
+
+
+def cache_nbytes(kv) -> int:
+    """Device bytes the whole-model cache occupies (values + scales +
+    positions) — the numerator of the int8-vs-f32 HBM claim, measured
+    on the REAL arrays rather than derived."""
+    return int(sum(int(np.prod(a.shape)) * jnp.dtype(a.dtype).itemsize
+                   for a in kv))
+
+
+def kv_bytes_per_token(num_layers, num_heads, head_dim,
+                       dtype="float32") -> int:
+    """Cache bytes one decoded token occupies across all layers: K + V
+    values (+ their scale entries at int8). The ``decode_throughput``
+    bench row reports this per mode; slots-at-equal-HBM is its ratio."""
+    per_vec = (int(head_dim) + 4 if str(dtype) == "int8"
+               else int(head_dim) * 4)
+    return 2 * int(num_layers) * int(num_heads) * per_vec
 
 
 def decode_mask(pos, cache_len, dtype="float32"):

@@ -70,7 +70,7 @@ class GenerationEngine:
     def __init__(self, model, *, slots=None, cache_len=None,
                  prefill_buckets=None, eos_id=None, pad_id=None,
                  max_new_tokens=None, temperature=None, top_k=None,
-                 seed=0):
+                 kv_cache_dtype=None, seed=0):
         # lazy: serving imports generation's scheduler, so module-level
         # imports the other way would cycle
         from ..serving.batcher import parse_buckets
@@ -111,6 +111,19 @@ class GenerationEngine:
         # per-request temperature stays a traced array and is free
         self.top_k = int(top_k if top_k is not None
                          else flag("generation_top_k"))
+        # KV storage dtype: int8 stores the ring cache as int8 + per-head
+        # dynamic scales (~4x fewer cache bytes -> ~2x the slots per HBM;
+        # quantize on ring write, dequantize in the attention read). The
+        # int8 avals change the compiled signature, so each dtype mode
+        # gets its own cache keys in the CompiledStore — never a silent
+        # reuse of the other mode's program.
+        self.kv_cache_dtype = str(
+            kv_cache_dtype if kv_cache_dtype is not None
+            else flag("generation_kv_cache_dtype"))
+        if self.kv_cache_dtype not in _cache.KV_CACHE_DTYPES:
+            raise InvalidArgumentError(
+                f"generation_kv_cache_dtype must be one of "
+                f"{_cache.KV_CACHE_DTYPES}, got {self.kv_cache_dtype!r}")
         spec = model.cache_spec()
         self._num_layers, self._num_heads, self._head_dim = (
             int(spec[0]), int(spec[1]), int(spec[2]))
@@ -166,10 +179,31 @@ class GenerationEngine:
 
     def reset(self):
         """Zero every slot (all caches empty, positions 0)."""
-        self._ck, self._cv, self._pos = _cache.init_cache(
+        from ..monitor import registry as _mon
+
+        self._kv = _cache.init_cache(
             self._num_layers, self.slots, self._num_heads, self.cache_len,
-            self._head_dim)
+            self._head_dim, dtype=self.kv_cache_dtype)
+        # the decode-capacity denominators, as registry gauges: what the
+        # KV cache costs in HBM lands in /metrics next to the hbm/*
+        # gauges it competes with (int8 mode shows the ~4x cut directly)
+        _mon.gauge("generation/kv_cache_bytes").set(
+            _cache.cache_nbytes(self._kv))
+        _mon.gauge("generation/kv_bytes_per_token").set(
+            self.kv_bytes_per_token())
         return self
+
+    def cache_nbytes(self) -> int:
+        """Device bytes the whole decode cache occupies (all slots,
+        values + scales + positions) — the measured side of the
+        int8-vs-f32 HBM claim."""
+        return _cache.cache_nbytes(self._kv)
+
+    def kv_bytes_per_token(self) -> int:
+        """Cache bytes one decoded token occupies across all layers."""
+        return _cache.kv_bytes_per_token(
+            self._num_layers, self._num_heads, self._head_dim,
+            self.kv_cache_dtype)
 
     # -- compile accounting ---------------------------------------------------
 
@@ -216,50 +250,48 @@ class GenerationEngine:
 
     # -- pure steps (jitted) --------------------------------------------------
 
-    def _prefill_pure(self, state, ck, cv, pos, slot, tokens, length, temp,
-                      ctr):
+    def _prefill_pure(self, state, kv, slot, tokens, length, temp, ctr):
         """Bucketed prefill of ONE prompt into decode slot ``slot``.
 
         ``tokens [1, P]`` (P = a ladder bucket), ``length`` = true prompt
         length. Runs the full forward over the bucket with fresh
-        per-layer caches, installs the K/V into the slot, and samples the
-        first generated token from the last REAL prompt position.
+        per-layer caches, installs the K/V (and, at int8, the scale
+        planes) into the slot, and samples the first generated token
+        from the last REAL prompt position.
         """
-        from ..nn.transformer import StaticCache
-
         p = tokens.shape[1]
-        zero = jnp.zeros((1, self._num_heads, self.cache_len,
-                          self._head_dim), ck.dtype)
-        fresh = [StaticCache(zero, zero, jnp.zeros((1,), jnp.int32))
-                 for _ in range(self._num_layers)]
+        fresh = _cache.fresh_layer_caches(
+            self._num_layers, 1, self._num_heads, self.cache_len,
+            self._head_dim, dtype=self.kv_cache_dtype)
         mask = _cache.prefill_mask(p, self.cache_len, length)
         pos_ids = jnp.arange(p, dtype=jnp.int32)[None]
         (logits, new_caches), _ = functional_call(
             self.model, state, tokens,
             position_ids=pos_ids, attention_mask=mask, caches=fresh)
-        new_k, new_v = _cache.stack_layer_caches(new_caches)
-        ck, cv, pos = _cache.insert_slot(
-            ck, cv, pos, slot, new_k[:, 0], new_v[:, 0], length)
+        stacked = _cache.stack_layer_caches(new_caches)
+        kv = _cache.insert_slot_kv(
+            kv, slot, tuple(a[:, 0] for a in stacked), length)
         last = jax.lax.dynamic_index_in_dim(
             logits[0], length - 1, axis=0, keepdims=False)
         key = jax.random.fold_in(self._base_key, ctr)
         tok = sample_logits(last[None], key, temp[None], self.top_k)[0]
-        return ck, cv, pos, tok
+        return kv, tok
 
-    def _decode_pure(self, state, ck, cv, pos, tokens, temps, ctr):
+    def _decode_pure(self, state, kv, tokens, temps, ctr):
         """One decode step for EVERY slot: ``tokens [S]`` (each slot's
         last token) -> next token per slot. Static shapes throughout —
         this is the program whose compile count is exactly 1."""
-        caches = _cache.layer_caches(ck, cv, pos)
+        caches = _cache.layer_caches(*kv)
+        pos = kv[-1]
         pos_ids = jnp.minimum(pos, self.max_positions - 1)[:, None]
         mask = _cache.decode_mask(pos, self.cache_len)
         (logits, new_caches), _ = functional_call(
             self.model, state, tokens[:, None],
             position_ids=pos_ids, attention_mask=mask, caches=caches)
-        ck, cv = _cache.stack_layer_caches(new_caches)
+        kv = _cache.stack_layer_caches(new_caches) + (pos + 1,)
         key = jax.random.fold_in(self._base_key, ctr)
         nxt = sample_logits(logits[:, 0], key, temps, self.top_k)
-        return ck, cv, pos + 1, nxt
+        return kv, nxt
 
     # -- scheduler primitives -------------------------------------------------
 
@@ -304,11 +336,11 @@ class GenerationEngine:
         self._key_step += 1
         with RecordEvent("generation::prefill"):
             out = self._dispatch("prefill", self._prefill_jit, (
-                self._state(), self._ck, self._cv, self._pos,
+                self._state(), self._kv,
                 jnp.asarray(slot, jnp.int32), jnp.asarray(padded[None]),
                 jnp.asarray(n, jnp.int32), jnp.asarray(temp, jnp.float32),
                 jnp.asarray(self._key_step, jnp.int32)))
-        self._ck, self._cv, self._pos, tok = out
+        self._kv, tok = out
         return int(tok)
 
     def step(self, tokens, temps) -> np.ndarray:
@@ -318,11 +350,11 @@ class GenerationEngine:
         self._key_step += 1
         with RecordEvent("generation::decode"):
             out = self._dispatch("decode", self._decode_jit, (
-                self._state(), self._ck, self._cv, self._pos,
+                self._state(), self._kv,
                 jnp.asarray(np.asarray(tokens, np.int32)),
                 jnp.asarray(np.asarray(temps, np.float32)),
                 jnp.asarray(self._key_step, jnp.int32)))
-        self._ck, self._cv, self._pos, nxt = out
+        self._kv, nxt = out
         return np.asarray(nxt)
 
     # -- offline API ----------------------------------------------------------

@@ -130,6 +130,16 @@ class Predictor:
     def get_input_names(self):
         return list(self._feed_names)
 
+    def quant_metadata(self):
+        """Scale metadata of a loaded int8 model (the ``__quant__.json``
+        sidecar ``slim.ptq.save_int8_model`` writes): bits, per-var
+        scales, int8 weight names. None for ordinary f32 models — the
+        check an operator's tooling runs to confirm WHAT a serving
+        backend actually loaded."""
+        from ..slim.ptq import load_quant_metadata
+
+        return load_quant_metadata(self.config.model_dir())
+
     def get_output_names(self):
         return list(self._fetch_names)
 

@@ -108,6 +108,56 @@ class StaticCache(NamedTuple):
     pos: Any
 
 
+class QuantizedStaticCache(NamedTuple):
+    """:class:`StaticCache` at int8 storage with per-head dynamic scales.
+
+    ``k``/``v`` are int8 ``[B, H, C, D]``; ``k_scale``/``v_scale`` are
+    f32 ``[B, H, C]`` — one abs-max scale per written head-vector,
+    computed DYNAMICALLY at ring-write time (no calibration pass: each
+    K/V row quantizes against its own magnitude, so attention sinks and
+    outlier heads never clip the rest of the cache). The attention read
+    dequantizes the full static window (``q · scale/127``) before the
+    score matmul — decode HBM traffic drops to ~(D+4)/(4·D) of the f32
+    cache (3.8× at head_dim 64), which is what lets the same HBM hold
+    ~2× the decode slots (``FLAGS_generation_kv_cache_dtype=int8``).
+
+    Ring semantics, functional updates, and the caller-owned mask
+    contract are exactly :class:`StaticCache`'s; parity vs the full
+    f32 forward holds at the int8 envelope documented in README
+    "Quantization" (goldens in tests/test_quantization.py).
+    """
+
+    k: Any
+    v: Any
+    k_scale: Any
+    v_scale: Any
+    pos: Any
+
+
+#: int8 grid half-width for KV-cache quantization
+KV_QUANT_BNT = 127.0
+#: scale floor: an all-zero head-vector must not dequantize as NaN
+KV_QUANT_EPS = 1e-8
+
+
+def quantize_kv(x):
+    """``[..., D]`` float → (int8 values, f32 abs-max scales ``[...]``).
+
+    One dynamic scale per trailing vector (per head per cache entry) —
+    the quantize-on-ring-write half of the int8 KV cache.
+    """
+    x = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x), axis=-1), KV_QUANT_EPS)
+    q = jnp.round(jnp.clip(x / scale[..., None] * KV_QUANT_BNT,
+                           -KV_QUANT_BNT, KV_QUANT_BNT))
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv` — the attention-read half."""
+    return q.astype(dtype) * (scale[..., None] / KV_QUANT_BNT).astype(dtype)
+
+
 class MultiHeadAttention(Layer):
     """Scaled dot-product multi-head attention (transformer.py:67)."""
 
@@ -158,12 +208,13 @@ class MultiHeadAttention(Layer):
         q = self._shape(self.q_proj(query))
         k = self._shape(self.k_proj(key))
         v = self._shape(self.v_proj(value))
-        if isinstance(cache, StaticCache):
+        if isinstance(cache, (StaticCache, QuantizedStaticCache)):
             # incremental path: write the new K/V into the ring cache by
             # functional index update, then attend over the FULL static
             # window — shapes never change across steps, so a jitted
             # decode step compiles exactly once (the caller's mask hides
-            # not-yet-written entries)
+            # not-yet-written entries). The quantized cache writes int8
+            # + per-head scales and hands back the dequantized window.
             k, v, new_cache = self._update_static_cache(cache, k, v)
         elif cache is not None:
             pk, pv = cache
@@ -259,6 +310,8 @@ class MultiHeadAttention(Layer):
         at pos == 0; ring-wrap writes are decode-only by construction —
         the engine admits prompts no longer than the cache window).
         """
+        if isinstance(cache, QuantizedStaticCache):
+            return self._update_quantized_cache(cache, k, v)
         kc, vc, pos = cache
         kn = k._array if isinstance(k, Tensor) else jnp.asarray(k)
         vn = v._array if isinstance(v, Tensor) else jnp.asarray(v)
@@ -276,6 +329,43 @@ class MultiHeadAttention(Layer):
             vc = jax.lax.dynamic_update_slice_in_dim(vc, vn, start, axis=2)
         return (Tensor._from_array(kc), Tensor._from_array(vc),
                 StaticCache(kc, vc, pos))
+
+    def _update_quantized_cache(self, cache, k, v):
+        """Int8 twin of :meth:`_update_static_cache`.
+
+        The fresh K/V projections quantize per head-vector (one dynamic
+        abs-max scale each, :func:`quantize_kv`) before the ring write —
+        int8 values and f32 scales land at the same ring index the f32
+        cache would write. The attention read then dequantizes the FULL
+        window: masked (never-written / stale) entries dequantize to
+        whatever garbage they hold, exactly as in the f32 cache, and the
+        caller's mask hides them.
+        """
+        kc, vc, ks, vs, pos = cache
+        kn = k._array if isinstance(k, Tensor) else jnp.asarray(k)
+        vn = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+        out_dtype = kn.dtype
+        kq, ksc = quantize_kv(kn)
+        vq, vsc = quantize_kv(vn)
+        c = kc.shape[2]
+        if kn.shape[2] == 1:
+            rows = jnp.arange(kc.shape[0])
+            idx = jnp.mod(pos, c)
+            kc = kc.at[rows, :, idx, :].set(kq[:, :, 0, :])
+            vc = vc.at[rows, :, idx, :].set(vq[:, :, 0, :])
+            ks = ks.at[rows, :, idx].set(ksc[:, :, 0])
+            vs = vs.at[rows, :, idx].set(vsc[:, :, 0])
+        else:
+            start = jnp.mod(pos[0], c)
+            dus = jax.lax.dynamic_update_slice_in_dim
+            kc = dus(kc, kq, start, axis=2)
+            vc = dus(vc, vq, start, axis=2)
+            ks = dus(ks, ksc, start, axis=2)
+            vs = dus(vs, vsc, start, axis=2)
+        kf = dequantize_kv(kc, ks, out_dtype)
+        vf = dequantize_kv(vc, vs, out_dtype)
+        return (Tensor._from_array(kf), Tensor._from_array(vf),
+                QuantizedStaticCache(kc, vc, ks, vs, pos))
 
 
 class TransformerEncoderLayer(Layer):

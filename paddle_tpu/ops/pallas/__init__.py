@@ -6,5 +6,6 @@ attention) and operators/jit/ (runtime-codegen CPU kernels) — here as
 Pallas kernels compiled through Mosaic for the TPU's MXU/VMEM.
 """
 from .flash_attention import flash_attention  # noqa: F401
+from .int8_matmul import int8_matmul  # noqa: F401
 from .layernorm_residual import layernorm_residual  # noqa: F401
 from .optimizer_update import fused_momentum_update  # noqa: F401

@@ -184,3 +184,82 @@ def quant_dequant_static(x, *, scale, bit_length=8):
     """PTQ simulation op with a calibrated constant scale
     (quantization_pass.py's inserted quant/dequant pair)."""
     return _qdq(x, jnp.asarray(scale, x.dtype), bit_length)
+
+
+# ---------------------------------------------------------------------------
+# Deployable int8 ops (slim/ptq.py save_int8_model): REAL int8 storage and
+# compute, not quant-dequant simulation. The program carries int8 weights
+# plus per-tensor calibrated activation scales; matmul/mul contract the
+# int8 operands into int32 on the MXU (ops/pallas/int8_matmul.py behind
+# FLAGS_use_int8_matmul; identical jnp dot_general fallback) and apply the
+# combined dequant scale once on the int32 accumulator.
+# ---------------------------------------------------------------------------
+
+
+def _bnt(bit_length):
+    return float((1 << (int(bit_length) - 1)) - 1)
+
+
+@register_op("quantize_static")
+def quantize_static(x, *, scale, bit_length=8):
+    """f32 -> int8 with a calibrated constant scale (the activation
+    quantize in a deployed int8 program)."""
+    bnt = _bnt(bit_length)
+    s = max(float(scale), 1e-8)
+    q = jnp.round(jnp.clip(x.astype(jnp.float32) / s * bnt, -bnt, bnt))
+    return q.astype(jnp.int8)
+
+
+@register_op("dequantize_static")
+def dequantize_static(x, *, scale, bit_length=8, dtype="float32"):
+    """int8 -> float with a constant scale (restores f32 weights for ops
+    with no int8 compute path yet, e.g. conv2d — the weight still ships
+    and loads as int8 bytes)."""
+    bnt = _bnt(bit_length)
+    return x.astype(dtype) * (float(scale) / bnt)
+
+
+@register_op("matmul_int8")
+def matmul_int8(x, y, *, scale_x, scale_y, bit_length=8,
+                y_bit_length=None, transpose_x=False, transpose_y=False):
+    """int8 × int8 matmul with int32 accumulation and one dequant.
+
+    ``x``/``y`` are int8 on the calibrated grids ``scale_x``/``scale_y``
+    (``bit_length`` = x's grid width, ``y_bit_length`` = y's, defaulting
+    to x's — activation and weight bits may differ); the int32 product
+    dequantizes by ``scale_x·scale_y / (bnt_x·bnt_y)`` — the only
+    rounding in the op is the operands' own quantization (the
+    contraction itself is exact integer math).
+    """
+    from .pallas.int8_matmul import int8_matmul as _mm
+
+    if transpose_x and x.ndim > 1:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y and y.ndim > 1:
+        y = jnp.swapaxes(y, -1, -2)
+    lead = x.shape[:-1]
+    acc = _mm(x.reshape((-1, x.shape[-1])), y)
+    bnt_x = _bnt(bit_length)
+    bnt_y = _bnt(bit_length if y_bit_length is None else y_bit_length)
+    out = acc.astype(jnp.float32) * (
+        float(scale_x) * float(scale_y) / (bnt_x * bnt_y))
+    return out.reshape(lead + (y.shape[-1],))
+
+
+@register_op("mul_int8")
+def mul_int8(x, y, *, scale_x, scale_y, bit_length=8, y_bit_length=None,
+             x_num_col_dims=1, y_num_col_dims=1):
+    """int8 twin of the ``mul`` op (flatten then 2D matmul); bit-length
+    semantics as in :func:`matmul_int8`."""
+    import math as _math
+
+    from .pallas.int8_matmul import int8_matmul as _mm
+
+    xs = x.reshape((_math.prod(x.shape[:x_num_col_dims]), -1))
+    ys = y.reshape((_math.prod(y.shape[:y_num_col_dims]), -1))
+    acc = _mm(xs, ys)
+    bnt_x = _bnt(bit_length)
+    bnt_y = _bnt(bit_length if y_bit_length is None else y_bit_length)
+    out = acc.astype(jnp.float32) * (
+        float(scale_x) * float(scale_y) / (bnt_x * bnt_y))
+    return out.reshape(x.shape[:x_num_col_dims] + y.shape[y_num_col_dims:])

@@ -835,6 +835,12 @@ class GenerationServer:
                 "slot_occupancy": round(self.scheduler.occupancy(), 4),
                 "midbatch_admissions": val(
                     "serving/gen_midbatch_admissions_total"),
+                # KV-cache economics: what decode capacity costs in HBM
+                # (int8 mode ~4x fewer bytes/token -> ~2x the slots at
+                # equal HBM; FLAGS_generation_kv_cache_dtype)
+                "kv_cache_dtype": self.engine.kv_cache_dtype,
+                "kv_bytes_per_token": self.engine.kv_bytes_per_token(),
+                "kv_cache_bytes": self.engine.cache_nbytes(),
             },
             "latency": {
                 "token": quantiles("serving/gen_token_ms"),

@@ -7,4 +7,9 @@ quantization_pass.py (static program rewrite).
 """
 from .quant_nn import QuantizedConv2D, QuantizedLinear  # noqa: F401
 from .qat import ImperativeQuantAware  # noqa: F401
-from .ptq import PostTrainingQuantization, quantize_static_program  # noqa: F401
+from .ptq import (  # noqa: F401
+    PostTrainingQuantization,
+    load_quant_metadata,
+    quantize_static_program,
+    rewrite_int8_program,
+)

@@ -13,18 +13,79 @@ fusion. The quantized program is a drop-in for the Executor/Predictor.
 """
 from __future__ import annotations
 
+import json
+import os
+
 import numpy as np
 
 import jax.numpy as jnp
 
 _QUANTIZABLE = ("mul", "matmul", "conv2d")
 
+#: scale metadata sidecar written next to the saved int8 program
+QUANT_METADATA_FILENAME = "__quant__.json"
+
+# calibration floor: a dead activation (abs-max 0.0) must never produce
+# a 0 scale — dequantizing by it is NaN/inf (see _clamped_scale)
+_SCALE_EPS = 1e-8
+
+
+def _clamped_scale(name, raw):
+    """Clamp a calibrated scale away from zero.
+
+    A variable whose calibration abs-max is 0.0 (dead activation, an
+    all-zero calibration batch) would otherwise bake a 0 scale into the
+    program — and dequantizing by it is NaN/inf at serving time, far
+    from the calibration run that caused it. Clamp to a tiny epsilon
+    (the quantized values are all 0 anyway, so the clamp is exact) and
+    leave a flight-recorder breadcrumb naming the variable.
+    """
+    s = float(raw)
+    if s > _SCALE_EPS:
+        return s
+    from ..monitor import flight_recorder as _flight
+
+    _flight.record_event("ptq_zero_scale", var=name, raw_scale=s,
+                         clamped_to=_SCALE_EPS)
+    return _SCALE_EPS
+
 
 def _collect_var_abs_max(program, scope, exe, feed_batches, var_names):
-    """Run calibration batches; record abs-max per listed var."""
+    """Run calibration batches; record abs-max per listed var.
+
+    ONE ``exe.run`` per batch fetches every calibration var. The fetch
+    set is validated against what the program's ops actually produce
+    BEFORE running: a requested var nothing computes would either fail
+    deep inside the trace or — worse, when a stale same-named value
+    sits in the scope — silently calibrate on garbage. Error loudly
+    naming the missing vars instead.
+    """
+    var_names = list(var_names)
+    produced = set()
+    for blk in program.blocks:
+        for op in blk.ops:
+            produced.update(op.output_names())
+        for name, var in blk.vars.items():
+            if getattr(var, "_meta", {}).get("is_data"):
+                produced.add(name)  # feed vars land in env directly
+    for feed in feed_batches:
+        produced.update(feed)
+    missing = sorted(set(var_names) - produced)
+    if missing:
+        from ..errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"calibration vars {missing} are not produced by any op in "
+            "the program (pruned or renamed?); the fetched set must "
+            f"equal the requested set ({len(var_names)} vars)")
     maxes = {n: 0.0 for n in var_names}
     for feed in feed_batches:
-        outs = exe.run(program, feed=feed, fetch_list=list(var_names))
+        outs = exe.run(program, feed=feed, fetch_list=var_names)
+        if len(outs) != len(var_names):
+            raise RuntimeError(
+                f"calibration fetch returned {len(outs)} values for "
+                f"{len(var_names)} requested vars — fetched set must "
+                "equal the requested set")
         for n, v in zip(var_names, outs):
             maxes[n] = max(maxes[n], float(np.max(np.abs(np.asarray(v)))))
     return maxes
@@ -57,13 +118,14 @@ def quantize_static_program(program, scope, exe, feed_batches, *,
 
     scales = _collect_var_abs_max(program, scope, exe, feed_batches,
                                   act_inputs)
+    scales = {n: _clamped_scale(n, s) for n, s in scales.items()}
 
     # weights: quant-dequant in place (per-tensor abs-max, like the
     # reference's weight_quantize_type="abs_max" path)
     bnt_w = float((1 << (weight_bits - 1)) - 1)
     for n in sorted(weight_inputs):
         w = np.asarray(scope.get(n))
-        s = max(float(np.max(np.abs(w))), 1e-8)
+        s = _clamped_scale(n, float(np.max(np.abs(w))))
         q = np.round(np.clip(w / s * bnt_w, -bnt_w, bnt_w))
         scope.set(n, jnp.asarray((q * s / bnt_w).astype(w.dtype)))
         scales[n] = s
@@ -106,6 +168,158 @@ def quantize_static_program(program, scope, exe, feed_batches, *,
     return scales
 
 
+def rewrite_int8_program(program, scope, scales, *, weight_bits=8,
+                         activation_bits=8):
+    """Lower a calibrated fake-quant program to a DEPLOYABLE int8 one.
+
+    Input: a program ``quantize_static_program`` already rewrote
+    (``quant_dequant_static`` sim ops in front of quantizable ops,
+    qdq'd f32 weights in the scope) plus its ``scales``. Output: a NEW
+    program (the input is untouched) where
+
+    - every quantized weight is stored as a REAL int8 array in the scope
+      under ``<w>@int8`` (exact: the scope value already sits on the
+      int8 grid, so re-quantizing loses nothing);
+    - matmul/mul ops whose activation input carries a calibrated scale
+      and whose second operand is a quantized weight become
+      ``matmul_int8``/``mul_int8``: the activation is quantized by ONE
+      ``quantize_static`` op (f32→int8) and the contraction runs
+      int8×int8→int32 (ops/pallas/int8_matmul.py behind
+      ``FLAGS_use_int8_matmul``), dequantized once by the combined
+      scale — no fake-quant simulation left on the path;
+    - ops with no int8 compute path (conv2d, or a matmul whose weight is
+      the first operand) keep the sim op for their activation but still
+      ship the int8 weight, restored by a load-time
+      ``dequantize_static`` (the Predictor's constant-folding pass
+      materializes it once at load).
+
+    Returns ``(new_program, int8_weights)`` where ``int8_weights`` maps
+    ``<w>@int8`` names to the int8 arrays that were installed in
+    ``scope`` (the save path persists them; f32 originals drop out of
+    the pruned program).
+    """
+    from ..static.program import OpDesc, Program
+
+    bnt_w = float((1 << (weight_bits - 1)) - 1)
+    prog = Program.from_dict(program.to_dict())
+    prog._constants = dict(getattr(program, "_constants", {}))
+    block = prog.global_block()
+
+    # recover the sim pass's bookkeeping from the program itself: every
+    # quant_dequant_static op is (base var -> qdq'd var, scale attr)
+    qdq_of = {}      # qdq output name -> (base name, scale)
+    for op in block.ops:
+        if op.type == "quant_dequant_static":
+            qdq_of[op.outputs["Out"][0]] = (op.inputs["X"][0],
+                                            float(op.attrs["scale"]))
+
+    def is_weight(n):
+        return (n in scales
+                and ((block.has_var(n) and block.var(n).persistable)
+                     or scope.has(n)))
+
+    # decide per quantizable op whether the int8 compute rewrite applies
+    int8_ops = {}    # id(op) -> (act_qdq_name, weight_name)
+    for op in block.ops:
+        if op.type not in ("mul", "matmul"):
+            continue
+        ins = op.inputs.get("X", [])
+        if len(ins) != 2:
+            continue
+        a, w = ins
+        if a in qdq_of and is_weight(w):
+            int8_ops[id(op)] = (a, w)
+
+    # int8 consumers per qdq var: a qdq op ALL of whose consumers went
+    # int8 is replaced by quantize_static; mixed consumers keep both
+    qdq_consumers = {}   # qdq name -> [total, int8]
+    for op in block.ops:
+        for n in op.input_names():
+            if n in qdq_of:
+                stats = qdq_consumers.setdefault(n, [0, 0])
+                stats[0] += 1
+                if id(op) in int8_ops:
+                    stats[1] += 1
+
+    int8_weights = {}
+
+    def quantized_weight(w):
+        qname = f"{w}@int8"
+        if qname not in int8_weights:
+            arr = np.asarray(scope.get(w))
+            s = scales[w]
+            q = np.round(np.clip(arr / s * bnt_w, -bnt_w, bnt_w)).astype(
+                np.int8)
+            int8_weights[qname] = q
+            scope.set(qname, jnp.asarray(q))
+            block.create_var(name=qname, shape=list(q.shape), dtype="int8",
+                             persistable=True)
+        return qname
+
+    new_ops = []
+    for op in block.ops:
+        if op.type == "quant_dequant_static":
+            qn = op.outputs["Out"][0]
+            base, scale = qdq_of[qn]
+            total, as_int8 = qdq_consumers.get(qn, [0, 0])
+            if as_int8:
+                q8 = f"{base}@q8"
+                src = block.var(base)
+                block.create_var(name=q8, shape=src.shape, dtype="int8")
+                new_ops.append(OpDesc(
+                    "quantize_static", {"X": [base]}, {"Out": [q8]},
+                    {"scale": scale, "bit_length": activation_bits}))
+            if as_int8 < total or total == 0:
+                new_ops.append(op)  # non-int8 consumers still need the sim
+            continue
+
+        if id(op) in int8_ops:
+            a, w = int8_ops[id(op)]
+            base, scale_a = qdq_of[a]
+            attrs = {k: v for k, v in op.attrs.items()}
+            attrs.update(scale_x=scale_a, scale_y=scales[w],
+                         bit_length=activation_bits,
+                         y_bit_length=weight_bits)
+            new_ops.append(OpDesc(
+                f"{op.type}_int8",
+                {"X": [f"{base}@q8", quantized_weight(w)]},
+                dict(op.outputs), attrs))
+            continue
+
+        if op.type in _QUANTIZABLE:
+            # no int8 compute path: ship the weight as int8 anyway and
+            # restore f32 at load time (constant folding collapses it)
+            new_inputs = {}
+            for slot, names in op.inputs.items():
+                out_names = []
+                for n in names:
+                    if is_weight(n):
+                        qname = quantized_weight(n)
+                        deq = f"{n}@deq"
+                        if not block.has_var(deq):
+                            src = block.var(n)
+                            block.create_var(name=deq, shape=src.shape,
+                                             dtype=str(src.dtype))
+                            new_ops.append(OpDesc(
+                                "dequantize_static", {"X": [qname]},
+                                {"Out": [deq]},
+                                {"scale": scales[n],
+                                 "bit_length": weight_bits,
+                                 "dtype": str(src.dtype)}))
+                        out_names.append(deq)
+                    else:
+                        out_names.append(n)
+                new_inputs[slot] = out_names
+            new_ops.append(OpDesc(op.type, new_inputs, dict(op.outputs),
+                                  dict(op.attrs)))
+            continue
+
+        new_ops.append(op)
+    block.ops[:] = new_ops
+    prog._version = getattr(prog, "_version", 0) + 1
+    return prog, int8_weights
+
+
 class PostTrainingQuantization:
     """post_training_quantization.py facade over the pass above."""
 
@@ -135,3 +349,50 @@ class PostTrainingQuantization:
             dirname, feed_names, fetch_vars, self._exe,
             main_program=self._program,
         )
+
+    def save_int8_model(self, dirname, feed_names, fetch_vars):
+        """Save a DEPLOYABLE int8 inference program.
+
+        Folds the calibrated scales into the saved program as real int8
+        weights + per-tensor activation scales (``rewrite_int8_program``
+        — ``quantize_static``/``matmul_int8``/``mul_int8`` ops, not
+        fake-quant simulation). The result loads into an UNCHANGED
+        ``inference.Predictor``; a ``__quant__.json`` sidecar persists
+        the scale metadata (bits, per-var scales, int8 weight names) for
+        tooling. Returns the fetch names like ``save_quantized_model``.
+        """
+        if self.scales is None:
+            raise RuntimeError(
+                "save_int8_model needs calibrated scales; call "
+                "quantize() first")
+        from ..monitor import flight_recorder as _flight
+        from ..static import io as static_io
+
+        prog, int8_weights = rewrite_int8_program(
+            self._program, self._scope, self.scales,
+            weight_bits=self._wbits, activation_bits=self._abits)
+        out = static_io.save_inference_model(
+            dirname, feed_names, fetch_vars, self._exe, main_program=prog)
+        meta = {
+            "version": 1,
+            "weight_bits": self._wbits,
+            "activation_bits": self._abits,
+            "scales": {n: float(s) for n, s in self.scales.items()},
+            "int8_weights": sorted(int8_weights),
+        }
+        with open(os.path.join(dirname, QUANT_METADATA_FILENAME), "w") as f:
+            json.dump(meta, f)
+        _flight.record_event(
+            "int8_model_saved", dir=dirname,
+            int8_weights=len(int8_weights), scales=len(self.scales))
+        return out
+
+
+def load_quant_metadata(dirname):
+    """Read the ``__quant__.json`` sidecar ``save_int8_model`` wrote
+    (None when the dir holds no quantized model)."""
+    path = os.path.join(dirname, QUANT_METADATA_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)

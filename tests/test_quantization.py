@@ -205,6 +205,189 @@ def test_ptq_static_program(tmp_path):
     np.testing.assert_allclose(got2, got, rtol=1e-5, atol=1e-6)
 
 
+def _build_fc_net(rng, layers=((16, "relu"), (4, None))):
+    x = static.data("x", [None, 8], "float32")
+    h = x
+    for i, (width, act) in enumerate(layers):
+        h = static.nn.fc(h, width, activation=act, name=f"f{i}")
+    exe = static.Executor()
+    exe.run_startup()
+    return exe, static.default_main_program(), x, h
+
+
+def test_ptq_zero_scale_clamped_and_recorded():
+    """A dead activation (all-zero calibration) must clamp its scale to
+    epsilon — not bake a 0 scale that dequantizes to NaN/inf — and name
+    the variable in the flight recorder."""
+    from paddle_tpu.monitor import flight_recorder
+
+    static.enable_static()
+    rng = np.random.RandomState(0)
+    exe, prog, x, y = _build_fc_net(rng)
+    # all-zero calibration batches: every activation abs-max is 0.0
+    calib = [{"x": np.zeros((8, 8), "float32")} for _ in range(2)]
+    ptq = slim.PostTrainingQuantization(exe, prog, calib)
+    ptq.quantize()
+    assert all(s > 0 for s in ptq.scales.values())
+    events = [e for e in flight_recorder.events()
+              if e.get("kind") == "ptq_zero_scale"]
+    assert events, "zero-scale clamp must leave a flight-recorder event"
+    assert all(e["var"] for e in events)
+    # and the quantized program still produces finite outputs
+    out = exe.run(prog, feed={"x": rng.randn(4, 8).astype("float32")},
+                  fetch_list=[y])[0]
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_ptq_calibration_fetch_set_validated():
+    """A calibration var nothing in the program produces must error
+    loudly naming it — not silently calibrate on a stale scope value."""
+    from paddle_tpu.errors import InvalidArgumentError
+    from paddle_tpu.slim.ptq import _collect_var_abs_max
+
+    static.enable_static()
+    rng = np.random.RandomState(1)
+    exe, prog, x, y = _build_fc_net(rng)
+    # plant a stale same-named value in the scope: the old code would
+    # have fetched it as if it were a live activation
+    static.global_scope().set("ghost_var", np.ones(3, "float32"))
+    calib = [{"x": rng.randn(4, 8).astype("float32")}]
+    with pytest.raises(InvalidArgumentError, match="ghost_var"):
+        _collect_var_abs_max(prog, static.global_scope(), exe, calib,
+                             [y.name, "ghost_var"])
+
+
+def test_ptq_int8_model_round_trip(tmp_path):
+    """quantize -> save_int8_model -> fresh Predictor: REAL int8 weights
+    on disk, int8 compute ops in the loaded program, outputs within the
+    documented int8 envelope of the fp32 program, scale metadata
+    persisted across save/load."""
+    from paddle_tpu.framework import serialization
+    from paddle_tpu.inference import Config, create_predictor
+
+    static.enable_static()
+    rng = np.random.RandomState(4)
+    exe, prog, x, y = _build_fc_net(rng)
+    calib = [{"x": rng.randn(16, 8).astype("float32")} for _ in range(4)]
+    Xtest = rng.randn(8, 8).astype("float32")
+    ref = np.asarray(exe.run(feed={"x": Xtest}, fetch_list=[y])[0])
+
+    ptq = slim.PostTrainingQuantization(exe, prog, calib)
+    ptq.quantize()
+    sim = np.asarray(exe.run(prog, feed={"x": Xtest}, fetch_list=[y])[0])
+    path = str(tmp_path / "int8model")
+    ptq.save_int8_model(path, ["x"], [y])
+    n_scales = len(ptq.scales)
+    static.disable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+
+    # the sidecar persists the full scale table
+    meta = slim.load_quant_metadata(path)
+    assert meta["version"] == 1
+    assert meta["weight_bits"] == 8 and meta["activation_bits"] == 8
+    assert len(meta["scales"]) == n_scales
+    assert meta["int8_weights"], "int8 weights must be recorded"
+
+    # saved params hold REAL int8 arrays (not qdq'd floats)
+    state = serialization.load(path + "/__params__", return_numpy=True)
+    for qname in meta["int8_weights"]:
+        assert state[qname].dtype == np.int8
+    # the f32 originals dropped out of the pruned int8 program
+    f32_weights = [n for n in state
+                   if state[n].ndim == 2 and state[n].dtype == np.float32]
+    assert not f32_weights
+
+    pred = create_predictor(Config(path))
+    # the predictor surfaces what it loaded
+    assert pred.quant_metadata()["scales"] == meta["scales"]
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "mul_int8" in types and "quantize_static" in types
+    assert "quant_dequant_static" not in types  # no sim ops on the path
+    pred.get_input_handle("x").copy_from_cpu(Xtest)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    # documented envelope: int8 compute tracks the fake-quant sim almost
+    # exactly (the contraction is exact integer math; only the dequant
+    # mul-order differs) and the fp32 reference within ~5% of its scale
+    np.testing.assert_allclose(got, sim, rtol=1e-4, atol=1e-5)
+    assert np.abs(got - ref).max() < 0.05 * np.abs(ref).max() + 0.05
+
+
+def test_ptq_int8_model_mixed_bit_widths(tmp_path):
+    """weight_bits != activation_bits must dequantize each operand on
+    its OWN grid: a 4-bit-weight int8 program stays within the (wider)
+    4-bit envelope instead of coming back 127/7 off in scale."""
+    from paddle_tpu.inference import Config, create_predictor
+
+    static.enable_static()
+    rng = np.random.RandomState(6)
+    exe, prog, x, y = _build_fc_net(rng)
+    calib = [{"x": rng.randn(16, 8).astype("float32")} for _ in range(4)]
+    Xtest = rng.randn(8, 8).astype("float32")
+    ref = np.asarray(exe.run(feed={"x": Xtest}, fetch_list=[y])[0])
+    ptq = slim.PostTrainingQuantization(exe, prog, calib, weight_bits=4,
+                                        activation_bits=8)
+    ptq.quantize()
+    path = str(tmp_path / "w4a8")
+    ptq.save_int8_model(path, ["x"], [y])
+    static.disable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    pred = create_predictor(Config(path))
+    pred.get_input_handle("x").copy_from_cpu(Xtest)
+    pred.run()
+    got = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    # 4-bit weights: coarse but SCALE-correct (a bit-width mixup shows
+    # up as an ~18x magnitude error, far outside this envelope)
+    assert np.abs(got - ref).max() < 0.35 * np.abs(ref).max() + 0.35
+
+
+def test_int8_matmul_kernel_parity():
+    """pallas interpret == jnp fallback for the int8 matmul, bit-equal
+    (integer math), including padded tails on every axis."""
+    from paddle_tpu.ops.pallas.int8_matmul import (
+        _jnp_matmul,
+        _pallas_matmul,
+    )
+
+    rng = np.random.RandomState(0)
+    for m, k, n in [(32, 128, 128), (37, 70, 130), (257, 129, 260)]:
+        x = jnp.asarray(rng.randint(-127, 128, (m, k)).astype(np.int8))
+        w = jnp.asarray(rng.randint(-127, 128, (k, n)).astype(np.int8))
+        ref = np.asarray(_jnp_matmul(x, w))
+        got = np.asarray(_pallas_matmul(x, w, interpret=True))
+        assert got.dtype == np.int32
+        np.testing.assert_array_equal(got, ref)
+        # and the fallback is the exact integer product
+        wide = np.asarray(x, np.int64) @ np.asarray(w, np.int64)
+        np.testing.assert_array_equal(ref, wide)
+
+
+def test_int8_matmul_ops_oracle():
+    """matmul_int8/mul_int8 dequantize the exact int32 contraction by
+    the combined scale — within one quantization step of fp32."""
+    rng = np.random.RandomState(1)
+    xf = rng.randn(6, 10).astype("float32")
+    wf = rng.randn(10, 5).astype("float32")
+    sx = float(np.abs(xf).max())
+    sw = float(np.abs(wf).max())
+    xq = kernel("quantize_static")(jnp.asarray(xf), scale=sx)
+    wq = kernel("quantize_static")(jnp.asarray(wf), scale=sw)
+    assert str(xq.dtype) == "int8"
+    out = np.asarray(kernel("matmul_int8")(xq, wq, scale_x=sx, scale_y=sw))
+    ref = xf @ wf
+    # error bound: K accumulated products, each operand within half a
+    # quantization step
+    bound = 10 * (sx / 127 * np.abs(wf).max()
+                  + sw / 127 * np.abs(xf).max())
+    assert np.abs(out - ref).max() < bound
+    out2 = np.asarray(kernel("mul_int8")(xq, wq, scale_x=sx, scale_y=sw))
+    np.testing.assert_allclose(out2, out, rtol=1e-6)
+    deq = np.asarray(kernel("dequantize_static")(wq, scale=sw))
+    assert np.abs(deq - wf).max() <= sw / 127 / 2 + 1e-6
+
+
 def test_qat_conv2d_path():
     """QuantizedConv2D: per-output-channel weight scales + training."""
     import paddle_tpu.nn as pnn

@@ -24,6 +24,8 @@
 #             router->backend processes, tail retention of deadline+retry)
 #           + kernel smoke (fused pallas kernels: numeric parity,
 #             bounded compiles, prefetch-overlap input-wait drop)
+#           + quant smoke (int8 end-to-end: kernel parity, int8 serving
+#             programs, int8 KV cache, quantized all-reduce byte cut)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -109,6 +111,13 @@ case "$MODE" in
     # parity (pallas interpret vs jnp, flag on/off through real call
     # sites), one-compile steady state, prefetch-overlap input-wait drop
     JAX_PLATFORMS=cpu python tools/kernel_smoke.py
+    # quant smoke: int8 matmul kernel parity (pallas interpret == jnp,
+    # bit-equal), PTQ -> save_int8_model served through a real
+    # InferenceServer within the fp32 envelope at bounded compiles,
+    # int8-KV decode == fp32 greedy tokens at >=1.8x slots/HBM, and the
+    # quantized all-reduce's >=3.5x wire-byte cut from the ledger +
+    # BERT-smoke loss convergence vs fp32
+    JAX_PLATFORMS=cpu python tools/quant_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

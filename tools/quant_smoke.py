@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""CI smoke for quantization end-to-end (`make quant-smoke`).
+
+Asserts the contracts the int8 work rests on, one per leg:
+
+1. **Kernel parity** — the pallas int8 matmul (interpret mode) is
+   BIT-equal to the jnp int8 dot_general fallback, including padded
+   tails on every axis (integer math: `FLAGS_use_int8_matmul` may never
+   change numerics).
+2. **Deployable int8 serving** — PTQ → ``save_int8_model`` → an
+   UNCHANGED Predictor inside a real ``InferenceServer``: HTTP answers
+   match the fp32 program within the documented envelope, the saved
+   params really are int8, and the bounded-compile discipline holds
+   (warmup == len(buckets) jit misses, zero unexpected after a mixed
+   burst — the int8 program compiles through the same CompiledStore).
+3. **int8 KV cache** — the int8-KV engine decodes the same greedy
+   tokens as the fp32 engine on the same weights, fits ≥ 1.8× the
+   decode slots in equal HBM (measured on the real cache arrays), and
+   stays compile-bound (zero extra compiles after warmup).
+4. **Quantized all-reduce** — the gradient-sync wire bytes certified
+   from the collective ledger itself (≥ 3.5× cut under a dp-8 mesh),
+   and BERT-smoke loss-curve convergence with the int8 gradient sync
+   within tolerance of fp32.
+
+Exit 0 on success. Nothing here depends on wall-clock timing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from urllib.request import Request, urlopen
+
+# 4's dp-8 ledger trace needs forced host devices BEFORE jax imports
+os.environ.setdefault(
+    "XLA_FLAGS",
+    (os.environ.get("XLA_FLAGS", "")
+     + " --xla_force_host_platform_device_count=8").strip())
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _kernel_parity():
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas.int8_matmul import (
+        _jnp_matmul,
+        _pallas_matmul,
+    )
+
+    rng = np.random.RandomState(0)
+    for m, k, n in [(32, 128, 128), (37, 70, 130), (300, 129, 257)]:
+        x = jnp.asarray(rng.randint(-127, 128, (m, k)).astype(np.int8))
+        w = jnp.asarray(rng.randint(-127, 128, (k, n)).astype(np.int8))
+        ref = np.asarray(_jnp_matmul(x, w))
+        got = np.asarray(_pallas_matmul(x, w, interpret=True))
+        assert (got == ref).all(), f"int8 kernel parity broke at {m,k,n}"
+    print("quant-smoke: int8 matmul pallas-interpret == jnp (bit-equal, "
+          "padded tails included)")
+
+
+def _int8_serving():
+    import paddle_tpu.static as static
+    from paddle_tpu import profiler, slim
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.serving import InferenceServer
+
+    buckets = (1, 2, 4)
+    rng = np.random.RandomState(4)
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        x = static.data("x", [None, 16], "float32")
+        h = static.nn.fc(x, 64, activation="relu", name="qs1")
+        y = static.nn.fc(h, 8, name="qs2")
+        exe = static.Executor()
+        exe.run_startup()
+        prog = static.default_main_program()
+        calib = [{"x": rng.randn(16, 16).astype("float32")}
+                 for _ in range(4)]
+        tests = [rng.randn(r, 16).astype("float32") for r in (1, 2, 3, 1)]
+        refs = [np.asarray(exe.run(feed={"x": a}, fetch_list=[y])[0])
+                for a in tests]
+        ptq = slim.PostTrainingQuantization(exe, prog, calib)
+        ptq.quantize()
+        model_dir = tempfile.mkdtemp(prefix="ptpu_quant_smoke_")
+        ptq.save_int8_model(model_dir, ["x"], [y])
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+        static.global_scope().clear()
+
+    meta = slim.load_quant_metadata(model_dir)
+    assert meta and meta["int8_weights"], "int8 weights missing from meta"
+
+    pred = create_predictor(Config(model_dir))
+    types = [op.type for op in pred._program.global_block().ops]
+    assert "mul_int8" in types, types
+    srv = InferenceServer(pred, port=0, replicas=2, buckets=buckets,
+                          batch_timeout_ms=1.0)
+    try:
+        misses0 = profiler.counters().get("executor::jit_cache_miss", 0)
+        srv.start()  # warms every bucket
+        warm = (profiler.counters().get("executor::jit_cache_miss", 0)
+                - misses0)
+        assert warm == len(buckets), (
+            f"int8 program warmup cost {warm} compiles, expected "
+            f"{len(buckets)} — one per bucket through the CompiledStore")
+        fp32_scale = max(np.abs(r).max() for r in refs)
+        for a, ref in zip(tests, refs):
+            body = json.dumps({"inputs": a.tolist()}).encode()
+            r = urlopen(Request(
+                srv.url + "/predict", data=body,
+                headers={"Content-Type": "application/json"}))
+            assert r.status == 200
+            out = json.loads(r.read())
+            got = np.asarray(next(iter(out["outputs"].values())),
+                             dtype="float32")
+            err = np.abs(got - ref).max()
+            assert err < 0.05 * fp32_scale + 0.05, (
+                f"int8 serving answer off fp32 by {err} (envelope 5%)")
+        total = (profiler.counters().get("executor::jit_cache_miss", 0)
+                 - misses0)
+        assert total == len(buckets) and srv.pool.extra_compiles() == 0, (
+            "mixed int8 traffic must add ZERO compiles after warmup")
+    finally:
+        srv.stop(drain=True)
+    print(f"quant-smoke: int8 InferenceServer parity OK "
+          f"({len(buckets)} compiles exactly, 0 unexpected; "
+          f"int8 weights: {meta['int8_weights']})")
+
+
+def _int8_kv_cache():
+    import paddle_tpu as paddle
+    from paddle_tpu.generation import GenerationEngine
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny_config
+
+    paddle.seed(3)
+    cfg = gpt_tiny_config()
+    cfg.attention_window = 16
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    prompts = [[5, 9, 4], [7, 3], [11, 2, 8, 6]]
+    eng32 = GenerationEngine(model, slots=2, cache_len=16,
+                             prefill_buckets=(4, 8), seed=2).warmup()
+    ref = eng32.generate(prompts, max_new_tokens=12, temperature=0.0)
+    eng8 = GenerationEngine(model, slots=2, cache_len=16,
+                            prefill_buckets=(4, 8), kv_cache_dtype="int8",
+                            seed=2).warmup()
+    got = eng8.generate(prompts, max_new_tokens=12, temperature=0.0)
+    assert got == ref, (
+        f"int8 KV decode diverged from fp32 greedy tokens: {got} != {ref}")
+    assert eng8.extra_compiles() == 0, "int8 decode must stay compile-bound"
+    ratio = eng32.cache_nbytes() / eng8.cache_nbytes()
+    assert ratio >= 1.8, (
+        f"int8 KV cache packs only {ratio:.2f}x the slots per HBM byte; "
+        "needs >= 1.8x")
+    print(f"quant-smoke: int8 KV decode == fp32 greedy tokens, "
+          f"{ratio:.2f}x slots at equal HBM, 0 extra compiles")
+
+
+def _quantized_allreduce():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import monitor, parallel
+    from paddle_tpu.distributed import quantized as qar
+    from paddle_tpu.framework import jit as fjit
+    from paddle_tpu.models import (
+        BertConfig,
+        BertForPretraining,
+        BertPretrainingCriterion,
+    )
+
+    # -- ledger wire-byte cut under a dp-8 mesh ------------------------
+    mesh = parallel.create_mesh(dp=8)
+    g = jnp.ones((4096, 64), jnp.float32)
+    with parallel.mesh_scope(mesh):
+        s0 = monitor.registry_snapshot()
+        try:
+            jax.make_jaxpr(
+                lambda a: qar.sync_grads({"w": a}, quantized=False))(g)
+        except Exception:
+            pass  # psum needs a bound axis; accounting already fired
+        s1 = monitor.registry_snapshot()
+        jax.make_jaxpr(
+            lambda a: qar.sync_grads({"w": a}, quantized=True))(g)
+        s2 = monitor.registry_snapshot()
+    fp32_bytes = qar.wire_bytes_per_step(s0, s1)
+    int8_bytes = qar.wire_bytes_per_step(s1, s2)
+    cut = fp32_bytes / int8_bytes
+    assert cut >= 3.5, (
+        f"quantized all-reduce cuts wire bytes only {cut:.2f}x "
+        f"({fp32_bytes} -> {int8_bytes}); needs >= 3.5x")
+
+    # -- BERT smoke: loss-curve convergence vs fp32 --------------------
+    cfg = BertConfig(
+        vocab_size=2048, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=128,
+        max_position_embeddings=64)
+    rng = np.random.RandomState(0)
+    batch, seq, n_pred, steps = 4, 32, 4, 8
+    ids = rng.randint(1, cfg.vocab_size, (batch, seq)).astype("int64")
+    tt = rng.randint(0, 2, (batch, seq)).astype("int64")
+    pos = np.stack([rng.choice(seq, n_pred, replace=False) + i * seq
+                    for i in range(batch)]).reshape(-1).astype("int64")
+    mlm = rng.randint(1, cfg.vocab_size, (batch * n_pred,)).astype("int64")
+    nsp = rng.randint(0, 2, (batch,)).astype("int64")
+
+    def run(flag_on):
+        paddle.set_flags({"quantized_allreduce": flag_on})
+        try:
+            paddle.seed(1)
+            model = BertForPretraining(cfg)
+            crit = BertPretrainingCriterion(cfg.vocab_size)
+            o = opt.AdamW(learning_rate=5e-4,
+                          parameters=model.parameters())
+            step = fjit.train_step(
+                model, o,
+                lambda m, i, t, p, ml, ns: crit(
+                    *m(i, t, masked_positions=p), ml, ns))
+            return [float(np.asarray(step(ids, tt, pos, mlm, nsp)["loss"]))
+                    for _ in range(steps)]
+        finally:
+            paddle.set_flags({"quantized_allreduce": False})
+
+    fp = run(False)
+    q = run(True)
+    assert q[-1] < q[0], f"int8-sync BERT loss did not descend: {q}"
+    delta = max(abs(a - b) for a, b in zip(fp, q))
+    assert delta < 0.05, (
+        f"int8-sync BERT loss curve drifted {delta:.4f} from fp32 "
+        f"(tolerance 0.05)\n  fp32: {fp}\n  int8: {q}")
+    print(f"quant-smoke: all-reduce wire bytes cut {cut:.2f}x "
+          f"({fp32_bytes} -> {int8_bytes}); BERT loss curve within "
+          f"{delta:.4f} of fp32 over {steps} steps")
+
+
+def main():
+    _kernel_parity()
+    _int8_serving()
+    _int8_kv_cache()
+    _quantized_allreduce()
+    print("quant-smoke: OK")
+
+
+if __name__ == "__main__":
+    main()
