@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke
+.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -67,6 +67,9 @@ kernel-smoke:  # fused pallas kernels: numeric parity, zero extra compiles, h2d 
 
 quant-smoke:  # int8 end-to-end: kernel parity, int8 serving, int8 KV cache, quantized all-reduce
 	JAX_PLATFORMS=cpu python tools/quant_smoke.py
+
+spec-smoke:  # speculative decoding: greedy parity, draft+verify compile counts, 2-process prefill->decode handoff
+	JAX_PLATFORMS=cpu python tools/spec_decode_smoke.py
 
 check:
 	python tools/check_op_coverage.py --min-pct 90

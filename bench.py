@@ -1044,6 +1044,21 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
                 last[free] = tok
             if not active:
                 continue
+            if eng.speculative:
+                # one draft+verify round emits 1..k+1 tokens per slot
+                # (truncated at each request's budget, the scheduler
+                # semantics)
+                nxt, counts = eng.spec_step(last, temps,
+                                            busy=list(active))
+                steps += 1
+                for s in list(active):
+                    take = min(int(counts[s]), active[s])
+                    done_tokens += take
+                    last[s] = nxt[s, take - 1]
+                    active[s] -= take
+                    if active[s] <= 0:
+                        del active[s]
+                continue
             nxt = eng.step(last, temps)
             steps += 1
             for s in list(active):
@@ -1080,6 +1095,41 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
     peaks = _cost.device_peaks()
     cont_tps = cont_tokens / cont_dt
     static_tps = static_tokens / static_dt
+
+    # -- speculative decoding on the same sweep (after everything
+    # above closes its accounting): a 1-layer truncated draft proposes
+    # k tokens, the target verifies k+1 in one batched forward — the
+    # decode-is-serial lever. Greedy budgets make the sweep token-count
+    # identical; the per-k engine is warmed LAST so its extra_compiles
+    # reads exactly its own steady state. ---------------------------------
+    from paddle_tpu.models import truncated_draft
+
+    draft = truncated_draft(model, num_layers=1)
+    speculative = {"draft_layers": 1}
+    for k in (2, 4):
+        eng_k = GenerationEngine(model, slots=slots, cache_len=cache_len,
+                                 prefill_buckets=prefill_buckets,
+                                 draft_model=draft, draft_k=k)
+        warm0 = profiler.counters().get(COMPILE_COUNTER, 0)
+        eng_k.warmup()
+        warm_k = profiler.counters().get(COMPILE_COUNTER, 0) - warm0
+        assert warm_k == eng_k.expected_compiles(), (
+            warm_k, eng_k.expected_compiles())
+        spec_tokens, spec_rounds, spec_dt = drive(eng_k, continuous=True)
+        assert spec_tokens == cont_tokens, \
+            "speculative decodes the same sweep"
+        assert eng_k.extra_compiles() == 0, \
+            "speculative decode stays compile-bound"
+        stats = eng_k.spec_stats()
+        spec_tps = spec_tokens / spec_dt
+        speculative[f"k{k}"] = {
+            "tokens_per_sec": round(spec_tps, 1),
+            "ms_per_token": round(1e3 * spec_dt / spec_tokens, 3),
+            "rounds": spec_rounds,
+            "acceptance_rate": stats["acceptance_rate"],
+            "vs_plain_tokens_per_sec": round(spec_tps / cont_tps, 3),
+            "warmup_compiles": warm_k,
+        }
     return {
         "metric": "decode_throughput",
         "value": round(cont_tps, 1),
@@ -1098,6 +1148,7 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
             "ms_per_token": round(1e3 * static_dt / static_tokens, 3),
         },
         "speedup_continuous_vs_static": round(cont_tps / static_tps, 3),
+        "speculative": speculative,
         "kv_cache": {
             "fp32_bytes_per_token": engine.kv_bytes_per_token(),
             "int8_bytes_per_token": engine8.kv_bytes_per_token(),
@@ -1116,6 +1167,182 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
         "mfu_decode": round(
             _cost.mfu(executed / (static_dt + cont_dt), peaks), 6),
         "device_kind": peaks.get("kind"),
+    }
+
+
+def bench_disagg_fleet(requests=36, clients=12):
+    """Disaggregated prefill/decode fleet vs a unified fleet at EQUAL
+    backend count (2 processes each) on a mixed prompt-length sweep.
+
+    Unified: two ``--kind generate`` backends, each splitting its slots
+    between serving decode steps and running its own prefills. Disagg:
+    one ``--kind prefill`` backend (all compute on the bucket-ladder
+    forward, ships KV slabs) + one ``--kind decode`` backend whose
+    capacity is ALL decode slots — the asymmetry disaggregation buys:
+    prefill scales on compute, decode on HBM, so the decode tier
+    dedicates its whole memory budget to slots (2x the unified fleet's
+    total here) where a unified backend must also hold prefill
+    activations and share its loop between the two phases. The router
+    (its own process, like the backends) orchestrates the prompt ->
+    slab -> decode handoff. The offered load oversubscribes the
+    unified fleet's slots (clients > unified slots), which is where
+    the slot-wait tail lives.
+
+    Clients stream (``"stream": true``) so TTFT is measured CLIENT-side
+    — submit to first token line through the router, the number a user
+    sees — under long-budget background generations that keep decode
+    slots busy: the unified fleet's p99 arrival waits for a slot on a
+    loop that is also prefilling, the disaggregated fleet's waits only
+    on the dedicated decode tier. Reports TTFT p50/p99 and
+    tokens/sec(/chip) per fleet shape, with per-backend compile
+    accounting asserted from /loadz (zero unexpected on every process
+    — the handoff path compiles nothing).
+    """
+    import json as _json
+    import signal as _signal
+    import tempfile
+    import threading
+    from urllib.request import Request, urlopen
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (
+        GPTConfig,
+        GPTForCausalLM,
+        save_gpt_model,
+    )
+    from paddle_tpu.serving.scaler import launch_process
+
+    cache_len = 64
+    buckets = "16,64"
+    paddle.seed(7)
+    cfg = GPTConfig(
+        vocab_size=512, hidden_size=128, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=256, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, attention_window=cache_len)
+    gpt_dir = tempfile.mkdtemp(prefix="ptpu_bench_disagg_")
+    save_gpt_model(GPTForCausalLM(cfg), gpt_dir)
+
+    rng = np.random.RandomState(3)
+    prompts = [list(map(int, rng.randint(3, 500, size=int(n))))
+               for n in rng.randint(8, 65, size=requests)]
+    budgets = [int(b) for b in rng.randint(24, 65, size=requests)]
+
+    def boot_backend(kind, slots):
+        args = ["--kind", kind, "--gpt-dir", gpt_dir,
+                "--cache-len", str(cache_len),
+                "--prefill-buckets", buckets,
+                "--slots", str(slots),
+                "--queue-capacity", "64"]
+        return launch_process("paddle_tpu.serving.backend", args,
+                              startup_timeout_s=180)
+
+    def boot_router(urls):
+        args = ["--probe-interval-s", "0.5"]
+        for u in urls:
+            args += ["--backend", u]
+        return launch_process("paddle_tpu.serving.router", args,
+                              startup_timeout_s=120)
+
+    def run_fleet(shape):
+        if shape == "unified":
+            backends = [boot_backend("generate", 3),
+                        boot_backend("generate", 3)]
+        else:
+            backends = [boot_backend("prefill", 1),
+                        boot_backend("decode", 14)]
+        router = boot_router([b.url for b in backends])
+        ttfts, tokens_out, errs = [], [0], []
+        lock = threading.Lock()
+        work = list(zip(prompts, budgets))
+
+        def client(idx):
+            for i in range(idx, len(work), clients):
+                p, b = work[i]
+                body = _json.dumps({
+                    "prompt": p, "max_new_tokens": b,
+                    "temperature": 0.0, "stream": True}).encode()
+                t0 = time.perf_counter()
+                try:
+                    r = urlopen(Request(
+                        router.url + "/generate", data=body,
+                        headers={"Content-Type": "application/json"}),
+                        timeout=300)
+                    first = None
+                    n = 0
+                    for line in r:
+                        msg = _json.loads(line)
+                        if "token" in msg:
+                            if first is None:
+                                first = time.perf_counter() - t0
+                            n += 1
+                        if "error" in msg:
+                            raise RuntimeError(msg["error"])
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errs.append(f"{type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    if first is not None:
+                        ttfts.append(first * 1e3)
+                    tokens_out[0] += n
+
+        try:
+            # settle the prober's kind map before offering load
+            time.sleep(1.5)
+            t0 = time.perf_counter()
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+            assert not errs, errs[:3]
+            assert len(ttfts) == requests, (len(ttfts), requests)
+            # per-process compile accounting: the handoff path must
+            # compile NOTHING beyond each kind's warmup set
+            compiles = {}
+            for b in backends:
+                lz = _json.loads(urlopen(b.url + "/loadz",
+                                         timeout=10).read())
+                assert lz["compiles"]["unexpected"] == 0, (b.url, lz)
+                compiles[lz["kind"]] = lz["compiles"]
+            ttfts.sort()
+            return {
+                "ttft_p50_ms": round(ttfts[len(ttfts) // 2], 1),
+                "ttft_p99_ms": round(ttfts[min(len(ttfts) - 1, int(
+                    len(ttfts) * 0.99))], 1),
+                "tokens_per_sec": round(tokens_out[0] / wall, 1),
+                "tokens_per_sec_per_chip": round(
+                    tokens_out[0] / wall / len(backends), 1),
+                "backends": len(backends),
+                "compiles": compiles,
+            }
+        finally:
+            for h in [router] + backends:
+                try:
+                    h.proc.send_signal(_signal.SIGTERM)
+                except OSError:
+                    pass
+            for h in [router] + backends:
+                try:
+                    h.proc.wait(20)
+                except Exception:  # noqa: BLE001
+                    h.proc.kill()
+
+    unified = run_fleet("unified")
+    disagg = run_fleet("disagg")
+    return {
+        "metric": "disagg_fleet",
+        "value": disagg["ttft_p99_ms"],
+        "unit": "ms (ttft p99, disaggregated)",
+        "requests": requests,
+        "clients": clients,
+        "unified": unified,
+        "disaggregated": disagg,
+        "ttft_p99_disagg_vs_unified": round(
+            disagg["ttft_p99_ms"] / unified["ttft_p99_ms"], 3),
     }
 
 
@@ -1505,8 +1732,11 @@ def main():
     result["tracing_overhead"] = bench_tracing_overhead()
     # online serving: batcher+replicas vs sequential single-request calls
     result["serving_throughput"] = bench_serving_throughput()
-    # generative decoding: continuous vs static batching, mixed lengths
+    # generative decoding: continuous vs static batching, mixed lengths,
+    # speculative draft/verify sub-row (k in {2, 4})
     result["decode_throughput"] = bench_decode_throughput()
+    # disaggregated prefill/decode 2-process fleet vs unified, TTFT p99
+    result["decode_throughput"]["disagg"] = bench_disagg_fleet()
     # serving fleet: 1 -> N backend processes behind the router
     result["router_throughput"] = bench_router_throughput()
     # async snapshot capture on the step path vs blocking saves (target <2%)

@@ -367,6 +367,42 @@ define_flag("generation_queue_capacity", 128,
             "max generation requests queued for decode slots before "
             "rejecting (backpressure: HTTP 429)")
 
+# generation/engine.py — speculative decoding. When enabled (and a
+# draft model is available, e.g. serving/backend.py --draft-dir), every
+# decode round runs the draft chain + ONE batched target verify over
+# draft_k+1 positions instead of one full-model dispatch per token:
+# greedy output stays token-identical to the plain engine, and each
+# round emits 1..draft_k+1 tokens for two dispatches.
+define_flag("speculative_enabled", False,
+            "enable speculative decoding in serving backends that have "
+            "a draft model configured (greedy output is token-identical "
+            "to the plain engine)")
+
+# generation/engine.py — proposals per speculative round. STATIC: k
+# shapes the draft/verify programs (and widens the ring store by k
+# scratch entries), so it is an engine-level knob, not per-request.
+define_flag("speculative_draft_k", 4,
+            "draft tokens proposed per speculative decoding round; "
+            "engine-level — changing it recompiles draft+verify")
+
+# serving/backend.py + serving/server.py — role of a generation backend
+# in a disaggregated fleet. "generate" serves /generate end to end;
+# "prefill" runs only the bucket-ladder forward and ships the KV slab
+# (POST /prefill); "decode" admits handed-off slabs into decode slots
+# (POST /generate_kv). The router composes prefill -> decode for
+# /generate when both tiers are in rotation.
+define_flag("backend_kind", "generate",
+            "generation backend role: generate | prefill | decode "
+            "(disaggregated fleets run distinct prefill/decode tiers)")
+
+# serving/router.py — budget for the prefill leg of a disaggregated
+# /generate (prompt -> KV slab). The decode leg keeps the normal
+# request timeout: prefill is one bounded forward, decode is an open-
+# ended generation.
+define_flag("serving_handoff_timeout_s", 30.0,
+            "router timeout for the prefill->slab leg of a "
+            "disaggregated /generate handoff")
+
 # serving/router.py — period of the router's backend prober (GET
 # /healthz + /loadz per backend): drives load-signal freshness AND the
 # only re-admission path for an evicted backend (readiness must flip

@@ -44,7 +44,14 @@ from .cache import (  # noqa: F401
     prefill_mask,
     stack_layer_caches,
 )
+from .cache import pad_slot_arrays, verify_mask  # noqa: F401
 from .engine import COMPILE_COUNTER, GenerationEngine  # noqa: F401
+from .handoff import (  # noqa: F401
+    HANDOFF_CONTENT_TYPE,
+    HandoffError,
+    pack_kv_slab,
+    unpack_kv_slab,
+)
 from .sampling import decode_loop, sample_logits, top_k_filter  # noqa: F401
 
 __all__ = [
@@ -53,5 +60,7 @@ __all__ = [
     "sample_logits", "top_k_filter", "decode_loop",
     "init_cache", "layer_caches", "stack_layer_caches", "insert_slot",
     "insert_slot_kv", "cache_nbytes", "kv_bytes_per_token",
-    "decode_mask", "prefill_mask",
+    "decode_mask", "prefill_mask", "verify_mask", "pad_slot_arrays",
+    "HandoffError", "pack_kv_slab", "unpack_kv_slab",
+    "HANDOFF_CONTENT_TYPE",
 ]

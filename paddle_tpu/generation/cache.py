@@ -20,6 +20,18 @@ the mask composition that makes the static window numerically exact:
 Everything here is shape-static: the same jitted program serves every
 sequence length, so steady-state generation is compile-bound at
 1 decode compile + one prefill compile per ladder bucket.
+
+**Store vs window** (speculative decoding): the physical ring STORE may
+be wider than the attention WINDOW. A speculative verify step writes
+``k+1`` new entries before attending; with ``store == window`` those
+writes would clobber ring entries still inside an early query's
+sliding window once the ring has wrapped. With ``store >= window + k``
+a write at position ``p`` clobbers position ``p - store <= p - window -
+k``, which no query of the round can still attend — so in-place ring
+writes stay exact. The masks therefore take the physical ``store``
+width and an optional logical ``window`` (default: the store itself,
+the historical behavior), and :func:`verify_mask` composes causality
+across the ``k+1`` in-flight positions with the window constraint.
 """
 from __future__ import annotations
 
@@ -32,7 +44,8 @@ from ..nn.transformer import QuantizedStaticCache, StaticCache
 __all__ = [
     "init_cache", "layer_caches", "stack_layer_caches", "insert_slot",
     "insert_slot_kv", "fresh_layer_caches", "cache_nbytes",
-    "kv_bytes_per_token", "decode_mask", "prefill_mask",
+    "kv_bytes_per_token", "decode_mask", "prefill_mask", "verify_mask",
+    "pad_slot_arrays",
 ]
 
 NEG_INF = -1e9
@@ -135,18 +148,67 @@ def kv_bytes_per_token(num_layers, num_heads, head_dim,
     return 2 * int(num_layers) * int(num_heads) * per_vec
 
 
-def decode_mask(pos, cache_len, dtype="float32"):
-    """Additive ``[B, 1, 1, C]`` mask for one decode step.
+def decode_mask(pos, cache_len, window=None, dtype="float32"):
+    """Additive ``[B, 1, 1, store]`` mask for one decode step.
 
     The step's query (absolute position ``pos``) may attend every cache
-    entry already written INCLUDING itself — entry count after the write
-    is ``min(pos + 1, C)``; once the ring has wrapped, all ``C`` entries
-    are live and hold exactly the last ``C`` tokens (the sliding
-    window).
+    entry already written INCLUDING itself and no further back than
+    ``window`` positions. ``cache_len`` is the physical STORE width;
+    ``window`` defaults to it (the historical store-equals-window
+    behavior: entry count after the write is ``min(pos + 1, C)`` and a
+    wrapped ring holds exactly the last ``C`` tokens). With a wider
+    store (speculative decoding) entry ``j`` holds absolute position
+    ``pos - ((pos - j) mod store)`` — kept iff that distance is inside
+    the window and the entry was ever written.
     """
-    c = int(cache_len)
-    keep = jnp.arange(c)[None, :] < jnp.minimum(pos + 1, c)[:, None]
+    store = int(cache_len)
+    w = store if window is None else int(window)
+    dd = jnp.mod(pos[:, None] - jnp.arange(store)[None, :], store)
+    keep = (dd < w) & (dd <= pos[:, None])
     return jnp.where(keep, 0.0, NEG_INF).astype(dtype)[:, None, None, :]
+
+
+def verify_mask(pos, cache_len, span, window=None, dtype="float32"):
+    """Additive ``[B, 1, span, store]`` mask for a speculative verify
+    step: ``span = k + 1`` queries at absolute positions ``pos .. pos +
+    k``, attending a ring the forward has ALREADY written all ``span``
+    new entries into.
+
+    Query ``i`` keeps entry ``j`` iff the token it holds is causally
+    visible (``dd <= pos + i``, which also hides the q > i in-flight
+    writes: their ring distance is ``store - (q - i) >= window`` by the
+    ``store >= window + k`` allocation) and inside the sliding window
+    (``dd < window``). Row 0 of the span reduces exactly to
+    :func:`decode_mask`.
+    """
+    store = int(cache_len)
+    w = store if window is None else int(window)
+    q = pos[:, None, None] + jnp.arange(int(span))[None, :, None]
+    dd = jnp.mod(q - jnp.arange(store)[None, None, :], store)
+    keep = (dd < w) & (dd <= q)
+    return jnp.where(keep, 0.0, NEG_INF).astype(dtype)[:, None]
+
+
+def pad_slot_arrays(arrays, store):
+    """Zero-pad per-slot cache planes (``[L, H, C, D]`` values /
+    ``[L, H, C]`` scales) from window width ``C`` up to a wider ring
+    ``store`` along the cache axis — a prefill tier's KV slab (always
+    window-wide) landing in a decode tier whose ring carries the
+    speculative scratch margin. Entries past the prompt are never-
+    written zeros on both sides, so padding is exact."""
+    out = []
+    for a in arrays:
+        c = a.shape[2]
+        if c > int(store):
+            raise ValueError(
+                f"slot plane cache axis {c} exceeds the target store "
+                f"{store}")
+        if c < int(store):
+            pad = [(0, 0)] * a.ndim
+            pad[2] = (0, int(store) - c)
+            a = jnp.pad(a, pad)
+        out.append(a)
+    return tuple(out)
 
 
 def prefill_mask(bucket, cache_len, length, dtype="float32"):

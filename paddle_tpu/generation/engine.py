@@ -22,6 +22,34 @@ lowered/compiled through the cost model (so decode MFU lands in the
 counter — warmup snapshots it, and ``extra_compiles()`` must stay 0
 under any traffic mix.
 
+**Speculative decoding** (pass ``draft_model``): decode is memory-bound
+and serial — every token pays one full-model dispatch. A small draft
+GPT proposes ``k`` greedy tokens per slot (one compiled "draft" program
+running the whole chain), and the target model scores all ``k + 1``
+positions in ONE batched forward (the "verify" program): the longest
+proposal prefix matching the target's own sampled chain is accepted,
+and the target sample one past it is emitted as the correction/bonus
+token — so every round emits ``1..k+1`` tokens for two dispatches
+instead of ``1`` per dispatch. Greedy output is token-identical to the
+plain engine by construction (acceptance compares against the target
+argmax chain itself); sampled output draws every emitted token from the
+target's own distribution. Both programs compile once through the
+CompiledStore and the ring cache commit is the same functional index
+update discipline — the physical ring simply carries ``draft_k`` extra
+scratch entries (see generation/cache.py "store vs window") so the
+verify step's in-place span write can never clobber a live window
+entry. Rejected-position writes are garbage but provably masked until
+the next round overwrites them.
+
+**Disaggregated prefill/decode** (``kind`` warmup + KV handoff): a
+prefill-tier engine runs only :meth:`prefill_export` (bucket-ladder
+forward into window-width fresh caches, returning the slot's KV slab +
+first sampled token), a decode-tier engine admits that slab with
+:meth:`admit_prefilled` (pad to the ring store + ``insert_slot_kv``)
+and runs only the decode/speculative programs — prefill scales on
+compute, decode on HBM, and each tier's warmup compiles exactly its
+own program set (``expected_compiles(kind)``).
+
 The engine is single-threaded by design (one decode stream per model
 replica); :mod:`paddle_tpu.serving.continuous` drives it from a slot
 scheduler for continuous batching, and :meth:`generate` runs the same
@@ -30,6 +58,7 @@ slot loop inline for offline use (bench, tests, parity goldens).
 from __future__ import annotations
 
 import itertools
+import threading
 from collections import OrderedDict, deque
 
 import numpy as np
@@ -70,7 +99,8 @@ class GenerationEngine:
     def __init__(self, model, *, slots=None, cache_len=None,
                  prefill_buckets=None, eos_id=None, pad_id=None,
                  max_new_tokens=None, temperature=None, top_k=None,
-                 kv_cache_dtype=None, seed=0):
+                 kv_cache_dtype=None, draft_model=None, draft_k=None,
+                 seed=0):
         # lazy: serving imports generation's scheduler, so module-level
         # imports the other way would cycle
         from ..serving.batcher import parse_buckets
@@ -127,14 +157,68 @@ class GenerationEngine:
         spec = model.cache_spec()
         self._num_layers, self._num_heads, self._head_dim = (
             int(spec[0]), int(spec[1]), int(spec[2]))
+        # speculative decoding: a draft model makes the engine run
+        # draft/verify rounds instead of single-token decode steps. The
+        # physical ring store widens by draft_k scratch entries so the
+        # verify span's in-place writes stay window-exact (cache.py).
+        self.draft_model = draft_model
+        self.speculative = draft_model is not None
+        self.draft_k = int(draft_k if draft_k is not None
+                           else flag("speculative_draft_k"))
+        if self.speculative:
+            if self.draft_k < 1:
+                raise InvalidArgumentError(
+                    f"speculative draft_k must be >= 1, got {self.draft_k}")
+            draft_model.eval()
+            dspec = draft_model.cache_spec()
+            self._draft_layers, self._draft_heads, self._draft_dim = (
+                int(dspec[0]), int(dspec[1]), int(dspec[2]))
+            dcfg = getattr(draft_model, "config", None)
+            self._draft_max_positions = int(getattr(
+                dcfg, "max_position_embeddings", 1 << 30))
+            dvocab = getattr(dcfg, "vocab_size", None)
+            tvocab = getattr(cfg, "vocab_size", None)
+            if dvocab is not None and tvocab is not None \
+                    and int(dvocab) != int(tvocab):
+                raise InvalidArgumentError(
+                    f"draft model vocab ({dvocab}) must match the target "
+                    f"({tvocab}); proposals are target token ids")
+            tmax = int(getattr(cfg, "max_position_embeddings", 1 << 30))
+            if self._draft_max_positions < tmax:
+                # the draft tracks the target's positions exactly; a
+                # shorter draft context would silently gather clamped
+                # position embeddings past its limit (garbage prompt
+                # view, acceptance collapse) — refuse loudly instead
+                raise InvalidArgumentError(
+                    f"draft max_position_embeddings "
+                    f"({self._draft_max_positions}) must cover the "
+                    f"target's ({tmax}); the draft decodes the same "
+                    "positions")
+        self.store_len = self.cache_len + (
+            self.draft_k if self.speculative else 0)
         self._base_key = jax.random.PRNGKey(int(seed))
         self._key_step = 0
+        # prefill_export mutates NO cache state, so a prefill tier runs
+        # it from several HTTP threads at once — only the sampling-key
+        # counter needs a guard (a duplicated ctr would correlate two
+        # requests' samples)
+        self._key_lock = threading.Lock()
+        # speculative acceptance accounting (spec_stats / statz)
+        self._spec_rounds = 0
+        self._spec_proposed = 0
+        self._spec_accepted = 0
         self.reset()
         # eval_step-style snapshot: walk the module tree once, read the
         # live arrays per call (cheap, and parameter updates flow in)
         self._named = None
+        self._draft_named = None
         self._prefill_jit = jax.jit(self._prefill_pure)
+        self._spec_prefill_jit = jax.jit(self._spec_prefill_pure)
         self._decode_jit = jax.jit(self._decode_pure)
+        self._prefill_export_jit = jax.jit(self._prefill_export_pure)
+        self._draft_jit = jax.jit(self._draft_chain_pure)
+        self._verify_jit = jax.jit(self._verify_pure)
+        self._draft_prefill_jit = jax.jit(self._draft_prefill_pure)
         # compiled prefill/decode programs live in the SHARED compiled-
         # callable runtime: AOT compile + cost capture (decode MFU in the
         # /statz ledger) + the flag-governed LRU bound, with every new
@@ -144,7 +228,7 @@ class GenerationEngine:
         self._stores = {
             label: CompiledStore(f"generation_{label}",
                                  miss_counter=COMPILE_COUNTER)
-            for label in ("prefill", "decode")}
+            for label in ("prefill", "decode", "draft", "verify")}
         # deterministic per-engine index for the cache signature (stable
         # cache_key across runs, distinct per engine in the CostRecord
         # registry — two engines may share avals but not weights)
@@ -159,31 +243,53 @@ class GenerationEngine:
 
     # -- functional state -----------------------------------------------------
 
-    def _state(self):
-        if self._named is None:
-            self._named = {
-                "params": [(n, p, getattr(p, "trainable", True))
-                           for n, p in self.model.named_parameters()],
-                "buffers": [(n, b) for n, b in self.model.named_buffers()
-                            if b is not None],
-            }
+    @staticmethod
+    def _snapshot_named(model):
+        return {
+            "params": [(n, p, getattr(p, "trainable", True))
+                       for n, p in model.named_parameters()],
+            "buffers": [(n, b) for n, b in model.named_buffers()
+                        if b is not None],
+        }
+
+    @staticmethod
+    def _named_state(named):
         params, frozen = OrderedDict(), OrderedDict()
-        for n, p, trainable in self._named["params"]:
+        for n, p, trainable in named["params"]:
             (params if trainable else frozen)[n] = p._array
         return {
             "params": params,
             "frozen": frozen,
             "buffers": OrderedDict(
-                (n, b._array) for n, b in self._named["buffers"]),
+                (n, b._array) for n, b in named["buffers"]),
         }
+
+    def _state(self):
+        if self._named is None:
+            self._named = self._snapshot_named(self.model)
+        return self._named_state(self._named)
+
+    def _draft_state(self):
+        if self._draft_named is None:
+            self._draft_named = self._snapshot_named(self.draft_model)
+        return self._named_state(self._draft_named)
 
     def reset(self):
         """Zero every slot (all caches empty, positions 0)."""
         from ..monitor import registry as _mon
 
+        ring_slots = getattr(self, "_ring_slots", self.slots)
         self._kv = _cache.init_cache(
-            self._num_layers, self.slots, self._num_heads, self.cache_len,
+            self._num_layers, ring_slots, self._num_heads, self.store_len,
             self._head_dim, dtype=self.kv_cache_dtype)
+        if self.speculative:
+            # draft ring arrays only — the draft mirrors the target's
+            # committed token history exactly, so ONE shared pos vector
+            # (the target kv's) serves both caches
+            self._kv_draft = _cache.init_cache(
+                self._draft_layers, ring_slots, self._draft_heads,
+                self.store_len, self._draft_dim,
+                dtype=self.kv_cache_dtype)[:-1]
         # the decode-capacity denominators, as registry gauges: what the
         # KV cache costs in HBM lands in /metrics next to the hbm/*
         # gauges it competes with (int8 mode shows the ~4x cut directly)
@@ -195,9 +301,12 @@ class GenerationEngine:
 
     def cache_nbytes(self) -> int:
         """Device bytes the whole decode cache occupies (all slots,
-        values + scales + positions) — the measured side of the
-        int8-vs-f32 HBM claim."""
-        return _cache.cache_nbytes(self._kv)
+        values + scales + positions, plus the draft ring when
+        speculative) — the measured side of the int8-vs-f32 HBM claim."""
+        n = _cache.cache_nbytes(self._kv)
+        if self.speculative:
+            n += _cache.cache_nbytes(self._kv_draft)
+        return n
 
     def kv_bytes_per_token(self) -> int:
         """Cache bytes one decoded token occupies across all layers."""
@@ -229,26 +338,117 @@ class GenerationEngine:
         """Compiles since warmup — steady state must keep this at 0."""
         return self.watch.extra()
 
-    def warmup(self):
-        """Compile every prefill bucket plus the decode step ahead of
-        traffic (exactly ``len(prefill_buckets) + 1`` programs), then
-        snapshot the compile counter. Idempotent."""
+    def expected_compiles(self, kind="generate") -> int:
+        """Exact warmup program count for a backend ``kind``:
+
+        - ``generate`` (unified): one prefill per ladder bucket, plus
+          either the single decode program or the draft + verify pair;
+        - ``prefill`` (disaggregated prefill tier): one prefill-export
+          per bucket, nothing else;
+        - ``decode`` (disaggregated decode tier): the decode (or
+          draft + verify) program(s); a speculative decode tier also
+          compiles one draft-prefill per bucket (the handed-off slab is
+          target-only — the draft's view of the prompt is built at
+          admission).
+        """
+        buckets = len(self.prefill_buckets)
+        decode = 2 if self.speculative else 1
+        if kind == "generate":
+            return buckets + decode
+        if kind == "prefill":
+            return buckets
+        if kind == "decode":
+            return decode + (buckets if self.speculative else 0)
+        raise InvalidArgumentError(
+            f"unknown backend kind {kind!r}; expected generate | "
+            "prefill | decode")
+
+    def warmup(self, kind="generate"):
+        """Compile exactly ``expected_compiles(kind)`` programs ahead
+        of traffic, then snapshot the compile counter. Idempotent."""
         if self.warmed:
             return self
+        self.expected_compiles(kind)  # validates the kind loudly
         with RecordEvent("generation::warmup"):
-            for bucket in self.prefill_buckets:
-                self.admit(0, [self.pad_id] * int(bucket))
-            self.step(np.zeros(self.slots, np.int32),
-                      np.zeros(self.slots, np.float32))
+            if kind in ("generate",):
+                for bucket in self.prefill_buckets:
+                    self.admit(0, [self.pad_id] * int(bucket))
+            elif kind == "prefill":
+                # a prefill tier never decodes: shrink the untouched
+                # decode (and draft) rings to one slot — this tier's
+                # HBM belongs to prefill activations, not a ring
+                # nobody writes (its selling point in disaggregation)
+                self._ring_slots = 1
+                self.reset()
+                for bucket in self.prefill_buckets:
+                    self.prefill_export([self.pad_id] * int(bucket))
+            elif kind == "decode" and self.speculative:
+                for bucket in self.prefill_buckets:
+                    self._admit_draft(0, [self.pad_id] * int(bucket))
+            if kind != "prefill":
+                if kind == "decode":
+                    # pre-drive the handoff admission: the eager
+                    # pad/insert ops pay their one-time op compiles NOW
+                    # (per plane shape), not on the first live slab —
+                    # that cold cost is exactly the TTFT tail the
+                    # disaggregation bench measures
+                    self.admit_prefilled(
+                        0, self._fresh_slot_planes(), 1, 0,
+                        prompt=[self.pad_id] if self.speculative
+                        else None)
+                if self.speculative:
+                    self.spec_step(np.zeros(self.slots, np.int32),
+                                   np.zeros(self.slots, np.float32))
+                else:
+                    self.step(np.zeros(self.slots, np.int32),
+                              np.zeros(self.slots, np.float32))
         self.reset()  # warmup traffic must not look like live context
+        self._spec_rounds = self._spec_proposed = self._spec_accepted = 0
         self.watch.arm()
         self.warmed = True
         _flight.record_event(
-            "generation_warmup", prefill_buckets=list(self.prefill_buckets),
-            slots=self.slots, cache_len=self.cache_len)
+            "generation_warmup", backend_kind=kind,
+            prefill_buckets=list(self.prefill_buckets),
+            slots=self.slots, cache_len=self.cache_len,
+            speculative=self.speculative,
+            programs=self.expected_compiles(kind))
         return self
 
+    def _fresh_slot_planes(self):
+        """Zeroed window-width per-slot planes (a synthetic empty slab
+        — warmup's stand-in for a real handoff)."""
+        return tuple(
+            a[:, 0] for a in _cache.init_cache(
+                self._num_layers, 1, self._num_heads, self.cache_len,
+                self._head_dim, dtype=self.kv_cache_dtype)[:-1])
+
     # -- pure steps (jitted) --------------------------------------------------
+
+    def _prefill_forward(self, model, state, layers, heads, head_dim,
+                         tokens, length):
+        """One bucketed prefill forward into window-width fresh caches:
+        returns (logits ``[1, P, V]``, per-slot planes ``[L, H, C, D]``
+        (+scales)). Shared by target prefill, draft prefill, and the
+        prefill-export program."""
+        p = tokens.shape[1]
+        fresh = _cache.fresh_layer_caches(
+            layers, 1, heads, self.cache_len, head_dim,
+            dtype=self.kv_cache_dtype)
+        mask = _cache.prefill_mask(p, self.cache_len, length)
+        pos_ids = jnp.arange(p, dtype=jnp.int32)[None]
+        (logits, new_caches), _ = functional_call(
+            model, state, tokens,
+            position_ids=pos_ids, attention_mask=mask, caches=fresh)
+        stacked = _cache.stack_layer_caches(new_caches)
+        return logits, tuple(a[:, 0] for a in stacked)
+
+    def _sample_first(self, logits, length, temp, ctr):
+        """Sample the first generated token from the last REAL prompt
+        position of a prefill's logits."""
+        last = jax.lax.dynamic_index_in_dim(
+            logits[0], length - 1, axis=0, keepdims=False)
+        key = jax.random.fold_in(self._base_key, ctr)
+        return sample_logits(last[None], key, temp[None], self.top_k)[0]
 
     def _prefill_pure(self, state, kv, slot, tokens, length, temp, ctr):
         """Bucketed prefill of ONE prompt into decode slot ``slot``.
@@ -256,26 +456,64 @@ class GenerationEngine:
         ``tokens [1, P]`` (P = a ladder bucket), ``length`` = true prompt
         length. Runs the full forward over the bucket with fresh
         per-layer caches, installs the K/V (and, at int8, the scale
-        planes) into the slot, and samples the first generated token
-        from the last REAL prompt position.
+        planes) into the slot (zero-padded from the window width up to
+        the ring store), and samples the first generated token from the
+        last REAL prompt position.
         """
-        p = tokens.shape[1]
-        fresh = _cache.fresh_layer_caches(
-            self._num_layers, 1, self._num_heads, self.cache_len,
-            self._head_dim, dtype=self.kv_cache_dtype)
-        mask = _cache.prefill_mask(p, self.cache_len, length)
-        pos_ids = jnp.arange(p, dtype=jnp.int32)[None]
-        (logits, new_caches), _ = functional_call(
-            self.model, state, tokens,
-            position_ids=pos_ids, attention_mask=mask, caches=fresh)
-        stacked = _cache.stack_layer_caches(new_caches)
+        logits, planes = self._prefill_forward(
+            self.model, state, self._num_layers, self._num_heads,
+            self._head_dim, tokens, length)
         kv = _cache.insert_slot_kv(
-            kv, slot, tuple(a[:, 0] for a in stacked), length)
-        last = jax.lax.dynamic_index_in_dim(
-            logits[0], length - 1, axis=0, keepdims=False)
-        key = jax.random.fold_in(self._base_key, ctr)
-        tok = sample_logits(last[None], key, temp[None], self.top_k)[0]
+            kv, slot, _cache.pad_slot_arrays(planes, self.store_len),
+            length)
+        tok = self._sample_first(logits, length, temp, ctr)
         return kv, tok
+
+    def _spec_prefill_pure(self, state, dstate, kv, kv_draft, slot,
+                           tokens, length, temp, ctr):
+        """Speculative twin of :meth:`_prefill_pure`: ONE program
+        prefills the prompt through BOTH models — the draft ring must
+        hold the same committed history as the target's before the
+        first draft chain runs."""
+        logits, planes = self._prefill_forward(
+            self.model, state, self._num_layers, self._num_heads,
+            self._head_dim, tokens, length)
+        kv = _cache.insert_slot_kv(
+            kv, slot, _cache.pad_slot_arrays(planes, self.store_len),
+            length)
+        _, dplanes = self._prefill_forward(
+            self.draft_model, dstate, self._draft_layers,
+            self._draft_heads, self._draft_dim, tokens, length)
+        kv_draft = tuple(
+            a.at[:, slot].set(n) for a, n in zip(
+                kv_draft,
+                _cache.pad_slot_arrays(dplanes, self.store_len)))
+        tok = self._sample_first(logits, length, temp, ctr)
+        return kv, kv_draft, tok
+
+    def _prefill_export_pure(self, state, tokens, length, temp, ctr):
+        """Prefill-tier program: the bucketed forward WITHOUT a decode
+        ring — returns the window-width per-slot KV planes (the handoff
+        slab) and the first sampled token. The decode tier lands the
+        slab with :meth:`admit_prefilled`."""
+        logits, planes = self._prefill_forward(
+            self.model, state, self._num_layers, self._num_heads,
+            self._head_dim, tokens, length)
+        tok = self._sample_first(logits, length, temp, ctr)
+        return planes, tok
+
+    def _draft_prefill_pure(self, dstate, kv_draft, slot, tokens,
+                            length):
+        """Draft-only prefill into draft slot ``slot`` — a decode-tier
+        engine admitting a handed-off TARGET slab still needs the
+        draft's view of the prompt before it can speculate on it."""
+        _, dplanes = self._prefill_forward(
+            self.draft_model, dstate, self._draft_layers,
+            self._draft_heads, self._draft_dim, tokens, length)
+        return tuple(
+            a.at[:, slot].set(n) for a, n in zip(
+                kv_draft,
+                _cache.pad_slot_arrays(dplanes, self.store_len)))
 
     def _decode_pure(self, state, kv, tokens, temps, ctr):
         """One decode step for EVERY slot: ``tokens [S]`` (each slot's
@@ -284,7 +522,8 @@ class GenerationEngine:
         caches = _cache.layer_caches(*kv)
         pos = kv[-1]
         pos_ids = jnp.minimum(pos, self.max_positions - 1)[:, None]
-        mask = _cache.decode_mask(pos, self.cache_len)
+        mask = _cache.decode_mask(pos, self.store_len,
+                                  window=self.cache_len)
         (logits, new_caches), _ = functional_call(
             self.model, state, tokens[:, None],
             position_ids=pos_ids, attention_mask=mask, caches=caches)
@@ -292,6 +531,75 @@ class GenerationEngine:
         key = jax.random.fold_in(self._base_key, ctr)
         nxt = sample_logits(logits[:, 0], key, temps, self.top_k)
         return kv, nxt
+
+    def _draft_chain_pure(self, dstate, kv_draft, pos, tokens):
+        """The draft program: ``k`` greedy proposals per slot from one
+        dispatch. ``k + 1`` chained single-token draft decode steps —
+        step ``j`` writes its input token's K/V at ``pos + j`` (so the
+        draft ring ends the round holding the FULL proposed chain,
+        including the last proposal: on full acceptance the draft's
+        committed history still mirrors the target's) and feeds its
+        argmax forward. Returns (draft arrays, proposals ``[S, k]``)."""
+        caches = _cache.layer_caches(*(kv_draft + (pos,)))
+        cur = tokens
+        proposals = []
+        for j in range(self.draft_k + 1):
+            pj = pos + j
+            pos_ids = jnp.minimum(pj, self._draft_max_positions - 1)[:, None]
+            mask = _cache.decode_mask(pj, self.store_len,
+                                      window=self.cache_len)
+            (logits, caches), _ = functional_call(
+                self.draft_model, dstate, cur[:, None],
+                position_ids=pos_ids, attention_mask=mask, caches=caches)
+            nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+            if j < self.draft_k:
+                proposals.append(nxt)
+            cur = nxt
+        return (_cache.stack_layer_caches(caches),
+                jnp.stack(proposals, axis=1))
+
+    def _verify_pure(self, state, kv, tokens, proposals, temps, ctr):
+        """The verify program: ONE batched target forward over all
+        ``k + 1`` in-flight positions of every slot.
+
+        Inputs ``[S, k+1] = [last committed token | k proposals]`` write
+        their K/V into the ring span ``pos .. pos+k`` (in place —
+        window-exact by the store margin) and produce logits at every
+        position; the target's own sampled chain ``ts`` decides
+        acceptance: the longest proposal prefix with ``proposal[i] ==
+        ts[i]`` is accepted and ``ts[m]`` (the sample one past it) is
+        the correction/bonus token, so the round emits ``ts[:, :m+1]``
+        — exactly the token sequence the plain engine would have
+        produced one dispatch at a time (greedy: ``ts`` IS the argmax
+        chain). ``pos`` advances by the emitted count; rejected-position
+        ring writes are left as masked garbage for the next round's
+        span to overwrite."""
+        span = self.draft_k + 1
+        seq = jnp.concatenate([tokens[:, None], proposals], axis=1)
+        caches = _cache.layer_caches(*kv)
+        pos = kv[-1]
+        pos_ids = jnp.minimum(
+            pos[:, None] + jnp.arange(span, dtype=jnp.int32)[None, :],
+            self.max_positions - 1)
+        mask = _cache.verify_mask(pos, self.store_len, span,
+                                  window=self.cache_len)
+        (logits, new_caches), _ = functional_call(
+            self.model, state, seq,
+            position_ids=pos_ids, attention_mask=mask, caches=caches)
+        key = jax.random.fold_in(self._base_key, ctr)
+        ts = jnp.stack(
+            [sample_logits(logits[:, i], jax.random.fold_in(key, i),
+                           temps, self.top_k) for i in range(span)],
+            axis=1)
+        match = (proposals == ts[:, :self.draft_k]).astype(jnp.int32)
+        # cumprod/sum promote int32 -> int64 under x64 mode; the pos
+        # vector's dtype is part of every program's signature, so pin
+        # it or the second round re-compiles everything downstream
+        accepted = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        counts = (accepted + 1).astype(jnp.int32)
+        kv = _cache.stack_layer_caches(new_caches) + (
+            (pos + counts).astype(jnp.int32),)
+        return kv, ts, counts
 
     # -- scheduler primitives -------------------------------------------------
 
@@ -323,25 +631,114 @@ class GenerationEngine:
                 f"{self.max_positions}")
         return n
 
-    def admit(self, slot, prompt, temperature=None) -> int:
-        """Prefill ``prompt`` into ``slot`` and return the first sampled
-        token. The slot's previous occupant is simply overwritten — a
-        vacated slot needs no reset pass."""
+    def _padded_prompt(self, prompt):
         n = len(prompt)
         bucket = self.bucket_for(n)
         padded = np.full(bucket, self.pad_id, np.int32)
         padded[:n] = np.asarray(prompt, np.int32)
+        return padded, n
+
+    def admit(self, slot, prompt, temperature=None) -> int:
+        """Prefill ``prompt`` into ``slot`` and return the first sampled
+        token. The slot's previous occupant is simply overwritten — a
+        vacated slot needs no reset pass. Speculative engines prefill
+        the draft ring in the same program."""
+        padded, n = self._padded_prompt(prompt)
         temp = (self.default_temperature if temperature is None
                 else float(temperature))
         self._key_step += 1
         with RecordEvent("generation::prefill"):
-            out = self._dispatch("prefill", self._prefill_jit, (
-                self._state(), self._kv,
-                jnp.asarray(slot, jnp.int32), jnp.asarray(padded[None]),
-                jnp.asarray(n, jnp.int32), jnp.asarray(temp, jnp.float32),
-                jnp.asarray(self._key_step, jnp.int32)))
-        self._kv, tok = out
+            if self.speculative:
+                out = self._dispatch("prefill", self._spec_prefill_jit, (
+                    self._state(), self._draft_state(), self._kv,
+                    self._kv_draft, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(padded[None]), jnp.asarray(n, jnp.int32),
+                    jnp.asarray(temp, jnp.float32),
+                    jnp.asarray(self._key_step, jnp.int32)))
+                self._kv, self._kv_draft, tok = out
+            else:
+                out = self._dispatch("prefill", self._prefill_jit, (
+                    self._state(), self._kv,
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(padded[None]),
+                    jnp.asarray(n, jnp.int32),
+                    jnp.asarray(temp, jnp.float32),
+                    jnp.asarray(self._key_step, jnp.int32)))
+                self._kv, tok = out
         return int(tok)
+
+    def prefill_export(self, prompt, temperature=None):
+        """Prefill-tier primitive: run the bucketed forward and return
+        ``(planes, length, first_token)`` — the window-width per-slot
+        KV planes (``[L, H, C, D]`` values, ``[L, H, C]`` scales at
+        int8), the true prompt length, and the first sampled token.
+        The slab ships to a decode tier (:mod:`generation.handoff`)
+        whose :meth:`admit_prefilled` lands it in a free slot."""
+        padded, n = self._padded_prompt(prompt)
+        temp = (self.default_temperature if temperature is None
+                else float(temperature))
+        with self._key_lock:
+            self._key_step += 1
+            ctr = self._key_step
+        with RecordEvent("generation::prefill_export"):
+            planes, tok = self._dispatch(
+                "prefill", self._prefill_export_jit, (
+                    self._state(), jnp.asarray(padded[None]),
+                    jnp.asarray(n, jnp.int32),
+                    jnp.asarray(temp, jnp.float32),
+                    jnp.asarray(ctr, jnp.int32)))
+        return planes, n, int(tok)
+
+    def _admit_draft(self, slot, prompt):
+        """Draft-only prefill of ``prompt`` into draft slot ``slot`` —
+        the decode-tier half of a speculative handoff admission."""
+        padded, n = self._padded_prompt(prompt)
+        with RecordEvent("generation::draft_prefill"):
+            self._kv_draft = self._dispatch(
+                "prefill", self._draft_prefill_jit, (
+                    self._draft_state(), self._kv_draft,
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(padded[None]),
+                    jnp.asarray(n, jnp.int32)))
+
+    def admit_prefilled(self, slot, planes, length, first_token,
+                        prompt=None) -> int:
+        """Land a handed-off KV slab in decode slot ``slot``: pad the
+        window-width planes up to the ring store and commit them with
+        the same functional indexed update admission always uses. The
+        first token was already sampled by the prefill tier; it is
+        returned unchanged for scheduler uniformity. A speculative
+        engine additionally needs the PROMPT tokens (the slab is
+        target-only) to build the draft's view via a draft prefill."""
+        length = int(length)
+        if not 1 <= length <= self.cache_len:
+            raise InvalidArgumentError(
+                f"handoff length {length} outside [1, {self.cache_len}]")
+        arity = len(self._kv) - 1
+        if len(planes) != arity:
+            raise InvalidArgumentError(
+                f"handoff slab has {len(planes)} planes, this engine's "
+                f"{self.kv_cache_dtype} cache needs {arity} "
+                "(kv_cache_dtype mismatch between tiers?)")
+        padded = _cache.pad_slot_arrays(
+            tuple(jnp.asarray(p) for p in planes), self.store_len)
+        for a, p in zip(self._kv[:-1], padded):
+            if tuple(p.shape) != tuple(a.shape[:1] + a.shape[2:]) \
+                    or p.dtype != a.dtype:
+                raise InvalidArgumentError(
+                    f"handoff slab plane {tuple(p.shape)}/{p.dtype} does "
+                    f"not fit this engine's cache "
+                    f"{tuple(a.shape)}/{a.dtype}")
+        if self.speculative:
+            if prompt is None:
+                raise InvalidArgumentError(
+                    "a speculative decode tier needs the prompt tokens "
+                    "with the KV slab (the draft ring must be prefilled)")
+            self._admit_draft(slot, prompt)
+        with RecordEvent("generation::admit_prefilled"):
+            self._kv = _cache.insert_slot_kv(
+                self._kv, slot, padded, length)
+        return int(first_token)
 
     def step(self, tokens, temps) -> np.ndarray:
         """Decode one token for every slot. ``tokens``/``temps`` are
@@ -356,6 +753,62 @@ class GenerationEngine:
                 jnp.asarray(self._key_step, jnp.int32)))
         self._kv, nxt = out
         return np.asarray(nxt)
+
+    def spec_step(self, tokens, temps, busy=None):
+        """One speculative round for every slot: draft program (k
+        proposals per slot) then verify program (one batched target
+        forward over all k+1 positions). Returns ``(emitted [S, k+1],
+        counts [S])`` — slot ``s`` produced ``emitted[s, :counts[s]]``
+        new tokens this round (the caller truncates at EOS/budget).
+        ``busy`` (slot indices, or None for all) scopes the acceptance
+        accounting to slots actually generating."""
+        if not self.speculative:
+            raise InvalidArgumentError(
+                "spec_step needs a draft model; construct the engine "
+                "with draft_model= (FLAGS_speculative_enabled)")
+        toks = jnp.asarray(np.asarray(tokens, np.int32))
+        pos = self._kv[-1]
+        with RecordEvent("generation::draft"):
+            self._kv_draft, proposals = self._dispatch(
+                "draft", self._draft_jit, (
+                    self._draft_state(), self._kv_draft, pos, toks))
+        self._key_step += 1
+        with RecordEvent("generation::verify"):
+            out = self._dispatch("verify", self._verify_jit, (
+                self._state(), self._kv, toks, proposals,
+                jnp.asarray(np.asarray(temps, np.float32)),
+                jnp.asarray(self._key_step, jnp.int32)))
+        self._kv, ts, counts = out
+        counts = np.asarray(counts)
+        n_busy = self.slots if busy is None else len(busy)
+        if n_busy:
+            accepted = int(counts.sum() - self.slots if busy is None
+                           else sum(int(counts[s]) - 1 for s in busy))
+            self._spec_rounds += 1
+            self._spec_proposed += self.draft_k * n_busy
+            self._spec_accepted += accepted
+            from ..monitor import counter as _mcounter
+
+            _mcounter("generation/spec_rounds_total").inc()
+            _mcounter("generation/spec_proposed_total").inc(
+                self.draft_k * n_busy)
+            _mcounter("generation/spec_accepted_total").inc(accepted)
+        return np.asarray(ts), counts
+
+    def spec_stats(self) -> dict:
+        """Speculative acceptance accounting since the last reset/
+        warmup: rounds, proposed/accepted draft tokens, acceptance
+        rate (the /statz block)."""
+        return {
+            "enabled": self.speculative,
+            "draft_k": self.draft_k if self.speculative else 0,
+            "rounds": self._spec_rounds,
+            "proposed": self._spec_proposed,
+            "accepted": self._spec_accepted,
+            "acceptance_rate": round(
+                self._spec_accepted / self._spec_proposed, 4)
+            if self._spec_proposed else None,
+        }
 
     # -- offline API ----------------------------------------------------------
 
@@ -399,12 +852,26 @@ class GenerationEngine:
                     last[slot] = tok
             if not active:
                 continue
-            nxt = self.step(last, temps)
-            for slot in list(active):
-                idx, tokens = active[slot]
-                tokens.append(int(nxt[slot]))
-                last[slot] = nxt[slot]
-                if finished(tokens):
-                    results[idx] = tokens
-                    del active[slot]
+            if self.speculative:
+                ts, counts = self.spec_step(last, temps,
+                                            busy=list(active))
+                for slot in list(active):
+                    idx, tokens = active[slot]
+                    for i in range(int(counts[slot])):
+                        tokens.append(int(ts[slot, i]))
+                        last[slot] = ts[slot, i]
+                        if finished(tokens):
+                            break
+                    if finished(tokens):
+                        results[idx] = tokens
+                        del active[slot]
+            else:
+                nxt = self.step(last, temps)
+                for slot in list(active):
+                    idx, tokens = active[slot]
+                    tokens.append(int(nxt[slot]))
+                    last[slot] = nxt[slot]
+                    if finished(tokens):
+                        results[idx] = tokens
+                        del active[slot]
         return results

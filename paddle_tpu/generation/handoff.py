@@ -1,0 +1,141 @@
+"""KV-slab wire format for disaggregated prefill -> decode handoff.
+
+A prefill-tier backend runs the bucket-ladder forward and ships the
+admitted slot's KV planes to a decode-tier backend over the existing
+backend HTTP channel (``POST /generate_kv``, octet-stream body). The
+slab is self-describing and paranoid:
+
+``PTKV | version u16 | header_len u32 | header JSON | payload | crc32``
+
+- the header names every plane's shape/dtype plus the cache geometry
+  (layers/heads/head_dim/cache_len/kv dtype) and the generation
+  parameters riding along (first token, prompt length, max_new_tokens,
+  temperature, stream, and — for speculative decode tiers — the prompt
+  tokens themselves, since the slab is target-model-only);
+- the payload is the planes' raw bytes back to back, C-order;
+- the trailing CRC32 covers header + payload, so a truncated or
+  corrupted body is REJECTED at unpack (:class:`HandoffError` -> HTTP
+  400), never half-inserted into a decode slot.
+
+Both cache modes serialize: fp32 slabs carry 2 planes (k, v — each
+``[L, H, C, D]``), int8 slabs carry 4 (int8 k/v + f32 per-head scale
+planes ``[L, H, C]``, the :class:`nn.QuantizedStaticCache` layout from
+the quantization PR). The decode tier validates arity and geometry
+against its OWN engine before ``insert_slot_kv`` commits anything.
+"""
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+
+import numpy as np
+
+from ..errors import InvalidArgumentError
+
+__all__ = ["HandoffError", "pack_kv_slab", "unpack_kv_slab",
+           "HANDOFF_CONTENT_TYPE"]
+
+_MAGIC = b"PTKV"
+_VERSION = 1
+_HEAD = struct.Struct(">4sHI")  # magic, version, header_len
+_CRC = struct.Struct(">I")
+
+#: the /generate_kv request body content type
+HANDOFF_CONTENT_TYPE = "application/x-ptpu-kv-slab"
+
+
+class HandoffError(InvalidArgumentError):
+    """The slab failed validation (truncated, corrupt, or the wrong
+    geometry for the receiving engine). Maps to HTTP 400 — the payload
+    is unusable, retrying elsewhere cannot help."""
+
+
+def pack_kv_slab(planes, length, first_token, meta=None) -> bytes:
+    """Serialize one slot's KV planes plus riding metadata.
+
+    ``planes`` are the window-width per-slot arrays from
+    ``GenerationEngine.prefill_export`` (jax or numpy; 2 for fp32, 4
+    for int8). ``length`` is the true prompt length, ``first_token``
+    the prefill tier's sampled token. ``meta`` is an arbitrary
+    JSON-able dict (generation params, cache geometry).
+    """
+    arrs = [np.ascontiguousarray(np.asarray(p)) for p in planes]
+    header = {
+        "planes": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                   for a in arrs],
+        "length": int(length),
+        "first_token": int(first_token),
+        "meta": dict(meta or {}),
+    }
+    hbytes = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    payload = b"".join(a.tobytes() for a in arrs)
+    body = _HEAD.pack(_MAGIC, _VERSION, len(hbytes)) + hbytes + payload
+    return body + _CRC.pack(zlib.crc32(body) & 0xFFFFFFFF)
+
+
+def unpack_kv_slab(data: bytes):
+    """Parse and VALIDATE a slab: returns ``(planes, length,
+    first_token, meta)`` with planes as numpy arrays. Raises
+    :class:`HandoffError` on any structural problem — magic, version,
+    size arithmetic, or CRC mismatch (truncation and corruption both
+    land here)."""
+    if len(data) < _HEAD.size + _CRC.size:
+        raise HandoffError(
+            f"KV slab truncated: {len(data)} bytes is smaller than the "
+            "fixed framing")
+    magic, version, hlen = _HEAD.unpack_from(data, 0)
+    if magic != _MAGIC:
+        raise HandoffError("not a KV slab (bad magic)")
+    if version != _VERSION:
+        raise HandoffError(
+            f"KV slab version {version} unsupported (this build speaks "
+            f"{_VERSION})")
+    body, crc_bytes = data[:-_CRC.size], data[-_CRC.size:]
+    (crc,) = _CRC.unpack(crc_bytes)
+    if zlib.crc32(body) & 0xFFFFFFFF != crc:
+        raise HandoffError(
+            "KV slab checksum mismatch (truncated or corrupted payload)")
+    if _HEAD.size + hlen > len(body):
+        raise HandoffError("KV slab header overruns the payload")
+    try:
+        header = json.loads(body[_HEAD.size:_HEAD.size + hlen])
+        specs = header["planes"]
+        length = int(header["length"])
+        first_token = int(header["first_token"])
+        meta = dict(header.get("meta") or {})
+    except (ValueError, KeyError, TypeError) as e:
+        raise HandoffError(f"KV slab header malformed: {e}") from None
+    off = _HEAD.size + hlen
+    planes = []
+    for spec in specs:
+        try:
+            shape = tuple(int(d) for d in spec["shape"])
+            dtype = np.dtype(spec["dtype"])
+        except (ValueError, KeyError, TypeError) as e:
+            raise HandoffError(
+                f"KV slab plane spec malformed: {e}") from None
+        if dtype.kind not in "fiu" or any(d < 0 for d in shape):
+            # only numeric planes can come off a wire buffer — an
+            # "object" dtype (CRC-valid header, hostile or buggy
+            # sender) would crash frombuffer with a raw ValueError
+            # instead of the 400 this module promises
+            raise HandoffError(
+                f"KV slab plane spec invalid: dtype {dtype}, "
+                f"shape {shape}")
+        n = int(np.prod(shape)) * dtype.itemsize
+        if off + n > len(body):
+            raise HandoffError(
+                "KV slab payload shorter than its plane specs")
+        try:
+            planes.append(np.frombuffer(body, dtype=dtype, count=int(
+                np.prod(shape)), offset=off).reshape(shape))
+        except (ValueError, TypeError) as e:
+            raise HandoffError(
+                f"KV slab plane unreadable: {e}") from None
+        off += n
+    if off != len(body):
+        raise HandoffError(
+            f"KV slab carries {len(body) - off} trailing bytes beyond "
+            "its plane specs")
+    return tuple(planes), length, first_token, meta

@@ -39,6 +39,9 @@ from .gpt import (  # noqa: F401
     GPTForCausalLM,
     GPTModel,
     gpt_tiny_config,
+    load_gpt_model,
+    save_gpt_model,
+    truncated_draft,
 )
 from .se_resnext import (  # noqa: F401
     SEResNeXt,

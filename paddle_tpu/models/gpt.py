@@ -30,7 +30,8 @@ from ..nn.layers import Dropout, Embedding, LayerList, LayerNorm
 from ..nn.transformer import TransformerDecoderLayer, causal_mask
 from .bert import _init_bert_weights
 
-__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny_config"]
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "gpt_tiny_config",
+           "save_gpt_model", "load_gpt_model", "truncated_draft"]
 
 
 @dataclass
@@ -155,3 +156,71 @@ class GPTForCausalLM(Layer):
         cfg = self.config
         return (cfg.num_hidden_layers, cfg.num_attention_heads,
                 cfg.hidden_size // cfg.num_attention_heads)
+
+
+# ---------------------------------------------------------------------------
+# persistence + draft construction (serving fleets)
+# ---------------------------------------------------------------------------
+
+
+def save_gpt_model(model: "GPTForCausalLM", dirname):
+    """Persist a causal LM as ``config.json`` + ``model.pdparams`` —
+    the unit a generation backend process boots from
+    (``python -m paddle_tpu.serving.backend --kind generate --gpt-dir
+    DIR``), and the shape a draft-model directory takes
+    (``--draft-dir``)."""
+    import dataclasses
+    import json
+    import os
+
+    from ..framework.serialization import save
+
+    os.makedirs(dirname, exist_ok=True)
+    cfg = dataclasses.asdict(model.config)
+    with open(os.path.join(dirname, "config.json"), "w") as f:
+        json.dump(cfg, f, indent=1, sort_keys=True)
+    save(model.state_dict(), os.path.join(dirname, "model.pdparams"))
+    return dirname
+
+
+def load_gpt_model(dirname) -> "GPTForCausalLM":
+    """Rebuild a :func:`save_gpt_model` directory into a ready
+    :class:`GPTForCausalLM` (eval mode)."""
+    import json
+    import os
+
+    from ..framework.serialization import load
+
+    with open(os.path.join(dirname, "config.json")) as f:
+        cfg = GPTConfig(**json.load(f))
+    model = GPTForCausalLM(cfg)
+    model.set_state_dict(load(os.path.join(dirname, "model.pdparams")))
+    model.eval()
+    return model
+
+
+def truncated_draft(model: "GPTForCausalLM",
+                    num_layers: int = 1) -> "GPTForCausalLM":
+    """A layer-skip draft for speculative decoding: the target's
+    embeddings, FIRST ``num_layers`` decoder layers, final norm, and
+    (tied) LM head, copied into a shallower GPT.
+
+    Because the residual stream is dominated by the embedding path, the
+    truncated stack's argmax agrees with the full model's far more
+    often than chance — a distillation-free draft in the
+    self-speculative-decoding spirit, and the default draft the bench
+    and smoke use. For production the draft is any separately trained
+    small GPT sharing the vocab (``--draft-dir``).
+    """
+    import dataclasses
+
+    cfg = dataclasses.replace(model.config,
+                              num_hidden_layers=int(num_layers))
+    draft = GPTForCausalLM(cfg)
+    src = model.state_dict()
+    own = draft.state_dict()
+    draft.set_state_dict({
+        k: src[k] for k, v in own.items()
+        if k in src and tuple(src[k].shape) == tuple(v.shape)})
+    draft.eval()
+    return draft

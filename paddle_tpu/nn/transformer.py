@@ -11,7 +11,6 @@ from typing import Any, NamedTuple
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from .. import ops
@@ -305,10 +304,12 @@ class MultiHeadAttention(Layer):
 
         Decode (Lq == 1): every row writes its own ring index
         ``pos % C`` — a batched scatter, so co-batched sequences at
-        different positions share one program. Prefill (Lq > 1): the
-        whole span lands at the shared start offset (fresh slots start
-        at pos == 0; ring-wrap writes are decode-only by construction —
-        the engine admits prompts no longer than the cache window).
+        different positions share one program. Multi-token (Lq > 1,
+        prefill and speculative verify): each row writes its span at
+        its OWN offset ``(pos + t) % C`` — the same batched scatter
+        over a ``[B, T]`` index plane, so per-slot positions may differ
+        and the span may wrap the ring (the verify step's window-exact
+        in-place write; see generation/cache.py "store vs window").
         """
         if isinstance(cache, QuantizedStaticCache):
             return self._update_quantized_cache(cache, k, v)
@@ -324,9 +325,13 @@ class MultiHeadAttention(Layer):
             kc = kc.at[rows, :, idx, :].set(kn[:, :, 0, :])
             vc = vc.at[rows, :, idx, :].set(vn[:, :, 0, :])
         else:
-            start = jnp.mod(pos[0], c)
-            kc = jax.lax.dynamic_update_slice_in_dim(kc, kn, start, axis=2)
-            vc = jax.lax.dynamic_update_slice_in_dim(vc, vn, start, axis=2)
+            t = kn.shape[2]
+            rows = jnp.arange(kc.shape[0])[:, None]
+            idx = jnp.mod(pos[:, None] + jnp.arange(t)[None, :], c)
+            # advanced indices split by the H slice put the [B, T] index
+            # dims first, so the payload transposes to [B, T, H, D]
+            kc = kc.at[rows, :, idx, :].set(jnp.moveaxis(kn, 2, 1))
+            vc = vc.at[rows, :, idx, :].set(jnp.moveaxis(vn, 2, 1))
         return (Tensor._from_array(kc), Tensor._from_array(vc),
                 StaticCache(kc, vc, pos))
 
@@ -356,12 +361,13 @@ class MultiHeadAttention(Layer):
             ks = ks.at[rows, :, idx].set(ksc[:, :, 0])
             vs = vs.at[rows, :, idx].set(vsc[:, :, 0])
         else:
-            start = jnp.mod(pos[0], c)
-            dus = jax.lax.dynamic_update_slice_in_dim
-            kc = dus(kc, kq, start, axis=2)
-            vc = dus(vc, vq, start, axis=2)
-            ks = dus(ks, ksc, start, axis=2)
-            vs = dus(vs, vsc, start, axis=2)
+            t = kn.shape[2]
+            rows = jnp.arange(kc.shape[0])[:, None]
+            idx = jnp.mod(pos[:, None] + jnp.arange(t)[None, :], c)
+            kc = kc.at[rows, :, idx, :].set(jnp.moveaxis(kq, 2, 1))
+            vc = vc.at[rows, :, idx, :].set(jnp.moveaxis(vq, 2, 1))
+            ks = ks.at[rows, :, idx].set(jnp.moveaxis(ksc, 2, 1))
+            vs = vs.at[rows, :, idx].set(jnp.moveaxis(vsc, 2, 1))
         kf = dequantize_kv(kc, ks, out_dtype)
         vf = dequantize_kv(vc, vs, out_dtype)
         return (Tensor._from_array(kf), Tensor._from_array(vf),

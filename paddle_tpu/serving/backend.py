@@ -36,10 +36,31 @@ def _parse_args(argv):
     p = argparse.ArgumentParser(
         prog="paddle_tpu.serving.backend",
         description="boot one serving backend process over a saved "
-                    "inference model")
-    p.add_argument("--model-dir", required=True,
+                    "inference model (predict) or a saved GPT "
+                    "(generate / prefill / decode)")
+    p.add_argument("--kind", default="predict",
+                   choices=("predict", "generate", "prefill", "decode"),
+                   help="backend role: predict serves /predict over "
+                        "--model-dir; the generation kinds serve a "
+                        "causal LM from --gpt-dir (prefill/decode are "
+                        "the disaggregated tiers)")
+    p.add_argument("--model-dir", default=None,
                    help="directory produced by jit.save / "
-                        "save_inference_model")
+                        "save_inference_model (predict kind)")
+    p.add_argument("--gpt-dir", default=None,
+                   help="directory produced by models.save_gpt_model "
+                        "(generation kinds)")
+    p.add_argument("--draft-dir", default=None,
+                   help="draft-model directory (save_gpt_model) — "
+                        "enables speculative decoding on generate/"
+                        "decode kinds when FLAGS_speculative_enabled "
+                        "or --speculative is set")
+    p.add_argument("--speculative", action="store_true",
+                   help="enable speculative decoding (needs "
+                        "--draft-dir)")
+    p.add_argument("--draft-k", type=int, default=None,
+                   help="proposals per speculative round (default: "
+                        "FLAGS_speculative_draft_k)")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0,
                    help="0 binds an ephemeral port (see --port-file)")
@@ -54,12 +75,38 @@ def _parse_args(argv):
     p.add_argument("--mesh-dp", type=int, default=0,
                    help="shard the backend over an N-device dp mesh "
                         "(0: unsharded)")
-    return p.parse_args(argv)
+    # generation-engine knobs (generation kinds only)
+    p.add_argument("--slots", type=int, default=None,
+                   help="decode slots (generation kinds)")
+    p.add_argument("--cache-len", type=int, default=None,
+                   help="KV window (generation kinds)")
+    p.add_argument("--prefill-buckets", default=None,
+                   help="comma-separated prompt-length ladder "
+                        "(generation kinds)")
+    p.add_argument("--kv-cache-dtype", default=None,
+                   help="float32 | int8 (generation kinds; handoff "
+                        "tiers must match)")
+    args = p.parse_args(argv)
+    if args.kind == "predict" and not args.model_dir:
+        p.error("--kind predict needs --model-dir")
+    if args.kind != "predict" and not args.gpt_dir:
+        p.error(f"--kind {args.kind} needs --gpt-dir")
+    if args.speculative and not args.draft_dir:
+        # silently booting a PLAIN engine here would leave the operator
+        # believing speculation is on (only /statz would tell)
+        p.error("--speculative needs --draft-dir")
+    return args
 
 
 def build_server(args):
-    """Predictor (optionally GSPMD-sharded) + InferenceServer, not yet
-    started — split from :func:`main` so tests can drive it in-process."""
+    """Server for the requested kind, not yet started — split from
+    :func:`main` so tests can drive it in-process. ``predict`` builds
+    the Predictor/InferenceServer stack; the generation kinds build a
+    GenerationEngine (optionally speculative) under a
+    :class:`GenerationServer` whose role gates its routes and warmup
+    program set."""
+    if args.kind != "predict":
+        return _build_generation_server(args)
     from ..inference import Config, create_predictor
     from .server import InferenceServer
 
@@ -77,6 +124,27 @@ def build_server(args):
         pred, port=args.port, host=args.host, replicas=args.replicas,
         buckets=args.buckets, queue_capacity=args.queue_capacity,
         batch_timeout_ms=args.batch_timeout_ms)
+
+
+def _build_generation_server(args):
+    from ..flags import flag
+    from ..generation.engine import GenerationEngine
+    from ..models.gpt import load_gpt_model
+    from .server import GenerationServer
+
+    model = load_gpt_model(args.gpt_dir)
+    draft = None
+    if args.draft_dir and (args.speculative
+                           or flag("speculative_enabled")):
+        draft = load_gpt_model(args.draft_dir)
+    engine = GenerationEngine(
+        model, slots=args.slots, cache_len=args.cache_len,
+        prefill_buckets=args.prefill_buckets,
+        kv_cache_dtype=args.kv_cache_dtype,
+        draft_model=draft, draft_k=args.draft_k)
+    return GenerationServer(
+        engine, port=args.port, host=args.host, kind=args.kind,
+        queue_capacity=args.queue_capacity)
 
 
 def _announce_port(path, port):
@@ -103,7 +171,9 @@ def main(argv=None) -> int:
     if args.port_file:
         _announce_port(args.port_file, srv.port)
     print(f"serving backend ready on {srv.url} "
-          f"(model={args.model_dir}, pid={os.getpid()})", flush=True)
+          f"(kind={args.kind}, "
+          f"model={args.model_dir or args.gpt_dir}, "
+          f"pid={os.getpid()})", flush=True)
 
     stop = threading.Event()
 

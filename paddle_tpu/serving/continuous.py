@@ -48,16 +48,25 @@ __all__ = ["ContinuousBatcher", "GenerationRequest"]
 
 
 class GenerationRequest:
-    """One submitted generation: a token prompt, its budget and sampling
-    override, the tokens produced so far, and a completion event."""
+    """One submitted generation: a token prompt (or a handed-off KV
+    slab standing in for one), its budget and sampling override, the
+    tokens produced so far, and a completion event."""
 
-    __slots__ = ("prompt", "max_new_tokens", "temperature", "deadline",
-                 "t_submit", "t_first_token", "tokens", "finish_reason",
-                 "on_token", "error", "trace", "_done")
+    __slots__ = ("prompt", "prompt_len", "max_new_tokens", "temperature",
+                 "deadline", "t_submit", "t_first_token", "tokens",
+                 "finish_reason", "on_token", "error", "trace",
+                 "handoff", "_done")
 
     def __init__(self, prompt, max_new_tokens, temperature, deadline,
-                 t_submit, on_token=None):
+                 t_submit, on_token=None, handoff=None, prompt_len=None):
         self.prompt = prompt
+        # a disaggregated admission knows the prompt LENGTH (slab
+        # metadata) even when the tokens themselves did not ride along
+        self.prompt_len = (len(prompt) if prompt_len is None
+                           else int(prompt_len))
+        # (planes, length, first_token) from generation.handoff — the
+        # admission path becomes insert_slot_kv instead of a prefill
+        self.handoff = handoff
         # the submitter's trace context (the HTTP handler's server
         # span): queue-wait / slot-admission / decode spans recorded by
         # the decode-loop thread hang under it
@@ -178,6 +187,11 @@ class ContinuousBatcher:
                     else None)
         req = GenerationRequest(prompt, max_new, temperature, deadline,
                                 now, on_token=on_token)
+        return self._enqueue(req)
+
+    def _enqueue(self, req) -> GenerationRequest:
+        """The one admission gate both submit paths share: closed
+        check, bounded-queue backpressure (429), enqueue + notify."""
         with self._lock:
             if self._closed:
                 raise ServingClosedError(
@@ -195,6 +209,47 @@ class ContinuousBatcher:
             self._not_empty.notify()
         self._m_requests.inc()
         return req
+
+    def submit_prefilled(self, planes, length, first_token,
+                         max_new_tokens=None, temperature=None,
+                         deadline_ms=None, on_token=None,
+                         prompt=None) -> GenerationRequest:
+        """Enqueue a handed-off generation: the prompt was prefilled on
+        a PREFILL-tier backend and arrives as a KV slab (window-width
+        per-slot planes + true length + the first sampled token).
+        Admission becomes a single functional cache insert instead of a
+        prefill forward; everything downstream (queue contracts,
+        deadlines, streaming, completion) is the normal request path.
+        ``prompt`` (the token ids) is required by speculative engines —
+        the draft ring must be prefilled at admission."""
+        length = int(length)
+        if not 1 <= length <= self.engine.cache_len:
+            raise InvalidArgumentError(
+                f"handoff prompt length {length} outside "
+                f"[1, {self.engine.cache_len}]")
+        max_new = (self.engine.default_max_new_tokens
+                   if max_new_tokens is None else int(max_new_tokens))
+        if max_new < 1:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be >= 1, got {max_new}")
+        if length + max_new > self.engine.max_positions:
+            raise InvalidArgumentError(
+                f"prompt ({length}) + max_new_tokens ({max_new}) "
+                f"exceeds max_position_embeddings "
+                f"{self.engine.max_positions}")
+        if self.engine.speculative and prompt is None:
+            raise InvalidArgumentError(
+                "a speculative decode tier needs the prompt tokens with "
+                "the KV slab (draft ring prefill at admission)")
+        now = self._clock()
+        deadline = (now + float(deadline_ms) / 1e3
+                    if deadline_ms is not None and float(deadline_ms) > 0
+                    else None)
+        req = GenerationRequest(
+            prompt, max_new, temperature, deadline, now,
+            on_token=on_token, prompt_len=length,
+            handoff=(planes, length, int(first_token)))
+        return self._enqueue(req)
 
     def generate(self, prompt, max_new_tokens=None, temperature=None,
                  timeout=None) -> list:
@@ -225,7 +280,7 @@ class ContinuousBatcher:
             _tracing.record_interval(
                 "serving::queue_wait", req.trace, req.t_submit, now,
                 error="deadline exceeded in queue",
-                prompt_tokens=len(req.prompt))
+                prompt_tokens=req.prompt_len)
             _tracing.flag_trace(req.trace, "deadline")
             req.done(error=DeadlineExceededError(
                 f"generation deadline passed after "
@@ -263,7 +318,7 @@ class ContinuousBatcher:
         self._m_responses.inc()
         _flight.record_event(
             "generation_complete", reason=reason,
-            prompt_tokens=len(req.prompt), tokens=len(req.tokens))
+            prompt_tokens=req.prompt_len, tokens=len(req.tokens))
         req.done()
 
     def _admit_ready(self):
@@ -292,16 +347,32 @@ class ContinuousBatcher:
             # the cache disposition + FLOPs while it is current)
             _tracing.record_interval(
                 "serving::queue_wait", req.trace, req.t_submit, t_admit,
-                prompt_tokens=len(req.prompt))
-            bucket = engine.bucket_for(len(req.prompt))
-            asp = _tracing.begin_span(
-                "serving::slot_admission", slot=free, midbatch=midbatch,
-                bucket=bucket, prompt_tokens=len(req.prompt),
-                padded_tokens=bucket - len(req.prompt),
-                fill=round(len(req.prompt) / bucket, 4))
+                prompt_tokens=req.prompt_len)
+            if req.handoff is not None:
+                # a prefill-tier forward already happened elsewhere;
+                # admission is one functional cache insert
+                asp = _tracing.begin_span(
+                    "serving::slot_admission", slot=free,
+                    midbatch=midbatch, handoff=True,
+                    prompt_tokens=req.prompt_len)
+            else:
+                bucket = engine.bucket_for(len(req.prompt))
+                asp = _tracing.begin_span(
+                    "serving::slot_admission", slot=free,
+                    midbatch=midbatch,
+                    bucket=bucket, prompt_tokens=req.prompt_len,
+                    padded_tokens=bucket - len(req.prompt),
+                    fill=round(len(req.prompt) / bucket, 4))
             try:
                 with _tracing.use_span(asp):
-                    tok = engine.admit(free, req.prompt, req.temperature)
+                    if req.handoff is not None:
+                        planes, length, first = req.handoff
+                        tok = engine.admit_prefilled(
+                            free, planes, length, first,
+                            prompt=req.prompt)
+                    else:
+                        tok = engine.admit(free, req.prompt,
+                                           req.temperature)
             except Exception as e:  # noqa: BLE001 — the loop must survive
                 asp.set_error(f"{type(e).__name__}: {e}")
                 _tracing.record_fanin(asp, [req.trace])
@@ -326,7 +397,7 @@ class ContinuousBatcher:
                 self._m_midbatch.inc()
             _flight.record_event(
                 "generation_admit", slot=free, midbatch=midbatch,
-                prompt_tokens=len(req.prompt),
+                prompt_tokens=req.prompt_len,
                 queued_ms=round(
                     (req.t_first_token - req.t_submit) * 1e3, 3))
             self._deliver(req, tok)
@@ -355,7 +426,14 @@ class ContinuousBatcher:
                 continue
             t0 = self._clock()
             try:
-                nxt = engine.step(self._last, self._temps)
+                if engine.speculative:
+                    # one draft+verify round: every busy slot emits
+                    # 1..k+1 tokens (the scheduler truncates at its own
+                    # EOS/budget, exactly like the one-token path)
+                    ts, counts = engine.spec_step(
+                        self._last, self._temps, busy=busy)
+                else:
+                    nxt = engine.step(self._last, self._temps)
             except Exception as e:  # noqa: BLE001 — fail THESE, keep serving
                 for s in busy:
                     req, self._slots[s] = self._slots[s], None
@@ -373,20 +451,40 @@ class ContinuousBatcher:
                     "generation_step_error", slots=len(busy),
                     error=f"{type(e).__name__}: {e}"[:300])
                 continue
-            self._h_token.observe((self._clock() - t0) * 1e3)
+            dt_ms = (self._clock() - t0) * 1e3
             if self._watch.armed:
                 self._watch.note(slots=len(busy))
+            emitted = 0
             for s in busy:
                 req = self._slots[s]
                 if req is None or req.finished:  # stop(drain=False) race
                     self._slots[s] = None
                     continue
-                self._deliver(req, nxt[s])
-                self._last[s] = nxt[s]
-                reason = self._finished_reason(req)
+                reason = None
+                if engine.speculative:
+                    for i in range(int(counts[s])):
+                        self._deliver(req, ts[s, i])
+                        self._last[s] = ts[s, i]
+                        emitted += 1
+                        reason = self._finished_reason(req)
+                        if reason is not None:
+                            break
+                else:
+                    self._deliver(req, nxt[s])
+                    self._last[s] = nxt[s]
+                    emitted += 1
+                    reason = self._finished_reason(req)
                 if reason is not None:
                     self._slots[s] = None
                     self._complete(req, reason)
+            # per-token latency, per STREAM (what a client waits between
+            # tokens): the plain path observes the step time unchanged;
+            # a speculative round amortizes its two dispatches over the
+            # mean tokens each busy stream emitted
+            if engine.speculative and emitted:
+                self._h_token.observe(dt_ms * len(busy) / emitted)
+            else:
+                self._h_token.observe(dt_ms)
             self._m_busy.set(self.live_slots)
         # drained exit: nothing queued, nothing active
         self._m_busy.set(self.live_slots)

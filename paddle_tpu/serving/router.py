@@ -151,17 +151,6 @@ class BackendState:
 
 
 class _RouterHandler(_BaseHandler):
-    def _reply_raw(self, status, data: bytes, ctype):
-        _tracing.note_status(status)
-        self.send_response(status)
-        self.send_header("Content-Type", ctype or "application/json")
-        self.send_header("Content-Length", str(len(data)))
-        self.end_headers()
-        try:
-            self.wfile.write(data)
-        except (BrokenPipeError, ConnectionResetError):
-            pass
-
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         if self._get_common(path):
@@ -197,6 +186,16 @@ class _RouterHandler(_BaseHandler):
         if srv.draining:
             self._reply(503, {"error": "router draining"})
             return
+        if kind == "generate" and srv.has_kind("prefill") \
+                and srv.has_kind("decode"):
+            # disaggregated fleet: /generate becomes prefill -> slab ->
+            # decode, orchestrated here (the tiers never talk directly,
+            # so each leg keeps the full retry/eviction policy). BOTH
+            # tiers must be live — with only a prefill tier up (decode
+            # still booting/evicted) requests keep flowing to any
+            # unified generate backends instead of 503ing
+            self._proxy_disagg(body)
+            return
         t0 = time.monotonic()
         try:
             backend, conn, resp = srv.dispatch(kind, path, body)
@@ -207,6 +206,12 @@ class _RouterHandler(_BaseHandler):
             self._reply(504, {"error": str(e)})
             return
         _tracing.annotate(backend=backend.url)
+        self._relay(srv, backend, conn, resp, t0)
+
+    def _relay(self, srv, backend, conn, resp, t0):
+        """Forward one dispatched backend response to the client —
+        streamed re-chunking or a buffered read — with the
+        died-mid-response handling and the finish bookkeeping."""
         status = resp.status
         try:
             if (resp.getheader("Transfer-Encoding") or "").lower() \
@@ -230,6 +235,64 @@ class _RouterHandler(_BaseHandler):
                                     resp.getheader("Content-Type"))
         finally:
             srv.finish(backend, t0, status, conn=conn, resp=resp)
+
+    def _proxy_disagg(self, body):
+        """Two-leg /generate: POST the request to a prefill backend
+        (bounded forward on the handoff budget), then hand its KV slab
+        to a decode backend whose response — streamed or not — relays
+        to the client exactly like a unified /generate.
+
+        Leg semantics: the prefill leg is stateless and keeps the full
+        retry policy; a non-200 prefill answer (400 bad prompt, 429
+        backpressure) passes through untouched. The slab then rides the
+        normal dispatch to the decode tier, where the usual "answered
+        means no replay" contract takes over."""
+        from ..generation.handoff import HANDOFF_CONTENT_TYPE
+
+        srv = self._srv
+        t0 = time.monotonic()
+        try:
+            b1, conn1, resp1 = srv.dispatch(
+                "prefill", "/prefill", body,
+                read_timeout=srv.handoff_timeout_s)
+        except NoBackendError as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except BackendTimeoutError as e:
+            self._reply(504, {"error": f"prefill handoff: {e}"})
+            return
+        _tracing.annotate(prefill_backend=b1.url)
+        status1 = resp1.status
+        slab = None
+        ctype1 = resp1.getheader("Content-Type")
+        try:
+            try:
+                slab = resp1.read()
+            except _BACKEND_READ_ERRORS as e:
+                status1 = 502
+                srv.note_backend_died(b1, "died_mid_response")
+                self._reply(502, {
+                    "error": "prefill backend connection lost "
+                             f"mid-slab ({type(e).__name__})"})
+                return
+        finally:
+            srv.finish(b1, t0, status1, conn=conn1, resp=resp1)
+        if status1 != 200:
+            self._reply_raw(status1, slab, ctype1)
+            return
+        t1 = time.monotonic()
+        try:
+            b2, conn2, resp2 = srv.dispatch(
+                "decode", "/generate_kv", slab,
+                content_type=HANDOFF_CONTENT_TYPE)
+        except NoBackendError as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except BackendTimeoutError as e:
+            self._reply(504, {"error": str(e)})
+            return
+        _tracing.annotate(backend=b2.url, handoff=True)
+        self._relay(srv, b2, conn2, resp2, t1)
 
     def _proxy_stream(self, resp, srv, backend):
         """Re-chunk a streaming backend response to the client as the
@@ -301,6 +364,9 @@ class Router:
         self.request_timeout_s = float(
             request_timeout_s if request_timeout_s is not None
             else flag("serving_router_request_timeout_s"))
+        # budget for the prefill->slab leg of a disaggregated /generate
+        # (one bounded forward; the decode leg keeps the full budget)
+        self.handoff_timeout_s = float(flag("serving_handoff_timeout_s"))
         self._lock = threading.Lock()
         self._backends: dict[str, BackendState] = {}
         # keep-alive pools: idle router->backend connections per backend
@@ -352,6 +418,14 @@ class Router:
     def healthy_count(self) -> int:
         with self._lock:
             return sum(b.in_rotation for b in self._backends.values())
+
+    def has_kind(self, kind) -> bool:
+        """Any in-rotation backend confirmed as ``kind``? (The
+        disaggregation switch: /generate orchestrates prefill->decode
+        exactly when a prefill tier is live.)"""
+        with self._lock:
+            return any(b.in_rotation and b.kind == kind
+                       for b in self._backends.values())
 
     def backend_states(self) -> list:
         with self._lock:
@@ -451,7 +525,8 @@ class Router:
         the retry policy keys on."""
         conn = self._connect(b, read_timeout=read_timeout)
         try:
-            return conn, self._request_on(conn, method, path, body)
+            return conn, self._request_on(conn, method, path, body,
+                                          read_timeout=read_timeout)
         except BackendTimeoutError:
             conn.close()
             raise
@@ -480,17 +555,23 @@ class Router:
         for conn in pool:
             conn.close()
 
-    def _dispatch_send(self, b: BackendState, path, body, headers=None):
+    def _dispatch_send(self, b: BackendState, path, body, headers=None,
+                       content_type=None, read_timeout=None):
         """POST over a pooled keep-alive connection. A failure on a
         REUSED connection is retried once on a fresh one — the backend
         may simply have timed the idle socket out, which is not evidence
         of death. Only a fresh-connection failure raises the retriable
-        :class:`BackendUnavailableError`."""
+        :class:`BackendUnavailableError`. ``content_type`` overrides
+        the JSON default (KV-slab handoffs are octet bodies);
+        ``read_timeout`` overrides the request budget (the prefill leg
+        of a handoff runs on the shorter handoff timeout)."""
         conn = self._pool_pop(b)
         if conn is not None:
             try:
                 return conn, self._request_on(conn, "POST", path, body,
-                                              extra_headers=headers)
+                                              extra_headers=headers,
+                                              content_type=content_type,
+                                              read_timeout=read_timeout)
             except BackendTimeoutError:
                 conn.close()
                 raise
@@ -500,7 +581,9 @@ class Router:
         conn = self._connect(b)
         try:
             return conn, self._request_on(conn, "POST", path, body,
-                                          extra_headers=headers)
+                                          extra_headers=headers,
+                                          content_type=content_type,
+                                          read_timeout=read_timeout)
         except BackendTimeoutError:
             conn.close()
             raise
@@ -510,9 +593,16 @@ class Router:
             raise BackendUnavailableError(
                 "no_response", f"{type(e).__name__}: {e}") from None
 
-    def _request_on(self, conn, method, path, body, extra_headers=None):
+    def _request_on(self, conn, method, path, body, extra_headers=None,
+                    content_type=None, read_timeout=None):
+        timeout = (self.request_timeout_s if read_timeout is None
+                   else float(read_timeout))
+        if conn.sock is not None:
+            # pooled connections keep their previous budget otherwise
+            conn.sock.settimeout(timeout)
         try:
-            headers = {"Content-Type": "application/json"} if body else {}
+            headers = ({"Content-Type": content_type or
+                        "application/json"} if body else {})
             if extra_headers:
                 headers.update(extra_headers)
             conn.request(method, path, body=body, headers=headers)
@@ -522,8 +612,7 @@ class Router:
             # backend may still be computing it — dispatched work, so
             # no retry (504), unlike the connection-failure cases
             raise BackendTimeoutError(
-                f"backend gave no response within "
-                f"{self.request_timeout_s}s") from None
+                f"backend gave no response within {timeout}s") from None
 
     def _get_json(self, b: BackendState, path):
         """Probe GET: ``(status, parsed-json-or-{})``. Probes read on a
@@ -546,14 +635,23 @@ class Router:
 
     def _pick(self, kind, exclude) -> BackendState | None:
         """Power-of-two-choices among in-rotation backends serving
-        ``kind``: sample two, take the lower load score."""
+        ``kind``: sample two, take the lower load score.
+
+        Kind-CONFIRMED backends always win over kind-unknown ones: a
+        not-yet-probed backend (``kind is None``) is only eligible when
+        NO confirmed backend serves the kind — with several kinds in
+        one fleet, an unprobed decode backend must not siphon
+        ``/predict`` traffic it will 404. A mis-guessed unknown is
+        re-picked, not failed (see :meth:`dispatch`)."""
         with self._lock:
-            cands = [
+            pool = [
                 b for b in self._backends.values()
                 if b.in_rotation and not b.draining
                 and b.url not in exclude
-                and (b.kind is None or b.kind == kind)
             ]
+            cands = [b for b in pool if b.kind == kind]
+            if not cands:
+                cands = [b for b in pool if b.kind is None]
             if not cands:
                 return None
             if len(cands) == 1:
@@ -561,7 +659,8 @@ class Router:
             a, c = self._rng.sample(cands, 2)
             return min((a, c), key=lambda b: (b.score(), b.url))
 
-    def dispatch(self, kind, path, body):
+    def dispatch(self, kind, path, body, content_type=None,
+                 read_timeout=None):
         """Pick-and-forward with the retry policy. Returns ``(backend,
         conn, resp)`` — response unread so the handler can stream it;
         the handler MUST call :meth:`finish` when done. Raises
@@ -577,6 +676,7 @@ class Router:
             if b is None:
                 break
             tried.add(b.url)
+            kind_known = b.kind is not None
             with self._lock:
                 b.inflight += 1
                 b.admitted += 1
@@ -593,8 +693,10 @@ class Router:
                         _tracing.TRACEPARENT_HEADER:
                             _tracing.format_traceparent(asp.context)}
                 try:
-                    conn, resp = self._dispatch_send(b, path, body,
-                                                     headers=headers)
+                    conn, resp = self._dispatch_send(
+                        b, path, body, headers=headers,
+                        content_type=content_type,
+                        read_timeout=read_timeout)
                 except BackendTimeoutError as e:
                     with self._lock:
                         b.inflight -= 1
@@ -636,6 +738,26 @@ class Router:
                     self._m_retries.inc()
                     _flight.record_event("router_retry", url=b.url,
                                          reason="admission_503",
+                                         path=path)
+                    continue
+                if resp.status == 404 and not kind_known:
+                    # a kind-unknown backend won the fallback pick for
+                    # a route it does not serve: learn its kind from a
+                    # probe and RE-PICK — the request never ran, so
+                    # failing it would punish the client for the
+                    # router's incomplete map
+                    try:
+                        resp.read()
+                    finally:
+                        conn.close()
+                    with self._lock:
+                        b.inflight -= 1
+                    asp.set_attributes(status=404, kind_mismatch=True)
+                    _tracing.flag_current_trace("retry")
+                    self._probe_backend(b)
+                    self._m_retries.inc()
+                    _flight.record_event("router_retry", url=b.url,
+                                         reason="kind_mismatch",
                                          path=path)
                     continue
                 asp.set_attributes(status=resp.status)

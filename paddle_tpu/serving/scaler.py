@@ -59,7 +59,16 @@ __all__ = ["AutoScaler", "FleetSignals", "SubprocessLauncher",
 
 @dataclass
 class FleetSignals:
-    """One evaluation tick's view of the fleet (inputs to ``decide``)."""
+    """One evaluation tick's view of the fleet (inputs to ``decide``).
+
+    ``kinds`` splits the pressure aggregates per backend kind
+    (``predict`` / ``generate`` / ``prefill`` / ``decode``): fleet-wide
+    means average a saturated decode tier against idle prefill
+    backends, which is exactly how a starving tier hides — a
+    kind-scoped scaler reads its own tier's split instead. When the
+    scaler is constructed with ``kind=...``, the TOP-LEVEL aggregates
+    are already that tier's (and ``kind`` names it); ``kinds`` always
+    carries the full per-kind view for evidence/debugging."""
 
     time: float
     backends_total: int
@@ -68,6 +77,32 @@ class FleetSignals:
     max_queue_depth: int
     total_inflight: int
     host: dict = field(default_factory=dict)  # cluster.local_snapshot()
+    kind: str | None = None
+    kinds: dict = field(default_factory=dict)
+
+
+def _kind_split(states) -> dict:
+    """Per-kind pressure aggregates over in-rotation backends (a
+    kind-unknown backend is booting/unprobed: its own bucket, so it
+    cannot dilute a confirmed tier's mean)."""
+    split: dict = {}
+    for b in states:
+        if not b.in_rotation:
+            continue
+        k = b.kind or "unknown"
+        row = split.setdefault(
+            k, {"healthy": 0, "queue_depths": [], "inflight": 0})
+        row["healthy"] += 1
+        row["queue_depths"].append(b.queue_depth)
+        row["inflight"] += b.inflight
+    out = {}
+    for k, row in split.items():
+        depths = row.pop("queue_depths")
+        row["mean_queue_depth"] = (sum(depths) / len(depths)
+                                   if depths else 0.0)
+        row["max_queue_depth"] = max(depths) if depths else 0
+        out[k] = row
+    return out
 
 
 @dataclass
@@ -145,7 +180,8 @@ class SubprocessLauncher:
     def __init__(self, model_dir, host="127.0.0.1", replicas=None,
                  buckets=None, queue_capacity=None, batch_timeout_ms=None,
                  mesh_dp=0, python=None, env=None,
-                 startup_timeout_s=120.0, cpu_sets=None):
+                 startup_timeout_s=120.0, cpu_sets=None,
+                 kind="predict", extra_args=()):
         self.model_dir = model_dir
         self.host = host
         self.replicas = replicas
@@ -153,6 +189,12 @@ class SubprocessLauncher:
         self.queue_capacity = queue_capacity
         self.batch_timeout_ms = batch_timeout_ms
         self.mesh_dp = mesh_dp
+        # generation kinds boot from a save_gpt_model dir (--gpt-dir);
+        # extra_args passes kind-specific knobs straight through
+        # (--slots, --draft-dir, ... — a tier-bound scaler's launcher
+        # bakes its tier's configuration here)
+        self.kind = str(kind)
+        self.extra_args = [str(a) for a in extra_args]
         self.python = python or sys.executable
         self.env = dict(env) if env else {}
         self.startup_timeout_s = float(startup_timeout_s)
@@ -166,6 +208,13 @@ class SubprocessLauncher:
         self._launches = 0
 
     def _args(self):
+        if self.kind != "predict":
+            args = ["--kind", self.kind,
+                    "--gpt-dir", str(self.model_dir),
+                    "--host", self.host, "--port", "0"]
+            if self.queue_capacity is not None:
+                args += ["--queue-capacity", str(self.queue_capacity)]
+            return args + self.extra_args
         args = ["--model-dir", str(self.model_dir),
                 "--host", self.host, "--port", "0"]
         if self.replicas is not None:
@@ -181,7 +230,7 @@ class SubprocessLauncher:
             args += ["--batch-timeout-ms", str(self.batch_timeout_ms)]
         if self.mesh_dp:
             args += ["--mesh-dp", str(self.mesh_dp)]
-        return args
+        return args + self.extra_args
 
     def launch(self) -> LaunchedBackend:
         cpus = (self.cpu_sets[self._launches % len(self.cpu_sets)]
@@ -225,9 +274,14 @@ class AutoScaler:
     def __init__(self, router, launcher, min_backends=None,
                  max_backends=None, up_queue_depth=None,
                  down_queue_depth=None, window=None, cooldown_s=None,
-                 interval_s=None, clock=time.monotonic):
+                 interval_s=None, kind=None, clock=time.monotonic):
         self.router = router
         self.launcher = launcher
+        # tier scoping: a kind-bound scaler sees ONLY its tier's
+        # pressure and owns only its tier's backends — one scaler per
+        # kind sizes a disaggregated fleet's tiers independently (the
+        # launcher must boot backends of the matching --kind)
+        self.kind = kind
         self.min_backends = int(
             min_backends if min_backends is not None
             else flag("serving_scaler_min_backends"))
@@ -276,8 +330,19 @@ class AutoScaler:
 
     def signals(self) -> FleetSignals:
         """One tick's fleet view: router backend table aggregates plus
-        this host's cluster snapshot (decision evidence)."""
-        states = self.router.backend_states()
+        this host's cluster snapshot (decision evidence). A kind-bound
+        scaler's top-level aggregates are its TIER's only (a saturated
+        decode tier must never be masked by idle prefill backends);
+        the full per-kind split rides along either way."""
+        all_states = self.router.backend_states()
+        states = all_states
+        if self.kind is not None:
+            # a just-launched owned backend may not have a probed kind
+            # yet — it still belongs to this tier's totals
+            states = [b for b in all_states
+                      if b.kind == self.kind or (
+                          b.kind is None
+                          and b.url in self.owned)]
         healthy = [b for b in states if b.in_rotation]
         depths = [b.queue_depth for b in healthy]
         return FleetSignals(
@@ -289,6 +354,8 @@ class AutoScaler:
             max_queue_depth=max(depths) if depths else 0,
             total_inflight=sum(b.inflight for b in healthy),
             host=_cluster.local_snapshot(),
+            kind=self.kind,
+            kinds=_kind_split(all_states),
         )
 
     # -- decision ------------------------------------------------------------

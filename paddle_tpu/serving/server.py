@@ -191,6 +191,20 @@ class _BaseHandler(BaseHTTPRequestHandler):
         except (BrokenPipeError, ConnectionResetError):
             pass
 
+    def _reply_raw(self, status, data: bytes, ctype):
+        """Raw-bytes reply (proxied payloads, KV slabs): the caller
+        owns the exact Content-Type; everything else matches
+        :meth:`_reply`."""
+        _tracing.note_status(status)
+        self.send_response(status)
+        self.send_header("Content-Type", ctype or "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        try:
+            self.wfile.write(data)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
     def _read_body(self):
         """Read (and thereby DRAIN) the POST body before any reply — an
         unread body left on a keep-alive connection parses as the next
@@ -522,6 +536,14 @@ class InferenceServer:
 # ---------------------------------------------------------------------------
 
 
+#: POST route each generation backend kind answers (the disaggregation
+#: contract: a prefill tier only prefills, a decode tier only continues
+#: handed-off slabs — anything else 404s, which the router's kind-aware
+#: pick treats as "re-pick", never "fail the request")
+_KIND_ROUTES = {"generate": "/generate", "prefill": "/prefill",
+                "decode": "/generate_kv"}
+
+
 class _GenerationHandler(_BaseHandler):
     def do_GET(self):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
@@ -530,8 +552,10 @@ class _GenerationHandler(_BaseHandler):
         if path == "/":
             self._reply(200, {
                 "service": "paddle_tpu generation",
-                "routes": ["/generate (POST)", "/healthz", "/statz",
-                           "/loadz", "/histz", "/tracez", "/metrics"]})
+                "kind": self._srv.kind,
+                "routes": [f"{_KIND_ROUTES[self._srv.kind]} (POST)",
+                           "/healthz", "/statz", "/loadz", "/histz",
+                           "/tracez", "/metrics"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -540,52 +564,59 @@ class _GenerationHandler(_BaseHandler):
         raw = self._read_body()
         if raw is None:
             return
-        if path != "/generate":
-            self._reply(404, {"error": f"unknown path {path!r}"})
+        if path != _KIND_ROUTES[self._srv.kind]:
+            self._reply(404, {
+                "error": f"unknown path {path!r} (this backend's kind "
+                         f"is {self._srv.kind!r})"})
             return
-        with self._trace_request("serving::generate"):
-            self._generate(raw)
+        if path == "/generate":
+            with self._trace_request("serving::generate"):
+                self._generate(raw)
+        elif path == "/prefill":
+            with self._trace_request("serving::prefill"):
+                self._prefill(raw)
+        else:
+            with self._trace_request("serving::generate_kv"):
+                self._generate_kv(raw)
 
-    def _generate(self, raw):
-        srv = self._srv
+    @staticmethod
+    def _parse_gen_body(raw) -> dict:
+        """Parse/validate the ``/generate`` (and ``/prefill``) JSON
+        body into its parameters; raises on malformed input (mapped to
+        400 by the callers)."""
+        body = json.loads(raw or b"{}")
+        if not isinstance(body, dict):
+            raise InvalidArgumentError(
+                'request body must be a JSON object with a "prompt" key')
+        prompt = body.get("prompt")
+        if (not isinstance(prompt, (list, tuple)) or not prompt
+                or not all(isinstance(t, int) for t in prompt)):
+            raise InvalidArgumentError(
+                '"prompt" must be a non-empty list of token ids (ints)')
+        max_new = body.get("max_new_tokens")
+        temperature = body.get("temperature")
+        deadline_ms = body.get("deadline_ms")
+        return {
+            "prompt": list(prompt),
+            "max_new_tokens": int(max_new) if max_new is not None
+            else None,
+            "temperature": float(temperature)
+            if temperature is not None else None,
+            "deadline_ms": float(deadline_ms)
+            if deadline_ms is not None else None,
+            "stream": bool(body.get("stream", False)),
+        }
+
+    def _check_ready(self, srv) -> bool:
         if not srv.ready:
             self._reply(503, {"error": "not ready"
                               if not srv.draining else "draining"})
-            return
-        try:
-            body = json.loads(raw or b"{}")
-            if not isinstance(body, dict):
-                raise InvalidArgumentError(
-                    "request body must be a JSON object with a "
-                    '"prompt" key')
-            prompt = body.get("prompt")
-            if (not isinstance(prompt, (list, tuple)) or not prompt
-                    or not all(isinstance(t, int) for t in prompt)):
-                raise InvalidArgumentError(
-                    '"prompt" must be a non-empty list of token ids '
-                    "(ints)")
-            max_new = body.get("max_new_tokens")
-            max_new = int(max_new) if max_new is not None else None
-            temperature = body.get("temperature")
-            temperature = (float(temperature) if temperature is not None
-                           else None)
-            deadline_ms = body.get("deadline_ms")
-            if deadline_ms is not None:
-                deadline_ms = float(deadline_ms)
-            stream = bool(body.get("stream", False))
-        except (ValueError, TypeError, InvalidArgumentError) as e:
-            self._reply(400, {"error": str(e)})
-            return
-        _tracing.annotate(prompt_tokens=len(prompt), stream=stream)
-        if stream:
-            self._generate_stream(srv, prompt, max_new, temperature,
-                                  deadline_ms)
-            return
-        req = self._try_submit(lambda: srv.scheduler.submit(
-            prompt, max_new_tokens=max_new, temperature=temperature,
-            deadline_ms=deadline_ms))
-        if req is None:
-            return
+            return False
+        return True
+
+    def _wait_and_reply(self, srv, req):
+        """Block on a submitted request and answer with the standard
+        non-streamed payload / error mapping."""
         try:
             tokens = req.wait(srv.request_timeout_s)
         except DeadlineExceededError as e:
@@ -597,21 +628,130 @@ class _GenerationHandler(_BaseHandler):
         self._reply(200, {
             "tokens": tokens,
             "finish_reason": req.finish_reason,
-            "prompt_tokens": len(req.prompt),
+            "prompt_tokens": req.prompt_len,
         })
 
-    def _generate_stream(self, srv, prompt, max_new, temperature,
-                         deadline_ms):
+    def _generate(self, raw):
+        srv = self._srv
+        if not self._check_ready(srv):
+            return
+        try:
+            p = self._parse_gen_body(raw)
+        except (ValueError, TypeError, InvalidArgumentError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        _tracing.annotate(prompt_tokens=len(p["prompt"]),
+                          stream=p["stream"])
+        submit = lambda **kw: srv.scheduler.submit(  # noqa: E731
+            p["prompt"], max_new_tokens=p["max_new_tokens"],
+            temperature=p["temperature"], deadline_ms=p["deadline_ms"],
+            **kw)
+        if p["stream"]:
+            self._generate_stream(srv, submit)
+            return
+        req = self._try_submit(submit)
+        if req is None:
+            return
+        self._wait_and_reply(srv, req)
+
+    def _prefill(self, raw):
+        """Prefill-tier leg of a disaggregated ``/generate``: run the
+        bucket-ladder forward, sample the first token, and answer with
+        the slot's KV slab (``generation.handoff`` wire format). The
+        original request's generation parameters — and the prompt
+        itself, which a speculative decode tier needs — ride in the
+        slab header, so the router can forward bytes without
+        re-parsing anything."""
+        from ..generation.handoff import HANDOFF_CONTENT_TYPE, pack_kv_slab
+
+        srv = self._srv
+        if not self._check_ready(srv):
+            return
+        try:
+            p = self._parse_gen_body(raw)
+            srv.engine.validate(
+                p["prompt"],
+                p["max_new_tokens"]
+                if p["max_new_tokens"] is not None
+                else srv.engine.default_max_new_tokens)
+        except (ValueError, TypeError, InvalidArgumentError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        _tracing.annotate(prompt_tokens=len(p["prompt"]), prefill=True)
+        try:
+            planes, length, first = srv.run_prefill(
+                p["prompt"], p["temperature"])
+        except ServingClosedError as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 — a failed forward must answer
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        blob = pack_kv_slab(planes, length, first, meta={
+            "params": {k: p[k] for k in
+                       ("prompt", "max_new_tokens", "temperature",
+                        "deadline_ms", "stream")},
+            "cache": srv.cache_geometry(),
+        })
+        self._reply_raw(200, blob, HANDOFF_CONTENT_TYPE)
+
+    def _generate_kv(self, raw):
+        """Decode-tier leg: land a handed-off KV slab in a decode slot
+        and continue the generation — the slab's riding parameters
+        reconstruct the original request (including streaming)."""
+        from ..generation.handoff import HandoffError, unpack_kv_slab
+
+        srv = self._srv
+        if not self._check_ready(srv):
+            return
+        try:
+            planes, length, first, meta = unpack_kv_slab(raw)
+            mine = srv.cache_geometry()
+            theirs = meta.get("cache") or {}
+            bad = {k: (theirs.get(k), mine[k]) for k in mine
+                   if theirs.get(k) != mine[k]}
+            if bad:
+                raise HandoffError(
+                    f"KV slab geometry does not match this decode tier: "
+                    f"{bad} (sender vs receiver)")
+            if srv.engine.speculative:
+                # a speculative decode tier re-prefills the DRAFT from
+                # the prompt at admission, which needs a covering
+                # bucket on THIS tier's ladder — reject now as the 400
+                # the handoff promises, not a 500 out of the decode
+                # loop after a prefill-tier forward was already spent
+                srv.engine.bucket_for(length)
+        except (HandoffError, InvalidArgumentError) as e:
+            self._reply(400, {"error": str(e)})
+            return
+        p = dict(meta.get("params") or {})
+        stream = bool(p.get("stream", False))
+        _tracing.annotate(prompt_tokens=length, handoff=True,
+                          stream=stream)
+        submit = lambda **kw: srv.scheduler.submit_prefilled(  # noqa: E731
+            planes, length, first,
+            max_new_tokens=p.get("max_new_tokens"),
+            temperature=p.get("temperature"),
+            deadline_ms=p.get("deadline_ms"),
+            prompt=p.get("prompt"), **kw)
+        if stream:
+            self._generate_stream(srv, submit)
+            return
+        req = self._try_submit(submit)
+        if req is None:
+            return
+        self._wait_and_reply(srv, req)
+
+    def _generate_stream(self, srv, submit):
         """Chunked ndjson streaming: one ``{"token": id}`` line per
         decoded token as it is produced, then a final ``{"done": ...}``
         line with the full result — the scheduler's ``on_token`` hook
-        feeding an HTTP chunk per decode step."""
+        feeding an HTTP chunk per decode step. ``submit`` is the
+        parameter-bound scheduler call (plain or handed-off)."""
         import queue as _queue
 
         q = _queue.Queue()
-        req = self._try_submit(lambda: srv.scheduler.submit(
-            prompt, max_new_tokens=max_new, temperature=temperature,
-            deadline_ms=deadline_ms, on_token=q.put))
+        req = self._try_submit(lambda: submit(on_token=q.put))
         if req is None:
             return
         # the chunked path bypasses _reply — record the status here
@@ -657,7 +797,7 @@ class _GenerationHandler(_BaseHandler):
             else:
                 chunk({"done": True, "tokens": req.tokens,
                        "finish_reason": req.finish_reason,
-                       "prompt_tokens": len(req.prompt)})
+                       "prompt_tokens": req.prompt_len})
             self.wfile.write(b"0\r\n\r\n")
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away; decoding continues
@@ -679,12 +819,30 @@ class GenerationServer:
     :class:`InferenceServer`, ``start()`` warms by default so
     ``/healthz`` readiness means every prefill bucket AND the decode
     step are compiled.
+
+    ``kind`` is the backend's role in a (possibly disaggregated) fleet
+    — ``generate`` serves ``/generate`` end to end; ``prefill`` runs
+    only the bucket-ladder forward and ships KV slabs (``/prefill``);
+    ``decode`` admits handed-off slabs into decode slots
+    (``/generate_kv``). Each kind warms exactly its own program set
+    (``engine.expected_compiles(kind)``) and reports its kind on
+    ``/loadz`` so the router can route and the autoscaler can size the
+    tiers independently.
     """
 
     def __init__(self, model_or_engine, port=0, host="127.0.0.1",
                  slots=None, cache_len=None, prefill_buckets=None,
                  queue_capacity=None, max_new_tokens=None,
-                 temperature=None, top_k=None, request_timeout_s=120.0):
+                 temperature=None, top_k=None, kv_cache_dtype=None,
+                 draft_model=None, draft_k=None, kind=None,
+                 request_timeout_s=120.0):
+        from ..flags import flag as _flag
+
+        self.kind = str(kind if kind is not None else _flag("backend_kind"))
+        if self.kind not in _KIND_ROUTES:
+            raise InvalidArgumentError(
+                f"backend kind must be one of {sorted(_KIND_ROUTES)}, "
+                f"got {self.kind!r}")
         if hasattr(model_or_engine, "step") and hasattr(
                 model_or_engine, "admit"):
             dropped = {
@@ -692,6 +850,8 @@ class GenerationServer:
                 "prefill_buckets": prefill_buckets,
                 "max_new_tokens": max_new_tokens,
                 "temperature": temperature, "top_k": top_k,
+                "kv_cache_dtype": kv_cache_dtype,
+                "draft_model": draft_model, "draft_k": draft_k,
             }
             bad = sorted(k for k, v in dropped.items() if v is not None)
             if bad:
@@ -707,9 +867,24 @@ class GenerationServer:
                 model_or_engine, slots=slots, cache_len=cache_len,
                 prefill_buckets=prefill_buckets,
                 max_new_tokens=max_new_tokens, temperature=temperature,
-                top_k=top_k)
+                top_k=top_k, kv_cache_dtype=kv_cache_dtype,
+                draft_model=draft_model, draft_k=draft_k)
         self.scheduler = ContinuousBatcher(
             self.engine, queue_capacity=queue_capacity)
+        # prefill tier: prefill_export mutates no cache state, so
+        # handler threads run a few forwards CONCURRENTLY (XLA overlaps
+        # one dispatch's compute with the next one's host prep) behind
+        # a bounded semaphore; the waiter count is the tier's /loadz
+        # queue-depth pressure (what the autoscaler sizes on)
+        self._prefill_concurrency = 4
+        self._prefill_sem = threading.BoundedSemaphore(
+            self._prefill_concurrency)
+        # waiter count mutated by concurrent handler threads: the +=/-=
+        # read-modify-write needs a guard or the /loadz gauge the tier
+        # autoscaler sizes on drifts permanently
+        self._prefill_count_lock = threading.Lock()
+        self._prefill_waiting = 0
+        self._prefill_active = 0
         self.request_timeout_s = request_timeout_s
         self._httpd = ServingHTTPServer((host, int(port)),
                                         _GenerationHandler)
@@ -740,7 +915,10 @@ class GenerationServer:
     # -- lifecycle -----------------------------------------------------------
 
     def start(self, warmup=True):
-        self.scheduler.start()
+        if self.kind != "prefill":
+            # a prefill tier never decodes: no slot scheduler loop —
+            # its engine runs synchronously under the prefill lock
+            self.scheduler.start()
         if self._thread is None or not self._thread.is_alive():
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever,
@@ -748,16 +926,53 @@ class GenerationServer:
             self._thread.start()
         _flight.record_event(
             "generation_server_start", port=self.port,
-            slots=self.engine.slots,
+            backend_kind=self.kind, slots=self.engine.slots,
             prefill_buckets=list(self.engine.prefill_buckets),
-            cache_len=self.engine.cache_len)
+            cache_len=self.engine.cache_len,
+            speculative=self.engine.speculative)
         if warmup:
             self.warmup()
         return self
 
     def warmup(self):
-        self.engine.warmup()
+        self.engine.warmup(kind=self.kind)
         return self
+
+    def run_prefill(self, prompt, temperature=None):
+        """Bounded-concurrency prefill-tier forward (the waiter count
+        is this tier's /loadz pressure)."""
+        if self.draining:
+            raise ServingClosedError("prefill backend draining")
+        with self._prefill_count_lock:
+            self._prefill_waiting += 1
+        acquired = False
+        try:
+            with self._prefill_sem:
+                # holding a slot is utilization, not backlog: move out
+                # of the waiter count so queue_depth means QUEUED (the
+                # decode tier's semantics — a tier at full concurrency
+                # with nothing waiting must not read as backlogged)
+                with self._prefill_count_lock:
+                    self._prefill_waiting -= 1
+                    self._prefill_active += 1
+                    acquired = True
+                return self.engine.prefill_export(prompt, temperature)
+        finally:
+            with self._prefill_count_lock:
+                if acquired:
+                    self._prefill_active -= 1
+                else:
+                    self._prefill_waiting -= 1
+
+    def cache_geometry(self) -> dict:
+        """The slab-compatibility contract both handoff tiers must
+        agree on — checked before any insert."""
+        e = self.engine
+        return {
+            "layers": e._num_layers, "heads": e._num_heads,
+            "head_dim": e._head_dim, "cache_len": e.cache_len,
+            "kv_dtype": e.kv_cache_dtype,
+        }
 
     def stop(self, drain=True, timeout=30.0):
         if self._stopped:
@@ -782,6 +997,7 @@ class GenerationServer:
     def healthz(self) -> dict:
         return {
             "ready": self.ready,
+            "kind": self.kind,
             "warmed": self.engine.warmed,
             "draining": self.draining,
             "uptime_s": round(time.monotonic() - self._t0, 3),
@@ -796,20 +1012,30 @@ class GenerationServer:
     def loadz(self) -> dict:
         """Router-facing load signal; same stable schema as the predict
         server's (``mean_fill`` is the predict-side field, decode-slot
-        occupancy is the generation analog)."""
-        depth = self.scheduler.queue_depth()
+        occupancy is the generation analog). The ``kind`` field routes
+        a disaggregated fleet: prefill tiers report their serialized-
+        forward waiter count as queue depth (compute pressure), decode
+        tiers the slot queue (HBM pressure) — each tier's autoscaler
+        sizes on its own signal."""
+        if self.kind == "prefill":
+            depth = self._prefill_waiting
+            occupancy = round(
+                self._prefill_active / self._prefill_concurrency, 4)
+        else:
+            depth = self.scheduler.queue_depth()
+            occupancy = round(self.scheduler.occupancy(), 4)
         return {
             "schema": LOADZ_SCHEMA_VERSION,
-            "kind": "generate",
+            "kind": self.kind,
             "ready": self.ready,
             "draining": self.draining,
             "queue_depth": depth,
             "queue_capacity": self.scheduler.queue_capacity,
             "load": round(depth / self.scheduler.queue_capacity, 4),
             "mean_fill": None,
-            "slot_occupancy": round(self.scheduler.occupancy(), 4),
+            "slot_occupancy": occupancy,
             "compiles": {
-                "expected": len(self.engine.prefill_buckets) + 1,
+                "expected": self.engine.expected_compiles(self.kind),
                 "unexpected": counter(
                     "serving/gen_unexpected_compiles").value,
                 "jit_misses": _jit_misses(),
@@ -842,6 +1068,10 @@ class GenerationServer:
                 "kv_bytes_per_token": self.engine.kv_bytes_per_token(),
                 "kv_cache_bytes": self.engine.cache_nbytes(),
             },
+            # speculative decoding economics: proposals accepted per
+            # round decide how many full-model dispatches each token
+            # costs (acceptance_rate * k + 1 tokens per verify)
+            "speculative": self.engine.spec_stats(),
             "latency": {
                 "token": quantiles("serving/gen_token_ms"),
                 "ttft": quantiles("serving/gen_ttft_ms"),
@@ -849,7 +1079,8 @@ class GenerationServer:
             },
             "compiles": {
                 "prefill_buckets": len(self.engine.prefill_buckets),
-                "decode": 1,
+                "decode": 2 if self.engine.speculative else 1,
+                "expected": self.engine.expected_compiles(self.kind),
                 "unexpected": val("serving/gen_unexpected_compiles"),
             },
             "slowest": _tracing.slowest_table(5, root_prefix="serving::"),

@@ -26,6 +26,9 @@
 #             bounded compiles, prefetch-overlap input-wait drop)
 #           + quant smoke (int8 end-to-end: kernel parity, int8 serving
 #             programs, int8 KV cache, quantized all-reduce byte cut)
+#           + spec smoke (speculative decoding: greedy token parity at
+#             exact draft+verify compile counts, self-draft acceptance,
+#             2-process prefill->decode fleet through the KV handoff)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -118,6 +121,12 @@ case "$MODE" in
     # quantized all-reduce's >=3.5x wire-byte cut from the ledger +
     # BERT-smoke loss convergence vs fp32
     JAX_PLATFORMS=cpu python tools/quant_smoke.py
+    # spec smoke: speculative greedy decode token-identical to the plain
+    # engine at exactly len(ladder)+2 compiles (draft + verify), self-
+    # draft acceptance at the ceiling, and a real 1-prefill+1-decode
+    # two-process fleet serving /generate through the KV-slab handoff
+    # with zero unexpected compiles on either tier
+    JAX_PLATFORMS=cpu python tools/spec_decode_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

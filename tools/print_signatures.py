@@ -48,6 +48,7 @@ MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.nets",
     "paddle_tpu.runtime",
+    "paddle_tpu.generation",
 ]
 
 
