@@ -24,7 +24,10 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke
+.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke
+
+lint:  # graphlint gate: pure-AST framework lint, waivers must justify every exception
+	python tools/graphlint.py --check
 
 test:
 	python -m pytest tests/ -q $(DIST_FLAGS)
@@ -72,6 +75,7 @@ spec-smoke:  # speculative decoding: greedy parity, draft+verify compile counts,
 	JAX_PLATFORMS=cpu python tools/spec_decode_smoke.py
 
 check:
+	python tools/graphlint.py --check
 	python tools/check_op_coverage.py --min-pct 90
 	python tools/print_signatures.py --check
 	JAX_PLATFORMS=cpu python __graft_entry__.py

@@ -1,0 +1,52 @@
+"""Static analysis: program-IR verifier + framework-aware source lint.
+
+Two halves (ISSUE 13, in the TVM/compiler-first spirit of PAPERS.md):
+
+- :mod:`verifier` / :mod:`passes` — a pass framework over the static
+  Program IR (``static/program.py``). ``verify_program`` (also exposed as
+  ``Program.verify``) checks def-before-use, duplicate/undeclared-alias
+  writes, kernel dtype consistency, dead ops/vars, and control-flow block
+  well-formedness BEFORE the executor lowers the block to XLA — a
+  malformed program becomes a structured :class:`VerifyError` naming the
+  op index, op type, and variable instead of an opaque trace error.
+  ``Executor.run`` verifies automatically behind ``FLAGS_program_verify``
+  (the verdict is cached per program version, so steady-state dispatch
+  pays one dict lookup — bench.py ``executor_dispatch.program_verify``).
+- :mod:`lint` — AST lint rules encoding recurring review findings
+  (stale trace-time flag reads, unlocked shared-counter mutation, host
+  syncs in decode/dispatch hot loops, weak-typed python-scalar captures).
+  CLI: ``tools/graphlint.py``; waivers: ``tools/graphlint_waivers.txt``.
+"""
+from .verifier import (  # noqa: F401
+    Finding,
+    VerifyError,
+    VerifyReport,
+    register_pass,
+    verifier_passes,
+    verify_program,
+)
+from .lint import (  # noqa: F401
+    LintFinding,
+    lint_file,
+    lint_paths,
+    lint_rules,
+    lint_source,
+)
+from .waivers import Waiver, load_waivers, match_waiver  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "VerifyError",
+    "VerifyReport",
+    "register_pass",
+    "verifier_passes",
+    "verify_program",
+    "LintFinding",
+    "lint_file",
+    "lint_paths",
+    "lint_rules",
+    "lint_source",
+    "Waiver",
+    "load_waivers",
+    "match_waiver",
+]

@@ -1,0 +1,428 @@
+"""Framework-aware AST lint: the recurring review findings as rules.
+
+Each rule encodes a bug class that review passes kept re-finding by hand
+(ISSUE 13 motivation — PR 11's trace-time flag read, PR 12's unlocked
+counter increments and weak-type signature re-keying):
+
+- ``stale-flag-read`` (GL001): a ``flag("...")``/``FLAGS_*``/environ read
+  lexically inside a function that is traced by ``jax.jit`` (directly,
+  via decorator/partial, or by being built inside ``_build_pure`` /
+  ``_trace_*`` builders that hand the closure to the CompiledStore). The
+  read happens ONCE at trace time and bakes the branch into the compiled
+  program — ``set_flags`` afterwards silently changes nothing.
+- ``unlocked-shared-mutation`` (GL002): augmented assignment on a
+  ``self.*`` counter in a class that also spawns threads or serves HTTP,
+  outside any ``with <lock>`` block. Interleaved read-modify-write drops
+  increments — and autoscalers size fleets on these counters.
+- ``host-sync-in-hot-path`` (GL003): ``.item()`` / ``float()`` /
+  ``bool()`` / ``int()`` / ``np.asarray()`` on a traced value inside a
+  decode/dispatch loop — each one is a device->host sync that serializes
+  the dispatch pipeline.
+- ``weak-type-capture`` (GL004): a bare Python int/float literal turned
+  into a device value inside a traced function without a pinned dtype
+  (``jnp.asarray(0)``): the weak-typed scalar promotes (int32->int64
+  under x64) and re-keys every compiled-signature cache it touches.
+
+This module is pure ``ast`` — no jax import — so ``tools/graphlint.py``
+runs in CI without touching an accelerator runtime.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+__all__ = ["LintFinding", "lint_rules", "lint_source", "lint_file",
+           "lint_paths", "RULES"]
+
+
+@dataclass
+class LintFinding:
+    rule: str       # slug, e.g. "stale-flag-read"
+    rule_id: str    # short id, e.g. "GL001"
+    path: str
+    line: int
+    col: int
+    func: str       # enclosing function qualname ("<module>" at top level)
+    message: str
+    hint: str
+
+    def __str__(self):
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule_id} "
+                f"[{self.rule}] in {self.func}: {self.message}\n"
+                f"    fix: {self.hint}")
+
+
+# rule slug -> (id, one-line description, fix hint)
+RULES = {
+    "stale-flag-read": (
+        "GL001",
+        "FLAGS read at trace time inside a jitted function",
+        "read the flag once at construction/build time and close over the "
+        "value; a trace-time read bakes the current value into the "
+        "compiled program and goes stale after set_flags",
+    ),
+    "unlocked-shared-mutation": (
+        "GL002",
+        "unsynchronized augmented assignment on shared instance state in "
+        "a threaded/serving class",
+        "guard the read-modify-write with the object's lock (with "
+        "self._lock:); concurrent += interleaves and drops updates",
+    ),
+    "host-sync-in-hot-path": (
+        "GL003",
+        "device->host sync (.item()/float()/np.asarray) inside a "
+        "decode/dispatch loop",
+        "keep the value on device (jnp ops / lax.cond) or sync once per "
+        "batch outside the loop; each sync stalls the dispatch pipeline",
+    ),
+    "weak-type-capture": (
+        "GL004",
+        "python numeric literal becomes a weak-typed device scalar "
+        "inside a traced function",
+        "pin the dtype (jnp.asarray(0, jnp.int32)); weak scalars promote "
+        "under x64 and re-key compiled-signature caches",
+    ),
+}
+
+
+def lint_rules():
+    """{slug: (id, description, hint)} for docs/CLI."""
+    return dict(RULES)
+
+
+# -- AST plumbing ------------------------------------------------------------
+
+_FUNC_NODES = (ast.FunctionDef, ast.AsyncFunctionDef)
+# callables whose function-valued arguments are traced by XLA
+_JIT_CALLS = {"jit", "pjit", "pmap"}
+# a nested function built inside one of these is handed to jax.jit by its
+# builder (TrainStepFn._build_pure, executor _trace_block, ...)
+_TRACED_BUILDER_PREFIXES = ("_build_pure", "_trace_")
+_LOCKISH = ("lock", "mutex", "cond", "cv", "sem")
+_THREADY_MARKERS = {
+    "Thread", "ThreadPoolExecutor", "ThreadingHTTPServer", "HTTPServer",
+    "BaseHTTPRequestHandler", "serve_forever", "start_new_thread", "Timer",
+    "threading", "socketserver",
+}
+_HOT_NAME_MARKERS = ("decode", "dispatch")
+
+
+def _dotted(node) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_callable(node) -> bool:
+    d = _dotted(node)
+    if d is None:
+        return False
+    leaf = d.rsplit(".", 1)[-1]
+    return leaf in _JIT_CALLS
+
+
+def _numeric_literal(node) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool):
+        return True
+    return (isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, (ast.USub, ast.UAdd))
+            and _numeric_literal(node.operand))
+
+
+class _Index:
+    """Parent links + per-function qualnames + the traced-function set."""
+
+    def __init__(self, tree):
+        self.parent = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+        self.funcs = [n for n in ast.walk(tree) if isinstance(n, _FUNC_NODES)]
+        self.qualname = {f: self._qual(f) for f in self.funcs}
+        self.traced = self._traced_set(tree)
+
+    def _ancestors(self, node):
+        while node in self.parent:
+            node = self.parent[node]
+            yield node
+
+    def _qual(self, fn):
+        parts = [fn.name]
+        for anc in self._ancestors(fn):
+            if isinstance(anc, _FUNC_NODES + (ast.ClassDef,)):
+                parts.append(anc.name)
+        return ".".join(reversed(parts))
+
+    def enclosing_function(self, node):
+        for anc in self._ancestors(node):
+            if isinstance(anc, _FUNC_NODES):
+                return anc
+        return None
+
+    def enclosing_class(self, node):
+        for anc in self._ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def _traced_set(self, tree):
+        roots = set()
+        by_name = {}
+        for f in self.funcs:
+            by_name.setdefault(f.name, []).append(f)
+            # (a) decorated with jit (plain or partial(jax.jit, ...))
+            for dec in f.decorator_list:
+                target = dec
+                if isinstance(dec, ast.Call):
+                    d = _dotted(dec.func)
+                    if d and d.rsplit(".", 1)[-1] == "partial" and dec.args:
+                        target = dec.args[0]
+                    else:
+                        target = dec.func
+                if _is_jit_callable(target):
+                    roots.add(f)
+            # (c) built inside a jit-handing builder (_build_pure etc.)
+            enc = self.enclosing_function(f)
+            if enc is not None and enc.name.startswith(
+                    _TRACED_BUILDER_PREFIXES):
+                roots.add(f)
+        # (b) passed by name into a jit call: jax.jit(step), pmap(fn, ...)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jit_callable(node.func):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        roots.update(by_name.get(arg.id, ()))
+                    elif isinstance(arg, _FUNC_NODES):
+                        roots.add(arg)
+        # transitive: anything lexically inside a traced fn traces with it
+        traced = set(roots)
+        for f in self.funcs:
+            if any(a in roots for a in self._ancestors(f)
+                   if isinstance(a, _FUNC_NODES)):
+                traced.add(f)
+        return traced
+
+    def own_nodes(self, fn):
+        """fn's body nodes, excluding nested function bodies (each nested
+        def reports through its own walk)."""
+        out = []
+        stack = list(fn.body)
+        while stack:
+            node = stack.pop()
+            out.append(node)
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_NODES):
+                    continue
+                stack.append(child)
+        return out
+
+    def under_lock(self, node):
+        for anc in self._ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        expr = expr.func
+                    d = (_dotted(expr) or "").lower()
+                    if any(tok in d for tok in _LOCKISH):
+                        return True
+        return False
+
+    def in_loop_within(self, node, fn):
+        for anc in self._ancestors(node):
+            if anc is fn:
+                return False
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                return True
+        return False
+
+
+# -- the rules ---------------------------------------------------------------
+
+def _emit(findings, rule, path, node, func, message):
+    rid, _desc, hint = RULES[rule]
+    findings.append(LintFinding(
+        rule, rid, path, getattr(node, "lineno", 0),
+        getattr(node, "col_offset", 0), func, message, hint))
+
+
+def _rule_stale_flag_read(idx, path, findings):
+    for fn in idx.traced:
+        qual = idx.qualname[fn]
+        for node in idx.own_nodes(fn):
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                leaf = d.rsplit(".", 1)[-1]
+                if leaf in ("flag", "_flag", "get_flags", "getenv"):
+                    _emit(findings, "stale-flag-read", path, node, qual,
+                          f"{d}(...) runs at trace time inside the jitted "
+                          f"function {fn.name!r}; the value is frozen into "
+                          "the compiled program")
+                elif d.startswith("os.environ"):
+                    _emit(findings, "stale-flag-read", path, node, qual,
+                          "os.environ read at trace time inside a jitted "
+                          "function")
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                ident = node.id if isinstance(node, ast.Name) else node.attr
+                if ident.startswith("FLAGS_"):
+                    _emit(findings, "stale-flag-read", path, node, qual,
+                          f"{ident} read at trace time inside the jitted "
+                          f"function {fn.name!r}")
+
+
+def _rule_unlocked_shared_mutation(idx, path, tree, findings):
+    for cls in [n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)]:
+        concurrent = False
+        for node in ast.walk(cls):
+            ident = None
+            if isinstance(node, ast.Name):
+                ident = node.id
+            elif isinstance(node, ast.Attribute):
+                ident = node.attr
+            if ident in _THREADY_MARKERS:
+                concurrent = True
+                break
+        if not concurrent:
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.AugAssign):
+                continue
+            tgt = node.target
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            fn = idx.enclosing_function(node)
+            if fn is None or fn.name in ("__init__", "__new__"):
+                continue
+            if idx.enclosing_class(fn) is not cls:
+                continue  # belongs to a nested class; judged there
+            if idx.under_lock(node):
+                continue
+            _emit(findings, "unlocked-shared-mutation", path, node,
+                  idx.qualname[fn],
+                  f"self.{tgt.attr} {_augop(node)}= ... mutates shared "
+                  f"state of threaded/serving class {cls.name!r} outside "
+                  "any lock")
+
+
+def _augop(node):
+    return {
+        ast.Add: "+", ast.Sub: "-", ast.Mult: "*", ast.Div: "/",
+        ast.FloorDiv: "//", ast.Mod: "%", ast.BitOr: "|", ast.BitAnd: "&",
+        ast.BitXor: "^",
+    }.get(type(node.op), "?")
+
+
+def _rule_host_sync_in_hot_path(idx, path, findings):
+    for fn in idx.funcs:
+        name = fn.name.lower()
+        hot = (any(m in name for m in _HOT_NAME_MARKERS)
+               or name.endswith("_loop"))
+        if not hot:
+            continue
+        qual = idx.qualname[fn]
+        for node in idx.own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not idx.in_loop_within(node, fn):
+                continue
+            sync = None
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                sync = ".item()"
+            else:
+                d = _dotted(node.func) or ""
+                leaf = d.rsplit(".", 1)[-1]
+                if d in ("np.asarray", "numpy.asarray", "np.array",
+                         "numpy.array"):
+                    sync = f"{d}(...)"
+                elif (leaf in ("float", "bool", "int") and "." not in d
+                        and len(node.args) == 1
+                        and isinstance(node.args[0],
+                                       (ast.Name, ast.Attribute))):
+                    sync = f"{leaf}(...)"
+            if sync:
+                _emit(findings, "host-sync-in-hot-path", path, node, qual,
+                      f"{sync} forces a device->host sync inside the "
+                      f"{fn.name!r} loop")
+
+
+def _rule_weak_type_capture(idx, path, findings):
+    for fn in idx.traced:
+        qual = idx.qualname[fn]
+        for node in idx.own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func) or ""
+            leaf = d.rsplit(".", 1)[-1]
+            has_dtype = any(kw.arg == "dtype" for kw in node.keywords)
+            bad = None
+            if leaf in ("asarray", "array") and d.split(".", 1)[0] in (
+                    "jnp", "jax"):
+                if (len(node.args) == 1 and not has_dtype
+                        and _numeric_literal(node.args[0])):
+                    bad = node.args[0]
+            elif leaf == "full" and d.split(".", 1)[0] in ("jnp", "jax"):
+                if (len(node.args) >= 2 and len(node.args) < 3
+                        and not has_dtype
+                        and _numeric_literal(node.args[1])):
+                    bad = node.args[1]
+            if bad is not None:
+                _emit(findings, "weak-type-capture", path, node, qual,
+                      f"{d}(<python literal>) without dtype= inside the "
+                      f"traced function {fn.name!r} creates a weak-typed "
+                      "scalar")
+
+
+# -- drivers -----------------------------------------------------------------
+
+def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
+    """Lint one source string. Returns findings (empty when clean)."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        f = LintFinding("parse-error", "GL000", path, e.lineno or 0,
+                        e.offset or 0, "<module>", f"syntax error: {e.msg}",
+                        "fix the syntax error")
+        return [f]
+    idx = _Index(tree)
+    findings: List[LintFinding] = []
+    _rule_stale_flag_read(idx, path, findings)
+    _rule_unlocked_shared_mutation(idx, path, tree, findings)
+    _rule_host_sync_in_hot_path(idx, path, findings)
+    _rule_weak_type_capture(idx, path, findings)
+    findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
+
+
+def lint_file(path: str) -> List[LintFinding]:
+    with open(path, encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def lint_paths(paths) -> List[LintFinding]:
+    """Lint every .py file under the given files/directories."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    findings = []
+    for fp in files:
+        findings.extend(lint_file(fp))
+    return findings

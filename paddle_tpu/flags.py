@@ -129,6 +129,19 @@ def globals_view() -> dict:
 define_flag("check_nan_inf", False,
             "scan step outputs for NaN/Inf and name the producing op")
 
+# static/executor.py + static/program.py Program.verify + analysis/ —
+# run the program-IR verifier (def-before-use, write conflicts, kernel
+# dtype consistency, control-flow block well-formedness; analysis/passes)
+# before each program is planned/lowered, raising a structured
+# VerifyError naming the offending op index/type/var instead of an
+# opaque XLA trace error. Values: off | on | strict ("strict" promotes
+# dead-code findings to errors). The verdict is cached per program
+# version, so steady-state dispatch pays a dict lookup (<1%, bench.py
+# executor_dispatch.program_verify sub-row).
+define_flag("program_verify", "on",
+            "verify program IR before lowering: off | on | strict "
+            "(strict also fails on dead ops/vars)")
+
 # platform/flags.cc benchmark — wired into framework/jit.py: synchronous
 # dispatch (block until ready each step) so wall-clock timings are exact
 define_flag("benchmark", False,

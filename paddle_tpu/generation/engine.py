@@ -198,10 +198,13 @@ class GenerationEngine:
             self.draft_k if self.speculative else 0)
         self._base_key = jax.random.PRNGKey(int(seed))
         self._key_step = 0
-        # prefill_export mutates NO cache state, so a prefill tier runs
-        # it from several HTTP threads at once — only the sampling-key
-        # counter needs a guard (a duplicated ctr would correlate two
-        # requests' samples)
+        # the sampling-key counter is bumped from every dispatch path and
+        # those paths run on different threads (prefill from HTTP handler
+        # threads, decode from the batcher loop): every bump goes through
+        # _next_key_step, which locks AND returns the snapshot — a bare
+        # `+= 1` followed by a re-read hands two threads the same ctr,
+        # correlating two requests' samples. The same lock guards the
+        # speculative acceptance counters /statz reads.
         self._key_lock = threading.Lock()
         # speculative acceptance accounting (spec_stats / statz)
         self._spec_rounds = 0
@@ -403,7 +406,10 @@ class GenerationEngine:
                     self.step(np.zeros(self.slots, np.int32),
                               np.zeros(self.slots, np.float32))
         self.reset()  # warmup traffic must not look like live context
-        self._spec_rounds = self._spec_proposed = self._spec_accepted = 0
+        with self._key_lock:
+            self._spec_rounds = 0
+            self._spec_proposed = 0
+            self._spec_accepted = 0
         self.watch.arm()
         self.warmed = True
         _flight.record_event(
@@ -638,6 +644,16 @@ class GenerationEngine:
         padded[:n] = np.asarray(prompt, np.int32)
         return padded, n
 
+    def _next_key_step(self) -> int:
+        """Bump the sampling-key counter under its lock and return the
+        snapshot. Every dispatch site uses the RETURNED value — re-reading
+        ``self._key_step`` after an unlocked ``+=`` is the race graphlint's
+        ``unlocked-shared-mutation`` rule exists for (two threads sampling
+        with the same key)."""
+        with self._key_lock:
+            self._key_step += 1
+            return self._key_step
+
     def admit(self, slot, prompt, temperature=None) -> int:
         """Prefill ``prompt`` into ``slot`` and return the first sampled
         token. The slot's previous occupant is simply overwritten — a
@@ -646,7 +662,7 @@ class GenerationEngine:
         padded, n = self._padded_prompt(prompt)
         temp = (self.default_temperature if temperature is None
                 else float(temperature))
-        self._key_step += 1
+        ctr = self._next_key_step()
         with RecordEvent("generation::prefill"):
             if self.speculative:
                 out = self._dispatch("prefill", self._spec_prefill_jit, (
@@ -654,7 +670,7 @@ class GenerationEngine:
                     self._kv_draft, jnp.asarray(slot, jnp.int32),
                     jnp.asarray(padded[None]), jnp.asarray(n, jnp.int32),
                     jnp.asarray(temp, jnp.float32),
-                    jnp.asarray(self._key_step, jnp.int32)))
+                    jnp.asarray(ctr, jnp.int32)))
                 self._kv, self._kv_draft, tok = out
             else:
                 out = self._dispatch("prefill", self._prefill_jit, (
@@ -663,7 +679,7 @@ class GenerationEngine:
                     jnp.asarray(padded[None]),
                     jnp.asarray(n, jnp.int32),
                     jnp.asarray(temp, jnp.float32),
-                    jnp.asarray(self._key_step, jnp.int32)))
+                    jnp.asarray(ctr, jnp.int32)))
                 self._kv, tok = out
         return int(tok)
 
@@ -677,9 +693,7 @@ class GenerationEngine:
         padded, n = self._padded_prompt(prompt)
         temp = (self.default_temperature if temperature is None
                 else float(temperature))
-        with self._key_lock:
-            self._key_step += 1
-            ctr = self._key_step
+        ctr = self._next_key_step()
         with RecordEvent("generation::prefill_export"):
             planes, tok = self._dispatch(
                 "prefill", self._prefill_export_jit, (
@@ -744,13 +758,13 @@ class GenerationEngine:
         """Decode one token for every slot. ``tokens``/``temps`` are
         host ``[S]`` arrays (vacant slots: anything — their output is
         ignored and their cache entries are overwritten on admission)."""
-        self._key_step += 1
+        ctr = self._next_key_step()
         with RecordEvent("generation::decode"):
             out = self._dispatch("decode", self._decode_jit, (
                 self._state(), self._kv,
                 jnp.asarray(np.asarray(tokens, np.int32)),
                 jnp.asarray(np.asarray(temps, np.float32)),
-                jnp.asarray(self._key_step, jnp.int32)))
+                jnp.asarray(ctr, jnp.int32)))
         self._kv, nxt = out
         return np.asarray(nxt)
 
@@ -772,21 +786,22 @@ class GenerationEngine:
             self._kv_draft, proposals = self._dispatch(
                 "draft", self._draft_jit, (
                     self._draft_state(), self._kv_draft, pos, toks))
-        self._key_step += 1
+        ctr = self._next_key_step()
         with RecordEvent("generation::verify"):
             out = self._dispatch("verify", self._verify_jit, (
                 self._state(), self._kv, toks, proposals,
                 jnp.asarray(np.asarray(temps, np.float32)),
-                jnp.asarray(self._key_step, jnp.int32)))
+                jnp.asarray(ctr, jnp.int32)))
         self._kv, ts, counts = out
         counts = np.asarray(counts)
         n_busy = self.slots if busy is None else len(busy)
         if n_busy:
             accepted = int(counts.sum() - self.slots if busy is None
                            else sum(int(counts[s]) - 1 for s in busy))
-            self._spec_rounds += 1
-            self._spec_proposed += self.draft_k * n_busy
-            self._spec_accepted += accepted
+            with self._key_lock:
+                self._spec_rounds += 1
+                self._spec_proposed += self.draft_k * n_busy
+                self._spec_accepted += accepted
             from ..monitor import counter as _mcounter
 
             _mcounter("generation/spec_rounds_total").inc()
@@ -799,15 +814,17 @@ class GenerationEngine:
         """Speculative acceptance accounting since the last reset/
         warmup: rounds, proposed/accepted draft tokens, acceptance
         rate (the /statz block)."""
+        with self._key_lock:  # consistent snapshot vs a concurrent round
+            rounds, proposed, accepted = (
+                self._spec_rounds, self._spec_proposed, self._spec_accepted)
         return {
             "enabled": self.speculative,
             "draft_k": self.draft_k if self.speculative else 0,
-            "rounds": self._spec_rounds,
-            "proposed": self._spec_proposed,
-            "accepted": self._spec_accepted,
-            "acceptance_rate": round(
-                self._spec_accepted / self._spec_proposed, 4)
-            if self._spec_proposed else None,
+            "rounds": rounds,
+            "proposed": proposed,
+            "accepted": accepted,
+            "acceptance_rate": round(accepted / proposed, 4)
+            if proposed else None,
         }
 
     # -- offline API ----------------------------------------------------------

@@ -8,8 +8,11 @@ VLOG-on-crash breadcrumbs played for the fluid runtime):
 
 - :class:`FlightRecorder` — a lock-cheap fixed-capacity ring buffer of
   structured events: executor run begin/end (program id + plan/jit cache
-  disposition), every collective call with a **per-group monotonic
-  sequence number** and a shape/dtype/reduce-op **fingerprint**, PS RPC
+  disposition), ``program_verify`` verdicts (the IR verifier's pass/fail
+  per program version, with the offending op/var on failure — so a
+  rejected program is in the black box even when the raising process
+  dies), every collective call with a **per-group monotonic sequence
+  number** and a shape/dtype/reduce-op **fingerprint**, PS RPC
   send/recv, DataLoader epoch/worker lifecycle, flag changes, XLA compile
   events. Dumped to JSON on unhandled exception, on ``SIGUSR1``, and on
   watchdog trip.

@@ -743,6 +743,20 @@ class Executor:
         scope = scope or global_scope()
 
         fetch_names = [v if isinstance(v, str) else v.name for v in fetch_list]
+        feed_names = sorted(feed.keys())
+
+        # IR verification gate (FLAGS_program_verify): a malformed program
+        # fails HERE with the op index/type/var named — before any plan,
+        # trace, or XLA lowering sees it. The verdict caches on the
+        # Program per version (program.py Program.verify), so the steady
+        # state pays one flag read + one dict lookup; failures also land
+        # in the flight recorder as `program_verify` events.
+        verify_level = str(flag("program_verify")).strip().lower()
+        if verify_level not in ("", "0", "off", "false", "no"):
+            with RecordEvent("executor::program_verify"):
+                program.verify(
+                    feed_names=feed_names, fetch_list=fetch_names,
+                    level="strict" if verify_level == "strict" else "on")
 
         with RecordEvent("executor::plan"):
             plan, plan_disposition = self._plan_for(program)
@@ -752,8 +766,6 @@ class Executor:
             for cname, cval in plan.constants:
                 if not scope.has(cname):
                     scope.set(cname, cval)
-
-            feed_names = sorted(feed.keys())
 
         with RecordEvent("executor::feed"):  # H2D feed staging
             feed_arrays = []

@@ -142,6 +142,12 @@ def append_static_op(op_type, tensors, attrs, alias_outputs=None):
         out_vars.append(var)
 
     desc_attrs = dict(run_attrs)
+    if alias_outputs:
+        # declared in-place aliasing (batch_norm's running stats): the
+        # op writes vars it also reads — the verifier's write-conflicts
+        # pass accepts exactly the declared set and flags the rest
+        desc_attrs["__inplace__"] = sorted(
+            n for n in alias_outputs.values())
     if is_rng:
         desc_attrs["__rng__"] = True
         # stable per-op id assigned at build time: the grad op copies the

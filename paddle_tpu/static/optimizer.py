@@ -79,8 +79,10 @@ class SGD(StaticOptimizer):
     def _append_update_ops(self, prog, params_grads, lr):
         block = prog.global_block()
         for p, g in params_grads:
+            # __inplace__: the update op writes the param it reads — the
+            # declared aliasing the verifier's write-conflicts pass wants
             block.append_op("sgd", {"X": [p.name, g.name, lr.name]},
-                            {"Out": [p.name]}, {})
+                            {"Out": [p.name]}, {"__inplace__": [p.name]})
 
 
 class Momentum(StaticOptimizer):
@@ -99,7 +101,8 @@ class Momentum(StaticOptimizer):
                 "momentum_update",
                 {"X": [p.name, g.name, vel.name, lr.name]},
                 {"Out": [p.name, vel.name]},
-                {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+                {"mu": self._momentum, "use_nesterov": self._use_nesterov,
+                 "__inplace__": [p.name, vel.name]})
 
 
 class Adam(StaticOptimizer):
@@ -114,7 +117,7 @@ class Adam(StaticOptimizer):
                                 initializer=I.Constant(0.0), trainable=False)
         step.stop_gradient = True
         block.append_op("increment", {"X": [step.name]}, {"Out": [step.name]},
-                        {"value": 1.0})
+                        {"value": 1.0, "__inplace__": [step.name]})
         for p, g in params_grads:
             m1 = create_parameter(p.shape, str(p.dtype), name=p.name + "@moment1",
                                   initializer=I.Constant(0.0), trainable=False)
@@ -124,4 +127,6 @@ class Adam(StaticOptimizer):
                 "adam_update",
                 {"X": [p.name, g.name, m1.name, m2.name, lr.name, step.name]},
                 {"Out": [p.name, m1.name, m2.name]},
-                {"beta1": self._beta1, "beta2": self._beta2, "epsilon": self._epsilon})
+                {"beta1": self._beta1, "beta2": self._beta2,
+                 "epsilon": self._epsilon,
+                 "__inplace__": [p.name, m1.name, m2.name]})

@@ -251,6 +251,86 @@ class Program:
     def global_block(self) -> Block:
         return self.blocks[0]
 
+    def verify(self, feed_names=(), fetch_list=(), level="on"):
+        """Run the program-IR verifier (analysis/) over this program.
+
+        Returns the :class:`~paddle_tpu.analysis.VerifyReport` when the
+        program is well-formed (possibly carrying warnings); raises
+        :class:`~paddle_tpu.analysis.VerifyError` naming the offending
+        block/op index/op type/var otherwise. ``level="strict"``
+        additionally promotes dead-code findings to errors.
+
+        The verdict is cached per (program version, feeds, fetches,
+        level) — any mutation through ``append_op``/``_create_block``
+        bumps ``_version`` and re-verifies — so ``Executor.run``'s
+        automatic call (``FLAGS_program_verify``) costs one dict lookup
+        in steady state (bench.py ``executor_dispatch.program_verify``).
+        """
+        fetch_names = tuple(
+            v if isinstance(v, str) else v.name for v in (fetch_list or ()))
+        # var-count fingerprint: create_var does NOT bump _version (only
+        # append_op/_create_block do), but adding a var can flip a verify
+        # verdict — e.g. declaring the persistable a cached VerifyError
+        # complained about. len(dict) is O(1), so this stays a few ns per
+        # block. (A persistable-flag flip on an EXISTING var remains
+        # invisible — the same documented blind spot as RunPlan's.)
+        n_vars = sum(len(b.vars) for b in self.blocks)
+        feeds = tuple(sorted(feed_names or ()))
+        key = (self._version, n_vars, feeds, fetch_names, level)
+        # __dict__ access: from_dict builds programs via __new__, so the
+        # cache attr may not exist yet
+        cache = self.__dict__.setdefault("_verify_cache", {})
+        hit = cache.get(key)
+        if hit is not None:
+            # LRU refresh: without it a rotation of >capacity distinct
+            # feed/fetch combos FIFO-thrashes and re-runs the full pass
+            # (~ms) on every dispatch
+            cache.pop(key, None)
+            cache[key] = hit
+            if isinstance(hit, Exception):
+                # fresh traceback each raise: re-raising the cached
+                # instance as-is would append frames to its __traceback__
+                # forever (and share the mutable chain across threads)
+                raise hit.with_traceback(None)
+            return hit
+        from ..analysis import VerifyError, verify_program
+
+        try:
+            report = verify_program(self, feeds, fetch_names, level)
+        except VerifyError as e:
+            self._verify_record(key, error=e)
+            raise
+        self._verify_record(key, report=report)
+        return report
+
+    def _verify_record(self, key, report=None, error=None):
+        """Cache a verification verdict (bounded) + flight breadcrumb."""
+        cache = self.__dict__.setdefault("_verify_cache", {})
+        cache[key] = error if error is not None else report
+        # LRU-bounded (hits move-to-end above); entries are small reports,
+        # so the bound covers a predictor serving many fetch subsets
+        while len(cache) > 64:
+            # replica pools verify from N threads: a concurrent evict of
+            # the same oldest key must be a no-op, not a KeyError
+            try:
+                cache.pop(next(iter(cache)), None)
+            except (StopIteration, RuntimeError):
+                break
+        try:  # the black box must never break verification itself
+            from ..monitor import flight_recorder as _flight
+
+            tok = getattr(self, "_identity_token", None)
+            fields = dict(
+                program=f"{tok if tok is not None else id(self)}@v{key[0]}",
+                ok=error is None,
+                warnings=len(report.warnings) if report is not None else 0,
+            )
+            if error is not None:
+                fields["error"] = str(error)[:500]
+            _flight.record_event("program_verify", **fields)
+        except Exception:
+            pass
+
     def current_block(self) -> Block:
         return self.blocks[_current_block_idx[-1]] if _current_block_idx else self.blocks[0]
 

@@ -1,0 +1,20 @@
+"""Known-bad fixture: GL003 host-sync-in-hot-path."""
+import numpy as np
+
+
+def decode_tokens(engine, steps):
+    out = []
+    for _ in range(steps):
+        tok = engine.step()
+        out.append(tok.item())  # BAD: device->host sync per token
+        if float(tok) > 3:  # BAD: another sync in the same loop
+            break
+    return out
+
+
+def dispatch_batches(batches, runner):
+    done = []
+    while batches:
+        b = batches.pop()
+        done.append(np.asarray(runner(b)))  # BAD: sync inside dispatch loop
+    return done

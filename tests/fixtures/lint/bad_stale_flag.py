@@ -1,0 +1,30 @@
+"""Known-bad fixture: GL001 stale-flag-read (PR 11's bug class)."""
+import jax
+
+from paddle_tpu.flags import flag
+
+
+@jax.jit
+def decorated_step(x):
+    # BAD: read at trace time — frozen into the compiled program
+    if flag("check_nan_inf"):
+        x = x + 1
+    return x
+
+
+def build_step():
+    def step(x):
+        scale = flag("monitor_interval")  # BAD: inside a jitted closure
+        return x * scale
+
+    return jax.jit(step)
+
+
+class Builder:
+    def _build_pure(self):
+        def pure(params, batch):
+            if flag("benchmark"):  # BAD: _build_pure hands this to jit
+                return params
+            return batch
+
+        return pure

@@ -1,0 +1,23 @@
+"""Known-bad fixture: GL002 unlocked-shared-mutation (PR 12's bug class)."""
+import threading
+
+
+class Batcher:
+    """Serves from worker threads; counters are scaler inputs."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.dispatched = 0
+        self.rejected = 0
+        self._worker = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.dispatched += 1  # BAD: no lock, threads interleave
+
+    def reject(self):
+        self.rejected += 1  # BAD: racing the worker thread
+
+    def ok_locked(self):
+        with self._lock:
+            self.dispatched += 1  # fine: guarded

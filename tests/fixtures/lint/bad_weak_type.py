@@ -1,0 +1,10 @@
+"""Known-bad fixture: GL004 weak-type-capture (PR 12's re-key bug class)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(pos):
+    one = jnp.asarray(1)  # BAD: weak int — promotes under x64, re-keys
+    base = jnp.full((4,), 0.5)  # BAD: weak float fill
+    return pos + one, base

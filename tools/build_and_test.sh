@@ -10,7 +10,8 @@
 #           crosses processes; compile-heavy files dominate wall time so
 #           sharding gives near-linear speedup)
 #   bench - bench.py smoke on the current backend
-#   check - static gates: op coverage + API spec + graft entry self-test
+#   check - static gates: graphlint (framework-aware AST lint, waiver-
+#           gated) + op coverage + API spec + graft entry self-test
 #           + debugz smoke (debug server endpoints + flight-recorder dump)
 #           + mfu smoke (cost-model capture + utilization endpoints)
 #           + serving smoke (online batcher/replica/HTTP contracts)
@@ -87,6 +88,9 @@ case "$MODE" in
     python bench.py
     ;;
   check)
+    # graphlint gate first: pure AST (no jax), fails on any unwaived
+    # finding or stale waiver (tools/graphlint_waivers.txt)
+    python tools/graphlint.py --check
     python tools/check_op_coverage.py --min-pct 90
     python tools/print_signatures.py --check
     JAX_PLATFORMS=cpu python __graft_entry__.py

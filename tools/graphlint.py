@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""graphlint: framework-aware source lint gate (ISSUE 13).
+
+Runs :mod:`paddle_tpu.analysis.lint` over the tree and reconciles the
+findings with the committed waiver file. Pure AST — never imports jax —
+so it runs first in CI before any test process starts.
+
+Usage:
+    python tools/graphlint.py                     # lint paddle_tpu/ + tools
+    python tools/graphlint.py path/to/file.py     # lint specific paths
+    python tools/graphlint.py --check             # CI gate: nonzero exit on
+                                                  #   any unwaived finding
+                                                  #   (also on unused or
+                                                  #   unjustified waivers)
+    python tools/graphlint.py --list-rules        # rule table
+    python tools/graphlint.py --json              # machine-readable output
+
+Waivers: tools/graphlint_waivers.txt — `<path> <rule> <scope>  # why`.
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_by_path(name, relpath):
+    """Import an analysis module by FILE PATH, bypassing the paddle_tpu
+    package __init__ (which imports jax): the lint gate must run in a
+    bare-python CI stage and never pay the framework import."""
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod  # dataclasses resolves __module__ through here
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_lint = _load_by_path("graphlint_lint", "paddle_tpu/analysis/lint.py")
+_waivers = _load_by_path("graphlint_waivers", "paddle_tpu/analysis/waivers.py")
+lint_paths, lint_rules = _lint.lint_paths, _lint.lint_rules
+WaiverFormatError = _waivers.WaiverFormatError
+load_waivers, match_waiver = _waivers.load_waivers, _waivers.match_waiver
+
+DEFAULT_PATHS = ["paddle_tpu", "tools"]
+DEFAULT_WAIVERS = os.path.join(_REPO, "tools", "graphlint_waivers.txt")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: paddle_tpu/ + "
+                         "tools/; stale-waiver enforcement applies only "
+                         "to this default full-scope run)")
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 on any unwaived finding (CI gate)")
+    ap.add_argument("--waivers", default=DEFAULT_WAIVERS,
+                    help="waiver file (default: tools/graphlint_waivers.txt)")
+    ap.add_argument("--no-waivers", action="store_true",
+                    help="ignore the waiver file (show every finding)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as JSON")
+    ap.add_argument("--list-rules", action="store_true")
+    ns = ap.parse_args(argv)
+
+    if ns.list_rules:
+        for slug, (rid, desc, hint) in sorted(lint_rules().items(),
+                                              key=lambda kv: kv[1][0]):
+            print(f"{rid}  {slug}\n    {desc}\n    fix: {hint}")
+        return 0
+
+    paths = ns.paths or [os.path.join(_REPO, p) for p in DEFAULT_PATHS]
+    for p in paths:
+        # a typo'd path must fail loud, not silently gate nothing
+        if not os.path.exists(p):
+            print(f"graphlint: no such path: {p}", file=sys.stderr)
+            return 2
+        if os.path.isfile(p) and not p.endswith(".py"):
+            print(f"graphlint: not a python file: {p}", file=sys.stderr)
+            return 2
+    findings = lint_paths(paths)
+    # report repo-relative paths so waivers and CI logs are stable
+    for f in findings:
+        ap_path = os.path.abspath(f.path)
+        if ap_path.startswith(_REPO + os.sep):
+            f.path = os.path.relpath(ap_path, _REPO)
+
+    try:
+        waivers = [] if ns.no_waivers else load_waivers(ns.waivers)
+    except WaiverFormatError as e:
+        print(f"graphlint: bad waiver file: {e}", file=sys.stderr)
+        return 2
+
+    open_findings, waived = [], []
+    for f in findings:
+        if match_waiver(waivers, f) is not None:
+            waived.append(f)
+        else:
+            open_findings.append(f)
+    # waiver staleness is only meaningful on a full default-scope run: a
+    # path-scoped invocation (pre-commit on changed files) legitimately
+    # never touches most waivers and must not fail on them
+    unused = [] if ns.paths else [w for w in waivers if not w.used]
+
+    if ns.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in open_findings],
+            "waived": [vars(f) for f in waived],
+            "unused_waivers": [vars(w) for w in unused],
+        }, indent=1))
+    else:
+        for f in open_findings:
+            print(f)
+        if waived:
+            print(f"graphlint: {len(waived)} finding(s) waived "
+                  f"({ns.waivers})")
+        for w in unused:
+            print(f"graphlint: UNUSED waiver {ns.waivers}:{w.line_no}: {w}")
+        if not open_findings:
+            print(f"graphlint: clean ({len(findings)} finding(s) total, "
+                  f"{len(waived)} waived)")
+        else:
+            print(f"graphlint: {len(open_findings)} unwaived finding(s)")
+
+    if ns.check and (open_findings or unused):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

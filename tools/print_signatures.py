@@ -49,6 +49,13 @@ MODULES = [
     "paddle_tpu.nets",
     "paddle_tpu.runtime",
     "paddle_tpu.generation",
+    "paddle_tpu.analysis",
+]
+
+# methods pinned as API surface beyond the module-level names (the spec
+# otherwise only sees constructors): (module, class, method)
+PINNED_METHODS = [
+    ("paddle_tpu.static", "Program", "verify"),
 ]
 
 
@@ -76,6 +83,14 @@ def collect():
                     lines.append(f"{mod_name}.{name}{sig}")
             except Exception:
                 continue
+    for mod_name, cls_name, meth_name in PINNED_METHODS:
+        mod = importlib.import_module(mod_name)
+        meth = getattr(getattr(mod, cls_name), meth_name)
+        try:
+            sig = str(inspect.signature(meth))
+        except (ValueError, TypeError):
+            sig = "(...)"
+        lines.append(f"{mod_name}.{cls_name}.{meth_name}{sig}")
     return sorted(set(lines))
 
 
