@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke
+.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke
 
 lint:  # graphlint gate: pure-AST framework lint, waivers must justify every exception
 	python tools/graphlint.py --check
@@ -73,6 +73,9 @@ quant-smoke:  # int8 end-to-end: kernel parity, int8 serving, int8 KV cache, qua
 
 spec-smoke:  # speculative decoding: greedy parity, draft+verify compile counts, 2-process prefill->decode handoff
 	JAX_PLATFORMS=cpu python tools/spec_decode_smoke.py
+
+memplan-smoke:  # static peak-HBM planner: accuracy envelope, strict admission, <1% dispatch overhead
+	JAX_PLATFORMS=cpu python tools/memplan_smoke.py
 
 check:
 	python tools/graphlint.py --check

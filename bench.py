@@ -1724,6 +1724,35 @@ def bench_executor_dispatch(iters=200):
         full_verify_us = (time.perf_counter() - tfull) * 1e6
         verify_overhead = cached_us / period_us
 
+        # memplan sub-row: the peak-HBM admission gate
+        # (FLAGS_memory_budget_check) pays a cached verdict lookup per
+        # dispatch and ONE full liveness plan per program mutation
+        # epoch — same direct-decomposition discipline as the
+        # program_verify sub-row, same <1% budget. plan_accuracy comes
+        # from the accuracy closure the steady-state loop's first
+        # compile already ledgered (predicted vs XLA memory_analysis).
+        from paddle_tpu.analysis import memory as _memplan
+        from paddle_tpu.monitor import cost_model as _cost
+
+        shapes = {"x": (32, 64), "y": (32, 1)}
+        _memplan.check_memory_budget(prog, feedns, fetchns,
+                                     feed_shapes=shapes)  # warm
+        mem_cached_us = float("inf")
+        for _ in range(5):
+            tv = time.perf_counter()
+            for _ in range(reps):
+                _memplan.check_memory_budget(prog, feedns, fetchns,
+                                             feed_shapes=shapes)
+            mem_cached_us = min(mem_cached_us,
+                                (time.perf_counter() - tv) / reps * 1e6)
+        tfull = time.perf_counter()
+        prog._memplan_cache.clear()
+        plan = _memplan.check_memory_budget(prog, feedns, fetchns,
+                                            feed_shapes=shapes)
+        full_plan_us = (time.perf_counter() - tfull) * 1e6
+        mem_overhead = mem_cached_us / period_us
+        rec = _cost.latest_record("executor")
+
         return {
             "metric": "executor_steady_state_dispatches_per_sec",
             "value": round(iters / dt, 1),
@@ -1739,6 +1768,24 @@ def bench_executor_dispatch(iters=200):
                 "dispatch_period_us": round(period_us, 1),
                 "overhead_pct": round(verify_overhead * 100, 3),
                 "within_target": bool(verify_overhead < 0.01),
+            },
+            "memplan": {
+                # steady-state admission = feed-shape tuples + one dict
+                # lookup; the full liveness plan is per mutation epoch
+                "cached_check_us": round(mem_cached_us, 3),
+                "full_plan_us": round(full_plan_us, 1),
+                "dispatch_period_us": round(period_us, 1),
+                "overhead_pct": round(mem_overhead * 100, 3),
+                "within_target": bool(mem_overhead < 0.01),
+                "predicted_peak_bytes": (
+                    plan.peak_bytes if plan is not None else None),
+                "peak_op": (f"#{plan.peak_op_index} "
+                            f"<{plan.peak_op_type}>"
+                            if plan is not None else None),
+                "plan_accuracy": (
+                    round(rec.plan_accuracy, 4)
+                    if rec is not None and rec.plan_accuracy is not None
+                    else None),
             },
         }
     finally:

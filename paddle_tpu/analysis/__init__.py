@@ -14,8 +14,18 @@ Two halves (ISSUE 13, in the TVM/compiler-first spirit of PAPERS.md):
   pays one dict lookup — bench.py ``executor_dispatch.program_verify``).
 - :mod:`lint` — AST lint rules encoding recurring review findings
   (stale trace-time flag reads, unlocked shared-counter mutation, host
-  syncs in decode/dispatch hot loops, weak-typed python-scalar captures).
+  syncs in decode/dispatch hot loops, weak-typed python-scalar captures,
+  per-token cache materialization in decode/dispatch loops).
   CLI: ``tools/graphlint.py``; waivers: ``tools/graphlint_waivers.txt``.
+- :mod:`memory` (Memplan, ISSUE 14) — interval-based liveness + peak-HBM
+  planning over the same IR: :func:`plan_memory` predicts the peak
+  resident bytes, high-water op, and top live tensors of a run BEFORE
+  any lowering, honoring the ``__inplace__`` aliasing convention, and
+  the liveness-aware donation-safety analysis rejects
+  declared-then-read donated buffers. ``Executor.run`` enforces the
+  device HBM budget through :func:`check_memory_budget` behind
+  ``FLAGS_memory_budget_check``, and every real compile closes the loop
+  via :func:`note_actual` (``plan_accuracy`` vs XLA memory_analysis).
 """
 from .verifier import (  # noqa: F401
     Finding,
@@ -32,9 +42,29 @@ from .lint import (  # noqa: F401
     lint_rules,
     lint_source,
 )
+from .memory import (  # noqa: F401
+    DonationError,
+    MemoryBudgetError,
+    MemoryFinding,
+    MemoryPlan,
+    accuracy_records,
+    check_memory_budget,
+    hbm_budget_bytes,
+    note_actual,
+    plan_memory,
+)
 from .waivers import Waiver, load_waivers, match_waiver  # noqa: F401
 
 __all__ = [
+    "DonationError",
+    "MemoryBudgetError",
+    "MemoryFinding",
+    "MemoryPlan",
+    "accuracy_records",
+    "check_memory_budget",
+    "hbm_budget_bytes",
+    "note_actual",
+    "plan_memory",
     "Finding",
     "VerifyError",
     "VerifyReport",

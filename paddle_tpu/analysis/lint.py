@@ -22,6 +22,13 @@ counter increments and weak-type signature re-keying):
   into a device value inside a traced function without a pinned dtype
   (``jnp.asarray(0)``): the weak-typed scalar promotes (int32->int64
   under x64) and re-keys every compiled-signature cache it touches.
+- ``cache-pull-in-hot-loop`` (GL005): host materialization of a device
+  CACHE array (``np.asarray(self._kv)``-style whole-cache pulls,
+  ``.numpy()``/``.tolist()``/``.copy()`` on kv/cache/slab-named values)
+  inside a decode/dispatch loop — each iteration allocates and copies
+  the entire cache to host, turning an O(1)-per-token step into
+  O(cache) per token (ISSUE 14; the memory planner budgets the cache
+  as RESIDENT device state, not a per-token host round trip).
 
 This module is pure ``ast`` — no jax import — so ``tools/graphlint.py``
 runs in CI without touching an accelerator runtime.
@@ -84,6 +91,14 @@ RULES = {
         "pin the dtype (jnp.asarray(0, jnp.int32)); weak scalars promote "
         "under x64 and re-key compiled-signature caches",
     ),
+    "cache-pull-in-hot-loop": (
+        "GL005",
+        "whole-cache host materialization (np.asarray/.numpy()/.tolist()/"
+        ".copy() of a kv/cache/slab value) inside a decode/dispatch loop",
+        "keep the cache on device (functional index updates) and pull "
+        "only the per-step slice once per loop exit; a per-token "
+        "whole-cache pull allocates and copies O(cache) bytes per token",
+    ),
 }
 
 
@@ -107,6 +122,12 @@ _THREADY_MARKERS = {
     "threading", "socketserver",
 }
 _HOT_NAME_MARKERS = ("decode", "dispatch")
+# dotted-name tokens that mark a value as a device CACHE (GL005): the
+# arrays whose per-token host materialization is O(cache) per token
+_CACHE_NAME_MARKERS = ("cache", "kv", "slab", "planes")
+# host-materializing zero-arg methods (GL005): each allocates a fresh
+# host copy of the receiver
+_MATERIALIZE_METHODS = ("numpy", "tolist", "copy")
 
 
 def _dotted(node) -> Optional[str]:
@@ -384,6 +405,60 @@ def _rule_weak_type_capture(idx, path, findings):
                       "scalar")
 
 
+def _cache_named(node) -> Optional[str]:
+    """Dotted name of ``node`` when it names a cache-like value
+    (contains a kv/cache/slab token segment-wise), else None. Sees
+    through subscripts: ``self._kv[0]`` pulls the same cache."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    d = _dotted(node)
+    if d is None:
+        return None
+    lowered = d.lower()
+    segments = lowered.replace("self.", "").split(".")
+    for seg in segments:
+        for tok in _CACHE_NAME_MARKERS:
+            if tok in seg:
+                return d
+    return None
+
+
+def _rule_cache_pull_in_hot_loop(idx, path, findings):
+    for fn in idx.funcs:
+        name = fn.name.lower()
+        hot = (any(m in name for m in _HOT_NAME_MARKERS)
+               or name.endswith("_loop"))
+        if not hot:
+            continue
+        qual = idx.qualname[fn]
+        for node in idx.own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            if not idx.in_loop_within(node, fn):
+                continue
+            pull, target = None, None
+            d = _dotted(node.func) or ""
+            leaf = d.rsplit(".", 1)[-1]
+            # np/numpy only: jnp.asarray of a device array is a free
+            # device-side no-op, not a host pull
+            if (d.split(".", 1)[0] in ("np", "numpy")
+                    and leaf in ("asarray", "array") and node.args):
+                target = _cache_named(node.args[0])
+                if target:
+                    pull = f"{d}({target})"
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _MATERIALIZE_METHODS
+                    and not node.args):
+                target = _cache_named(node.func.value)
+                if target:
+                    pull = f"{target}.{node.func.attr}()"
+            if pull:
+                _emit(findings, "cache-pull-in-hot-loop", path, node, qual,
+                      f"{pull} materializes the whole cache on host every "
+                      f"iteration of the {fn.name!r} loop — O(cache) "
+                      "bytes allocated per token")
+
+
 # -- drivers -----------------------------------------------------------------
 
 def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
@@ -401,6 +476,7 @@ def lint_source(source: str, path: str = "<string>") -> List[LintFinding]:
     _rule_unlocked_shared_mutation(idx, path, tree, findings)
     _rule_host_sync_in_hot_path(idx, path, findings)
     _rule_weak_type_capture(idx, path, findings)
+    _rule_cache_pull_in_hot_loop(idx, path, findings)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 

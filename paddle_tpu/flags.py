@@ -142,6 +142,23 @@ define_flag("program_verify", "on",
             "verify program IR before lowering: off | on | strict "
             "(strict also fails on dead ops/vars)")
 
+# static/executor.py + analysis/memory.py — static peak-HBM admission:
+# before any lower/compile, plan the program's liveness footprint
+# (analysis.plan_memory) and compare the predicted peak against the
+# device HBM capacity from the cost-model peaks table (hbm_bytes,
+# overridable via FLAGS_device_peaks). "strict" rejects over-budget
+# programs (MemoryBudgetError naming the high-water op + top tensors)
+# and liveness-unsafe donations (DonationError) BEFORE compiling;
+# "warn" records the same verdicts as memory_budget flight events and
+# a Python warning but admits. Verdicts cache per program version —
+# steady-state dispatch pays a dict lookup (<1%, bench.py
+# executor_dispatch.memplan). The generation engine applies the same
+# budget to its slots x cache-len x dtype geometry at construction.
+define_flag("memory_budget_check", "warn",
+            "static peak-HBM admission before compile: off | warn | "
+            "strict (strict rejects over-budget programs and unsafe "
+            "donations with the high-water op named)")
+
 # platform/flags.cc benchmark — wired into framework/jit.py: synchronous
 # dispatch (block until ready each step) so wall-clock timings are exact
 define_flag("benchmark", False,

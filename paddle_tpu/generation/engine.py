@@ -196,6 +196,12 @@ class GenerationEngine:
                     "positions")
         self.store_len = self.cache_len + (
             self.draft_k if self.speculative else 0)
+        # static capacity admission (FLAGS_memory_budget_check): the
+        # slots x cache-len x dtype geometry is budgeted against the
+        # device HBM BEFORE the rings allocate — a fleet operator learns
+        # "this geometry cannot fit; suggest_decode_slots says N" at
+        # boot, not as an allocator OOM mid-warmup
+        self.check_memory_budget()
         self._base_key = jax.random.PRNGKey(int(seed))
         self._key_step = 0
         # the sampling-key counter is bumped from every dispatch path and
@@ -316,6 +322,118 @@ class GenerationEngine:
         return _cache.kv_bytes_per_token(
             self._num_layers, self._num_heads, self._head_dim,
             self.kv_cache_dtype)
+
+    # -- static HBM capacity planning -----------------------------------------
+
+    @staticmethod
+    def _module_nbytes(model) -> int:
+        total = 0
+        for _n, p in model.named_parameters():
+            a = p._array
+            total += int(np.prod(a.shape, dtype=np.int64)) \
+                * np.dtype(a.dtype).itemsize
+        for _n, b in model.named_buffers():
+            if b is None:
+                continue
+            a = b._array
+            total += int(np.prod(a.shape, dtype=np.int64)) \
+                * np.dtype(a.dtype).itemsize
+        return total
+
+    def param_nbytes(self) -> int:
+        """Device bytes the model weights occupy (target + draft when
+        speculative) — the fixed term of the capacity plan."""
+        total = self._module_nbytes(self.model)
+        if self.speculative:
+            total += self._module_nbytes(self.draft_model)
+        return total
+
+    def slot_nbytes(self, kv_cache_dtype=None) -> int:
+        """Ring bytes ONE decode slot costs at this engine's geometry:
+        ``store_len x kv_bytes_per_token`` (values + scales at int8)
+        plus the slot's position word, plus the draft ring's analog when
+        speculative — the per-slot divisor of
+        :meth:`suggest_decode_slots`."""
+        dtype = str(kv_cache_dtype if kv_cache_dtype is not None
+                    else self.kv_cache_dtype)
+        per = self.store_len * _cache.kv_bytes_per_token(
+            self._num_layers, self._num_heads, self._head_dim, dtype) + 4
+        if self.speculative:
+            per += self.store_len * _cache.kv_bytes_per_token(
+                self._draft_layers, self._draft_heads, self._draft_dim,
+                dtype)
+        return per
+
+    def hbm_required_bytes(self, slots=None, kv_cache_dtype=None) -> int:
+        """Predicted device bytes the engine's geometry holds resident:
+        weights plus ``slots`` rings — the static plan the capacity
+        admission and :meth:`suggest_decode_slots` budget against
+        (matches :meth:`cache_nbytes` on the real arrays)."""
+        n = int(slots if slots is not None else self.slots)
+        return self.param_nbytes() + n * self.slot_nbytes(kv_cache_dtype)
+
+    def suggest_decode_slots(self, hbm_budget_bytes=None,
+                             kv_cache_dtype=None) -> int:
+        """Decode slots this model fits in ``hbm_budget_bytes`` (default:
+        the device HBM from the cost-model peaks table): ``(budget -
+        weights) // slot_nbytes``. ``kv_cache_dtype`` asks the other
+        cache mode's answer (int8 roughly doubles the count) without
+        rebuilding the engine — the serving-capacity recipe in README
+        "Memory planning"."""
+        if hbm_budget_bytes is None:
+            from ..analysis.memory import hbm_budget_bytes as _budget
+
+            hbm_budget_bytes = _budget()
+        avail = int(hbm_budget_bytes) - self.param_nbytes()
+        if avail <= 0:
+            return 0
+        return int(avail // self.slot_nbytes(kv_cache_dtype))
+
+    def check_memory_budget(self, level=None, budget_bytes=None):
+        """Refuse (strict) or warn about a slots x cache-len x dtype
+        geometry the static plan says cannot fit the device HBM.
+        ``level`` defaults to ``FLAGS_memory_budget_check``; returns the
+        required bytes when admitted."""
+        from ..analysis.memory import (
+            MemoryBudgetError,
+            _fmt_bytes,
+            hbm_budget_bytes as _budget,
+        )
+
+        lvl = str(level if level is not None
+                  else flag("memory_budget_check")).strip().lower()
+        if lvl in ("", "0", "off", "false", "no"):
+            return None
+        budget = int(budget_bytes if budget_bytes is not None
+                     else _budget())
+        required = self.hbm_required_bytes()
+        if budget <= 0 or required <= budget:
+            return required
+        fits = self.suggest_decode_slots(budget)
+        msg = (
+            f"generation geometry cannot fit: {self.slots} slot(s) x "
+            f"cache_len {self.cache_len} (store {self.store_len}) x "
+            f"{self.kv_cache_dtype} KV needs "
+            f"{_fmt_bytes(required)} (weights "
+            f"{_fmt_bytes(self.param_nbytes())} + "
+            f"{_fmt_bytes(self.slot_nbytes())}/slot) against "
+            f"{_fmt_bytes(budget)} HBM; suggest_decode_slots("
+            f"{budget}) = {fits}"
+            + ("" if self.kv_cache_dtype == "int8" else
+               f" (int8 KV would fit "
+               f"{self.suggest_decode_slots(budget, 'int8')})"))
+        _flight.record_event(
+            "memory_budget", scope="generation", verdict="over_budget",
+            required_bytes=required, budget_bytes=budget,
+            slots=self.slots, cache_len=self.cache_len,
+            kv_cache_dtype=self.kv_cache_dtype, suggested_slots=fits)
+        if lvl == "strict":
+            raise MemoryBudgetError(msg, budget_bytes=budget)
+        import warnings
+
+        warnings.warn(f"memory_budget_check={lvl}: {msg}",
+                      RuntimeWarning, stacklevel=3)
+        return required
 
     # -- compile accounting ---------------------------------------------------
 

@@ -126,7 +126,9 @@ class CostRecord:
 
     __slots__ = ("key", "label", "flops", "bytes_accessed",
                  "argument_bytes", "output_bytes", "temp_bytes",
-                 "peak_hbm_bytes", "partial", "meta", "runs", "created_t")
+                 "alias_bytes", "peak_hbm_bytes", "partial", "meta",
+                 "runs", "created_t", "predicted_peak_bytes",
+                 "plan_accuracy")
 
     def __init__(self, key, label, cost, mem, meta):
         self.key = key
@@ -138,15 +140,26 @@ class CostRecord:
         self.argument_bytes = int(mem.get("argument_size_in_bytes", 0))
         self.output_bytes = int(mem.get("output_size_in_bytes", 0))
         self.temp_bytes = int(mem.get("temp_size_in_bytes", 0))
+        # donated input/output pairs share one buffer; alias_bytes is
+        # that shared size, so arg+out+temp-alias is the true resident
+        # footprint (the planner's actual-side comparison, analysis/
+        # memory.note_actual)
+        self.alias_bytes = int(mem.get("alias_size_in_bytes", 0))
         # the program's live-HBM high-water mark: inputs + outputs + XLA
-        # scratch (aliased pairs share buffers, but argument/output sizes
-        # both count them — close enough for a footprint gauge)
+        # scratch (aliased pairs count on BOTH sides here — the historic
+        # gauge semantics; subtract alias_bytes for the true resident
+        # footprint, as note_actual does)
         self.peak_hbm_bytes = (self.argument_bytes + self.output_bytes
                                + self.temp_bytes)
         self.partial = cost is None or mem is None
         self.meta = dict(meta)
         self.runs = 0
         self.created_t = time.time()
+        # closed by analysis.memory.note_actual after the first dispatch
+        # of a statically-planned program (predicted peak vs this
+        # record's arg+out+temp-alias)
+        self.predicted_peak_bytes = None
+        self.plan_accuracy = None
 
     def to_dict(self) -> dict:
         return {
@@ -155,7 +168,11 @@ class CostRecord:
             "argument_bytes": self.argument_bytes,
             "output_bytes": self.output_bytes,
             "temp_bytes": self.temp_bytes,
+            "alias_bytes": self.alias_bytes,
             "peak_hbm_bytes": self.peak_hbm_bytes,
+            "predicted_peak_bytes": self.predicted_peak_bytes,
+            "plan_accuracy": (round(self.plan_accuracy, 4)
+                              if self.plan_accuracy is not None else None),
             "arithmetic_intensity": (
                 self.flops / self.bytes_accessed
                 if self.bytes_accessed else 0.0),
@@ -243,25 +260,36 @@ def reset_cost_records():
 # ---------------------------------------------------------------------------
 
 # (device_kind substring match, ordered most-specific first) -> peaks in
-# FLOP/s (bf16 dense MXU), HBM bytes/s, ICI bytes/s per chip. Published
-# per-chip numbers; new silicon or derated SKUs override any subset via
-# FLAGS_device_peaks.
+# FLOP/s (bf16 dense MXU), HBM bytes/s, ICI bytes/s, and HBM CAPACITY
+# bytes per chip. Published per-chip numbers; new silicon or derated
+# SKUs override any subset via FLAGS_device_peaks. hbm_bytes is the
+# memory-budget denominator the static planner admits against
+# (analysis/memory.check_memory_budget, FLAGS_memory_budget_check).
 _PEAKS_TABLE = (
-    ("v6", {"flops": 918e12, "hbm_bw": 1640e9, "ici_bw": 448e9}),
-    ("v5p", {"flops": 459e12, "hbm_bw": 2765e9, "ici_bw": 600e9}),
-    ("v5 lite", {"flops": 197e12, "hbm_bw": 819e9, "ici_bw": 200e9}),
-    ("v5e", {"flops": 197e12, "hbm_bw": 819e9, "ici_bw": 200e9}),
-    ("v5", {"flops": 459e12, "hbm_bw": 2765e9, "ici_bw": 600e9}),
-    ("v4", {"flops": 275e12, "hbm_bw": 1228e9, "ici_bw": 300e9}),
-    ("v3", {"flops": 123e12, "hbm_bw": 900e9, "ici_bw": 140e9}),
-    ("v2", {"flops": 45e12, "hbm_bw": 700e9, "ici_bw": 100e9}),
+    ("v6", {"flops": 918e12, "hbm_bw": 1640e9, "ici_bw": 448e9,
+            "hbm_bytes": 32e9}),
+    ("v5p", {"flops": 459e12, "hbm_bw": 2765e9, "ici_bw": 600e9,
+             "hbm_bytes": 95e9}),
+    ("v5 lite", {"flops": 197e12, "hbm_bw": 819e9, "ici_bw": 200e9,
+                 "hbm_bytes": 16e9}),
+    ("v5e", {"flops": 197e12, "hbm_bw": 819e9, "ici_bw": 200e9,
+             "hbm_bytes": 16e9}),
+    ("v5", {"flops": 459e12, "hbm_bw": 2765e9, "ici_bw": 600e9,
+            "hbm_bytes": 95e9}),
+    ("v4", {"flops": 275e12, "hbm_bw": 1228e9, "ici_bw": 300e9,
+            "hbm_bytes": 32e9}),
+    ("v3", {"flops": 123e12, "hbm_bw": 900e9, "ici_bw": 140e9,
+            "hbm_bytes": 32e9}),
+    ("v2", {"flops": 45e12, "hbm_bw": 700e9, "ici_bw": 100e9,
+            "hbm_bytes": 16e9}),
 )
 
 # CPU / unknown backends get NOMINAL peaks (order-of-magnitude host
 # numbers) so the utilization plumbing works everywhere — the absolute
 # MFU is only meaningful on known silicon or with FLAGS_device_peaks set,
 # and the payload says so via "nominal": true.
-_NOMINAL_PEAKS = {"flops": 1e11, "hbm_bw": 5e10, "ici_bw": 1e10}
+_NOMINAL_PEAKS = {"flops": 1e11, "hbm_bw": 5e10, "ici_bw": 1e10,
+                  "hbm_bytes": 8e9}
 
 _detected_kind = [None]  # cache: jax backend init is not free
 _parse_memo = [None, {}]  # [last raw flag string, its parsed overrides]
@@ -280,9 +308,10 @@ def _device_kind() -> str:
 
 def _parse_peaks_flag(raw: str) -> dict:
     """``FLAGS_device_peaks``: comma-separated ``k=v`` floats over
-    {flops, hbm_bw, ici_bw} (units: FLOP/s, B/s, B/s). Unknown keys and
-    unparseable entries are ignored loudly-enough (they simply don't
-    override), so a typo degrades to the detected table, not a crash."""
+    {flops, hbm_bw, ici_bw, hbm_bytes} (units: FLOP/s, B/s, B/s, B).
+    Unknown keys and unparseable entries are ignored loudly-enough (they
+    simply don't override), so a typo degrades to the detected table,
+    not a crash."""
     out = {}
     for part in raw.split(","):
         part = part.strip()
@@ -290,7 +319,7 @@ def _parse_peaks_flag(raw: str) -> dict:
             continue
         k, _, v = part.partition("=")
         k = k.strip().lower()
-        if k not in ("flops", "hbm_bw", "ici_bw"):
+        if k not in ("flops", "hbm_bw", "ici_bw", "hbm_bytes"):
             continue
         try:
             out[k] = float(v)
@@ -300,11 +329,12 @@ def _parse_peaks_flag(raw: str) -> dict:
 
 
 def device_peaks(kind=None) -> dict:
-    """Peak throughput sheet for the detected (or given) device kind:
-    ``{"kind", "flops", "hbm_bw", "ici_bw", "nominal"}`` — the MFU/
-    bandwidth/roofline denominators. ``FLAGS_device_peaks`` overrides any
-    subset; an override clears the nominal marker (the operator asserted
-    real numbers)."""
+    """Peak throughput/capacity sheet for the detected (or given) device
+    kind: ``{"kind", "flops", "hbm_bw", "ici_bw", "hbm_bytes",
+    "nominal"}`` — the MFU/bandwidth/roofline denominators plus the HBM
+    capacity the static memory planner budgets against.
+    ``FLAGS_device_peaks`` overrides any subset; an override clears the
+    nominal marker (the operator asserted real numbers)."""
     kind = kind if kind is not None else _device_kind()
     lowered = kind.lower()
     peaks, nominal = None, True

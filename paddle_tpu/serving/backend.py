@@ -166,7 +166,18 @@ def _announce_port(path, port):
 
 def main(argv=None) -> int:
     args = _parse_args(argv)
-    srv = build_server(args)
+    from ..analysis import MemoryBudgetError
+
+    try:
+        srv = build_server(args)
+    except MemoryBudgetError as e:
+        # the static capacity plan refuses a slots x cache-len x dtype
+        # geometry that cannot fit the device HBM
+        # (FLAGS_memory_budget_check=strict) — a clean boot-time
+        # refusal naming the fitting geometry, not a traceback the
+        # launcher has to grep out of an OOMed warmup
+        print(f"backend refused: {e}", file=sys.stderr, flush=True)
+        return 2
     srv.start(warmup=True)  # /healthz flips ready only after warmup
     if args.port_file:
         _announce_port(args.port_file, srv.port)

@@ -134,11 +134,17 @@ def _utilization(t0, flops0, val):
     uptime = max(time.monotonic() - t0, 1e-9)
     executed = val("cost/executed_flops") - flops0
     peaks = _cost.device_peaks()
+    # plan_accuracy: predicted-vs-actual peak HBM of the most recently
+    # compiled statically-planned program (analysis.memory.note_actual);
+    # 0 means no planned compile has closed the loop yet
+    accuracy = val("memplan/plan_accuracy")
     return uptime, {
         "executed_flops": executed,
         "mfu_avg": round(_cost.mfu(executed / uptime, peaks), 6),
         "device_kind": peaks.get("kind"),
         "peaks_nominal": peaks.get("nominal"),
+        "hbm_budget_bytes": peaks.get("hbm_bytes"),
+        "plan_accuracy": round(accuracy, 4) if accuracy else None,
     }
 
 
@@ -964,6 +970,14 @@ class GenerationServer:
                 else:
                     self._prefill_waiting -= 1
 
+    def _suggested_slots(self):
+        """Decode slots the device HBM budget would fit at this
+        geometry, or None when the budget is unknown (statz field)."""
+        try:
+            return self.engine.suggest_decode_slots()
+        except Exception:
+            return None
+
     def cache_geometry(self) -> dict:
         """The slab-compatibility contract both handoff tiers must
         agree on — checked before any insert."""
@@ -1067,6 +1081,11 @@ class GenerationServer:
                 "kv_cache_dtype": self.engine.kv_cache_dtype,
                 "kv_bytes_per_token": self.engine.kv_bytes_per_token(),
                 "kv_cache_bytes": self.engine.cache_nbytes(),
+                # static capacity plan: what the geometry needs vs what
+                # the device offers, and the slots the budget would fit
+                # (analysis/memory + engine.suggest_decode_slots)
+                "hbm_required_bytes": self.engine.hbm_required_bytes(),
+                "suggested_decode_slots": self._suggested_slots(),
             },
             # speculative decoding economics: proposals accepted per
             # round decide how many full-model dispatches each token

@@ -272,6 +272,19 @@ def _sync_persistent_cache():
 watch_flag("persistent_compile_cache_dir", lambda _v: _sync_persistent_cache())
 
 
+def _feed_shape(v):
+    """Shape of a feed value without materializing it (Tensor, device
+    array, ndarray, or nested list) — the memory-admission cache key's
+    per-run component, so it must stay allocation-light."""
+    a = getattr(v, "_array", None)
+    if a is not None:
+        return tuple(a.shape)
+    s = getattr(v, "shape", None)
+    if s is not None:
+        return tuple(s)
+    return tuple(np.shape(v))
+
+
 def _plan_key(program):
     tok = getattr(program, "_identity_token", None)
     if tok is None:
@@ -758,6 +771,27 @@ class Executor:
                     feed_names=feed_names, fetch_list=fetch_names,
                     level="strict" if verify_level == "strict" else "on")
 
+        # Static peak-HBM admission (FLAGS_memory_budget_check): plan the
+        # program's liveness footprint and compare it against the device
+        # HBM budget BEFORE any plan/lower/compile — an over-budget
+        # program (or a liveness-unsafe donation) fails here with the
+        # high-water op and top tensors named instead of OOMing
+        # mid-compile. Verdicts cache per program version (the verifier-
+        # cache discipline), so steady state pays feed-shape tuples plus
+        # one dict lookup (bench.py executor_dispatch.memplan, <1%).
+        mem_plan = None
+        budget_level = str(flag("memory_budget_check")).strip().lower()
+        if budget_level not in ("", "0", "off", "false", "no"):
+            from ..analysis import memory as _memory
+
+            feed_shapes = {n: _feed_shape(feed[n]) for n in feed_names}
+            with RecordEvent("executor::memory_plan"):
+                mem_plan = _memory.check_memory_budget(
+                    program, feed_names, fetch_names,
+                    feed_shapes=feed_shapes,
+                    level="strict" if budget_level == "strict"
+                    else "warn")
+
         with RecordEvent("executor::plan"):
             plan, plan_disposition = self._plan_for(program)
             block = plan.block
@@ -886,6 +920,14 @@ class Executor:
             raise
         # (the executed-work ledger bump and the trace's flops/cache_key
         # annotation happened inside the shared runtime's dispatch)
+        if first_run and mem_plan is not None:
+            # accuracy closure: the AOT compile just captured XLA's own
+            # memory_analysis — ledger predicted-vs-actual so the planner
+            # is certified against what the compiler actually built
+            # (plan_accuracy on the CostRecord, /costz, /statz gauge)
+            from ..analysis import memory as _memory
+
+            _memory.note_actual(entry.record, mem_plan)
         if donate_names:
             bump_counter("executor::donated_buffers", len(donate_names))
             # a fetch may share its buffer with a value the scope holds and

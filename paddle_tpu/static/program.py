@@ -331,6 +331,23 @@ class Program:
         except Exception:
             pass
 
+    def plan_memory(self, feed_names=(), fetch_list=(), feed_shapes=None,
+                    top_k=8):
+        """Static liveness + peak-HBM plan for this program
+        (:func:`paddle_tpu.analysis.plan_memory`): predicted peak
+        resident bytes, the high-water op index, the per-op resident
+        curve, and the top-K largest live tensors — computed from the
+        IR alone, before any lowering. ``feed_shapes`` (``{name: shape
+        tuple}``) concretizes ``-1`` batch dims. ``Executor.run``
+        enforces the device HBM budget against this plan behind
+        ``FLAGS_memory_budget_check``."""
+        from ..analysis import plan_memory as _plan
+
+        fetch_names = tuple(
+            v if isinstance(v, str) else v.name for v in (fetch_list or ()))
+        return _plan(self, tuple(feed_names or ()), fetch_names,
+                     feed_shapes=feed_shapes, top_k=top_k)
+
     def current_block(self) -> Block:
         return self.blocks[_current_block_idx[-1]] if _current_block_idx else self.blocks[0]
 

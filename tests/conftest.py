@@ -71,6 +71,9 @@ def _reset_telemetry():
     profiler.reset_counters()
     monitor.reset_registry(unregister=True)
     monitor.cost_model.reset_cost_records()
+    from paddle_tpu.analysis import memory as _memplan
+
+    _memplan.reset_accuracy_records()
     monitor.tracing.reset_store()
     monitor.cluster.stop_publisher()
     monitor.flight_recorder.reset_recorder()

@@ -1,0 +1,20 @@
+"""Known-bad fixture: GL005 cache-pull-in-hot-loop."""
+import numpy as np
+
+
+class Engine:
+    def decode_stream(self, steps):
+        out = []
+        for _ in range(steps):
+            snap = np.asarray(self._kv[0])  # BAD: whole-cache pull/token
+            out.append(int(snap[0, 0]))
+        return out
+
+    def dispatch_slots(self, requests):
+        done = []
+        while requests:
+            req = requests.pop()
+            done.append(self.cache.numpy())  # BAD: materialize per slot
+            planes = req.slab_planes
+            done.append(planes.tolist())  # BAD: slab copied per request
+        return done

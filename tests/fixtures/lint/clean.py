@@ -41,3 +41,9 @@ class Batcher:
 def decode_tokens(engine, steps):
     toks = [engine.step() for _ in range(steps)]
     return np.asarray(toks).tolist()  # ONE sync, outside the loop
+
+
+def decode_with_cache(engine, steps):
+    for _ in range(steps):
+        engine.step()  # cache stays on device across the loop
+    return np.asarray(engine.kv_cache)  # ONE pull, after the loop

@@ -470,6 +470,68 @@ def test_lint_clean_fixture_is_clean():
     assert lint_file(os.path.join(FIXTURES, "clean.py")) == []
 
 
+def test_lint_gl005_cache_pull_fixture():
+    """GL005 (ISSUE 14): per-token host materialization of a device
+    cache in decode/dispatch hot loops — the np.asarray(cache) pull
+    also double-flags as GL003 (it IS a host sync too), the method-call
+    pulls (.numpy()/.tolist()) are GL005's own territory."""
+    findings = lint_file(os.path.join(FIXTURES, "bad_alloc_loop.py"))
+    gl5 = [f for f in findings if f.rule_id == "GL005"]
+    assert len(gl5) == 3
+    assert {f.func.rsplit(".", 1)[-1] for f in gl5} == {
+        "decode_stream", "dispatch_slots"}
+    for f in gl5:
+        assert f.rule == "cache-pull-in-hot-loop"
+        assert "O(cache)" in f.message and f.hint
+
+
+def test_lint_gl005_negative_cases():
+    from paddle_tpu.analysis import lint_source
+
+    # pull AFTER the loop: one materialization per call, fine
+    clean = (
+        "import numpy as np\n"
+        "def decode_all(eng, n):\n"
+        "    for _ in range(n):\n"
+        "        eng.step()\n"
+        "    return np.asarray(eng.kv_cache)\n")
+    assert [f for f in lint_source(clean) if f.rule_id == "GL005"] == []
+    # cache pull in a NON-hot function: not this rule's business
+    cold = (
+        "import numpy as np\n"
+        "def summarize(eng):\n"
+        "    out = []\n"
+        "    for layer in eng.layers:\n"
+        "        out.append(np.asarray(layer.kv_cache))\n"
+        "    return out\n")
+    assert [f for f in lint_source(cold) if f.rule_id == "GL005"] == []
+    # non-cache values in a hot loop: GL003's territory, not GL005's
+    other = (
+        "import numpy as np\n"
+        "def decode_loop(eng, n):\n"
+        "    outs = []\n"
+        "    for _ in range(n):\n"
+        "        outs.append(np.asarray(eng.step()))\n"
+        "    return outs\n")
+    assert [f for f in lint_source(other) if f.rule_id == "GL005"] == []
+    # subscripted cache pull IS caught (self._kv[0] pulls the cache)
+    sub = (
+        "import numpy as np\n"
+        "def decode_span(eng, n):\n"
+        "    for _ in range(n):\n"
+        "        _ = np.asarray(eng._kv[0])\n")
+    assert len([f for f in lint_source(sub)
+                if f.rule_id == "GL005"]) == 1
+    # jnp.asarray of a device cache is a free device-side no-op, NOT a
+    # host pull — it must not be flagged
+    dev = (
+        "import jax.numpy as jnp\n"
+        "def decode_span(eng, n):\n"
+        "    for _ in range(n):\n"
+        "        _ = jnp.asarray(eng._kv[0])\n")
+    assert [f for f in lint_source(dev) if f.rule_id == "GL005"] == []
+
+
 def test_lint_rule_ids_unique_and_documented():
     rules = lint_rules()
     ids = [rid for rid, _, _ in rules.values()]

@@ -1,0 +1,491 @@
+"""Memplan: static liveness + peak-HBM planner (ISSUE 14).
+
+Golden programs with HAND-COMPUTED peak bytes pin the planner's
+arithmetic exactly — straight-line, while-loop sub-block, in-place
+optimizer update, and the donated-then-read illegal case — through both
+``analysis.plan_memory`` and ``Executor.run``'s strict-mode admission,
+plus the accuracy closure (plan vs XLA memory_analysis), the
+alias-bytes CostRecord satellite, and the generation-capacity consumers
+(``suggest_decode_slots`` + geometry refusal).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu import ops, profiler
+from paddle_tpu.analysis import (
+    DonationError,
+    MemoryBudgetError,
+    accuracy_records,
+    check_memory_budget,
+    plan_memory,
+)
+from paddle_tpu.flags import set_flags
+from paddle_tpu.monitor import cost_model
+from paddle_tpu.static.control_flow import while_loop
+
+F32 = 4
+
+
+@pytest.fixture(autouse=True)
+def _static_reset():
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    yield
+    set_flags({"memory_budget_check": "warn", "device_peaks": ""})
+    static.disable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+
+
+def _straightline():
+    """x[4,8] @ w[8,8] -> relu -> mean; every byte hand-countable."""
+    x = static.data("x", [4, 8], "float32")
+    w = static.nn.create_parameter([8, 8], "float32")
+    h = ops.matmul(x, w)
+    r = ops.relu(h)
+    o = ops.mean(r)
+    return x, w, h, r, o
+
+
+# ---------------------------------------------------------------------------
+# golden peaks: exact high-water op index + byte count
+# ---------------------------------------------------------------------------
+
+
+def test_straightline_peak_exact():
+    x, w, h, r, o = _straightline()
+    prog = static.default_main_program()
+    plan = prog.plan_memory(feed_names=["x"], fetch_list=[o],
+                            feed_shapes={"x": (4, 8)})
+    base = 4 * 8 * F32 + 8 * 8 * F32          # x (128) + w (256)
+    assert plan.baseline_bytes == base
+    # op0 matmul: +h (128); op1 relu: h still live + r (256);
+    # op2 mean: h dead, r live + o (4 bytes scalar)
+    assert plan.resident_bytes == [base + 128, base + 256, base + 132]
+    assert plan.peak_bytes == base + 256
+    assert (plan.peak_op_index, plan.peak_op_type) == (1, "relu")
+    assert not plan.errors
+    # top tensors at the high-water op, largest first, sources named
+    names = [(n, b) for n, b, _src in plan.top_tensors]
+    assert (w.name, 256) in names and ("x", 128) in names
+    assert (h.name, 128) in names and (r.name, 128) in names
+
+
+def test_advisor_flags_donation_eligible_dead_input():
+    _x, _w, h, r, _o = _straightline()
+    prog = static.default_main_program()
+    plan = prog.plan_memory(feed_names=["x"], fetch_list=[r.name],
+                            feed_shapes={"x": (4, 8)})
+    # h dies at the relu op, whose output matches h's shape/dtype and
+    # declares no aliasing: donation-eligible, undeclared
+    adv = plan.advisories
+    assert any(f.kind == "donation-eligible" and f.var == h.name
+               and f.op_index == 1 for f in adv)
+    # r is FETCHED: it must never be advised away
+    assert not any(f.var == r.name for f in adv)
+
+
+def test_inplace_update_not_double_counted():
+    p = static.Program()
+    b = p.global_block()
+    b.create_var(name="p", shape=[8, 8], dtype="float32", persistable=True)
+    b.create_var(name="g", shape=[8, 8], dtype="float32", is_data=True)
+    b.create_var(name="lr", shape=[], dtype="float32", persistable=True)
+    b.append_op("sgd", {"X": ["p", "g", "lr"]}, {"Out": ["p"]},
+                {"__inplace__": ["p"]})
+    plan = plan_memory(p, feed_names=["g"], feed_shapes={"g": (8, 8)})
+    base = 256 + 256 + 4  # p + g + lr; the in-place write adds NOTHING
+    assert plan.baseline_bytes == base
+    assert plan.resident_bytes == [base]
+    assert plan.peak_bytes == base
+    assert not plan.errors
+
+
+def test_while_subblock_peak_exact():
+    x = static.data("x", [4, 4], "float32")
+    w = static.nn.create_parameter([4, 4], "float32")
+    m = ops.matmul(x, w)
+    iv = ops.zeros([], "int32")
+
+    def cond(i, c):
+        return ops.less_than(i, np.asarray(3, "int32"))
+
+    def body(i, c):
+        t = ops.matmul(c, w)
+        return ops.add(i, np.asarray(1, "int32")), ops.relu(t)
+
+    outs = while_loop(cond, body, [iv, m])
+    prog = static.default_main_program()
+    plan = prog.plan_memory(feed_names=["x"], fetch_list=[outs[1]],
+                            feed_shapes={"x": (4, 4)})
+    # baseline: x (64) + w (64) + three captured int32 scalar constants
+    # (iv init, loop limit, increment) = 12
+    base = 64 + 64 + 12
+    assert plan.baseline_bytes == base
+    # body sub-block peak (formals alias the parent's carries — only the
+    # block's OWN intermediates count): matmul t (64) live + add out (4)
+    # + relu out (64) = 132; the cond block's (5 bytes) loses the
+    # max-over-branches comparison
+    body_peak = 64 + 4 + 64
+    # root op0 matmul: base + m (64); root op1 while: base + m + the two
+    # while outputs (4 + 64) + the body sub-block peak
+    assert plan.resident_bytes == [base + 64,
+                                   base + 64 + 4 + 64 + body_peak]
+    assert (plan.peak_op_index, plan.peak_op_type) == (1, "while")
+    assert plan.peak_bytes == base + 64 + 4 + 64 + body_peak
+
+
+# ---------------------------------------------------------------------------
+# donation safety: the liveness-aware upgrade of write-conflicts
+# ---------------------------------------------------------------------------
+
+
+def _donated_then_read_program():
+    p = static.Program()
+    b = p.global_block()
+    b.create_var(name="v", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="w", shape=[4], dtype="float32")
+    b.create_var(name="z", shape=[4], dtype="float32")
+    # op0 consumes v's buffer into the differently-named w …
+    b.append_op("relu", {"X": ["v"]}, {"Out": ["w"]},
+                {"__inplace__": ["v"]})
+    # … and op1 reads the donated v: use-after-donation
+    b.append_op("tanh", {"X": ["v"]}, {"Out": ["z"]}, {})
+    return p
+
+
+def test_donated_then_read_golden():
+    p = _donated_then_read_program()
+    plan = plan_memory(p, feed_names=["v"], fetch_names=["z"],
+                       feed_shapes={"v": (4,)})
+    errs = [f for f in plan.errors if f.kind == "donated-then-read"]
+    assert len(errs) == 1
+    assert (errs[0].op_index, errs[0].op_type, errs[0].var) == (
+        1, "tanh", "v")
+    with pytest.raises(DonationError) as ei:
+        plan.raise_if_unsafe()
+    assert (ei.value.op_index, ei.value.op_type, ei.value.var) == (
+        1, "tanh", "v")
+
+
+def test_executor_strict_rejects_donated_then_read():
+    p = _donated_then_read_program()
+    set_flags({"memory_budget_check": "strict"})
+    exe = static.Executor()
+    with pytest.raises(DonationError):
+        exe.run(p, feed={"v": np.ones(4, "f")}, fetch_list=["z"])
+    # rejection happened BEFORE any plan/compile
+    assert len(exe._cache) == 0 and len(exe._plans) == 0
+
+
+def test_fetching_a_donated_buffer_is_rejected():
+    p = static.Program()
+    b = p.global_block()
+    b.create_var(name="v", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="w", shape=[4], dtype="float32")
+    b.append_op("relu", {"X": ["v"]}, {"Out": ["w"]},
+                {"__inplace__": ["v"]})
+    plan = plan_memory(p, feed_names=["v"], fetch_names=["v"],
+                       feed_shapes={"v": (4,)})
+    assert any(f.kind == "donated-then-read" and f.var == "v"
+               for f in plan.errors)
+
+
+def test_grad_op_inherited_inplace_is_not_a_donation():
+    """backward.py copies the forward op's attrs (incl. __inplace__)
+    onto its grad:: op verbatim; the vjp replay aliases nothing, so a
+    batch_norm-style training program must NOT read as donated-then-
+    read when the optimizer later updates the running stats."""
+    x = static.data("x", [8, 6], "float32")
+    label = static.data("y", [8, 6], "float32")
+    h = static.nn.batch_norm(x)  # aliases running stats via __inplace__
+    loss = ops.mean(ops.square(ops.subtract(h, label)))
+    static.optimizer.Momentum(learning_rate=0.01).minimize(loss)
+    prog = static.default_main_program()
+    plan = prog.plan_memory(
+        feed_names=["x", "y"], fetch_list=[loss],
+        feed_shapes={"x": (8, 6), "y": (8, 6)})
+    assert not plan.errors
+
+
+def test_same_name_inplace_chain_stays_legal():
+    # sgd/momentum/adam-style state chains (v in inputs AND outputs,
+    # declared) are the LEGAL aliasing class — later reads see the
+    # updated value, one buffer, no finding
+    p = static.Program()
+    b = p.global_block()
+    b.create_var(name="s", shape=[4], dtype="float32", persistable=True)
+    b.create_var(name="o", shape=[4], dtype="float32")
+    b.append_op("relu", {"X": ["s"]}, {"Out": ["s"]},
+                {"__inplace__": ["s"]})
+    b.append_op("tanh", {"X": ["s"]}, {"Out": ["o"]}, {})
+    plan = plan_memory(p, fetch_names=["o"])
+    assert not plan.errors
+
+
+# ---------------------------------------------------------------------------
+# executor admission: budget verdicts, caching, accuracy closure
+# ---------------------------------------------------------------------------
+
+
+def _run_straightline(exe=None):
+    _x, _w, _h, _r, o = _straightline()
+    exe = exe or static.Executor()
+    exe.run_startup()
+    out = exe.run(feed={"x": np.ones((4, 8), "f")}, fetch_list=[o])
+    return exe, float(np.asarray(out[0])), o
+
+
+def test_strict_budget_rejection_names_high_water_op():
+    _x, _w, _h, _r, o = _straightline()
+    set_flags({"device_peaks": "hbm_bytes=500",
+               "memory_budget_check": "strict"})
+    exe = static.Executor()
+    exe.run_startup()
+    with pytest.raises(MemoryBudgetError) as ei:
+        exe.run(feed={"x": np.ones((4, 8), "f")}, fetch_list=[o])
+    e = ei.value
+    assert e.op_index == 1 and e.op_type == "relu"
+    assert e.peak_bytes == 640 and e.budget_bytes == 500
+    # the structured error names the high-water op and the top tensors
+    assert "relu" in str(e) and "param_0" in str(e)
+    assert len(exe._cache) == 0  # rejected before any compile
+
+
+def test_baseline_over_budget_still_names_tensors():
+    """When the feeds/persistables ALONE exceed the budget (no op ever
+    raises the live set above baseline) the rejection must still name
+    the weights — not render 'op #None' with an empty tensor list."""
+    p = static.Program()
+    b = p.global_block()
+    b.create_var(name="big_w", shape=[64, 64], dtype="float32",
+                 persistable=True)
+    plan = plan_memory(p, fetch_names=["big_w"])
+    assert plan.peak_op_index is None
+    assert plan.peak_bytes == plan.baseline_bytes == 64 * 64 * F32
+    assert any(n == "big_w" for n, _b, _s in plan.top_tensors)
+    with pytest.raises(MemoryBudgetError) as ei:
+        check_memory_budget(p, (), ["big_w"], level="strict",
+                            budget_bytes=1000)
+    assert "baseline" in str(ei.value)
+    assert "big_w" in str(ei.value)
+    assert "None" not in str(ei.value)
+
+
+def test_warn_mode_admits_with_warning_and_flight_event():
+    from paddle_tpu.monitor import flight_recorder
+
+    _x, _w, _h, _r, o = _straightline()
+    set_flags({"device_peaks": "hbm_bytes=500",
+               "memory_budget_check": "warn"})
+    exe = static.Executor()
+    exe.run_startup()
+    with pytest.warns(RuntimeWarning, match="over_budget"):
+        out = exe.run(feed={"x": np.ones((4, 8), "f")}, fetch_list=[o])
+    assert np.isfinite(float(np.asarray(out[0])))
+    events = [e for e in flight_recorder.events()
+              if e.get("kind") == "memory_budget"]
+    assert any(e.get("verdict") == "over_budget" for e in events)
+
+
+def test_verdict_caches_per_program_version():
+    profiler.reset_counters()
+    exe, _loss, o = _run_straightline()
+    for _ in range(3):
+        exe.run(feed={"x": np.ones((4, 8), "f")}, fetch_list=[o])
+    counters = profiler.counters()
+    assert counters.get("memplan::cache_miss", 0) == 1
+    assert counters.get("memplan::cache_hit", 0) >= 3
+    prog = static.default_main_program()
+    assert len(prog._memplan_cache) == 1
+
+
+def test_off_mode_skips_planning_entirely():
+    profiler.reset_counters()
+    set_flags({"memory_budget_check": "off"})
+    _exe, loss, _o = _run_straightline()
+    assert np.isfinite(loss)
+    counters = profiler.counters()
+    assert counters.get("memplan::cache_miss", 0) == 0
+    assert counters.get("memplan::cache_hit", 0) == 0
+
+
+def test_plan_accuracy_closure_on_costrecord():
+    from paddle_tpu.monitor import registry as _reg
+
+    _exe, loss, _o = _run_straightline()
+    assert np.isfinite(loss)
+    rec = cost_model.latest_record("executor")
+    assert rec is not None and rec.plan_accuracy is not None
+    assert 0.25 < rec.plan_accuracy < 4.0
+    assert rec.predicted_peak_bytes == 640
+    d = rec.to_dict()
+    assert d["plan_accuracy"] == round(rec.plan_accuracy, 4)
+    assert d["predicted_peak_bytes"] == 640
+    entries = accuracy_records()
+    assert entries and entries[-1]["predicted_bytes"] == 640
+    assert entries[-1]["actual_bytes"] > 0
+    assert _reg.gauge("memplan/plan_accuracy").value == pytest.approx(
+        rec.plan_accuracy)
+
+
+def test_training_program_accuracy_within_envelope():
+    """The CI smoke's contract in miniature: on an Adam train step the
+    predicted peak lands within the documented envelope of XLA's
+    argument+output+temp-alias."""
+    from paddle_tpu.analysis.memory import ACCURACY_ENVELOPE
+
+    x = static.data("x", [32, 64], "float32")
+    y = static.data("y", [32, 1], "float32")
+    w = static.nn.create_parameter([64, 1], "float32")
+    pred = ops.matmul(x, w)
+    loss = ops.mean(ops.square(ops.subtract(pred, y)))
+    static.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    exe.run_startup()
+    exe.run(feed={"x": np.ones((32, 64), "f"),
+                  "y": np.ones((32, 1), "f")}, fetch_list=[loss])
+    rec = cost_model.latest_record("executor")
+    assert rec.plan_accuracy is not None
+    assert 1.0 / ACCURACY_ENVELOPE <= rec.plan_accuracy \
+        <= ACCURACY_ENVELOPE
+    # the donation-aliased optimizer state shows up on the actual side
+    assert rec.alias_bytes > 0
+
+
+def test_unresolved_batch_dim_degrades_to_warning():
+    x = static.data("x", [-1, 8], "float32")
+    w = static.nn.create_parameter([8, 8], "float32")
+    h = ops.matmul(x, w)
+    prog = static.default_main_program()
+    # no feed shapes: the -1 dim cannot concretize — excluded, warned
+    plan = prog.plan_memory(feed_names=["x"], fetch_list=[h.name])
+    assert "x" in plan.unresolved
+    assert any(f.kind == "unresolved-shape" for f in plan.warnings)
+    # with the feed shape the same program resolves exactly
+    plan2 = prog.plan_memory(feed_names=["x"], fetch_list=[h.name],
+                             feed_shapes={"x": (16, 8)})
+    assert not plan2.unresolved
+    assert plan2.baseline_bytes == 16 * 8 * F32 + 256
+
+
+def test_check_memory_budget_inconclusive_never_blocks(monkeypatch):
+    """A planner-internal failure must cache an inconclusive verdict and
+    admit — the gate exists to prevent OOMs, not to add a crash mode."""
+    from paddle_tpu.analysis import memory as memmod
+
+    p = static.Program()
+    b = p.global_block()
+    b.create_var(name="v", shape=[4], dtype="float32", is_data=True)
+    b.create_var(name="o", shape=[4], dtype="float32")
+    b.append_op("relu", {"X": ["v"]}, {"Out": ["o"]}, {})
+
+    def boom(*a, **k):
+        raise RuntimeError("planner bug")
+
+    monkeypatch.setattr(memmod, "plan_memory", boom)
+    assert check_memory_budget(p, ["v"], ["o"],
+                               feed_shapes={"v": (4,)},
+                               level="strict") is None
+    # and the inconclusive verdict is cached (no re-plan per dispatch)
+    profiler.reset_counters()
+    assert check_memory_budget(p, ["v"], ["o"],
+                               feed_shapes={"v": (4,)},
+                               level="strict") is None
+    assert profiler.counters().get("memplan::cache_hit", 0) == 1
+
+
+# ---------------------------------------------------------------------------
+# satellite: alias_bytes surfaced on CostRecord + /costz
+# ---------------------------------------------------------------------------
+
+
+def test_alias_bytes_from_real_donating_compile():
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        return a * 2.0
+
+    jitted = jax.jit(f, donate_argnums=(0,))
+    lowered = jitted.lower(jnp.zeros((64, 64), jnp.float32))
+    compiled = lowered.compile()
+    rec = cost_model.capture("memplan_alias_test", lowered=lowered,
+                             compiled=compiled, key="memplan_alias_test")
+    assert rec.alias_bytes == 64 * 64 * F32
+    assert rec.to_dict()["alias_bytes"] == rec.alias_bytes
+    payload = cost_model.costz_payload()
+    mine = [r for r in payload["records"]
+            if r["key"] == "memplan_alias_test"]
+    assert mine and mine[0]["alias_bytes"] == rec.alias_bytes
+
+
+def test_device_peaks_carries_hbm_capacity():
+    peaks = cost_model.device_peaks()
+    assert peaks["hbm_bytes"] > 0
+    set_flags({"device_peaks": "hbm_bytes=12345"})
+    assert cost_model.device_peaks()["hbm_bytes"] == 12345
+    from paddle_tpu.analysis import hbm_budget_bytes
+
+    assert hbm_budget_bytes() == 12345
+
+
+# ---------------------------------------------------------------------------
+# capacity consumers: suggest_decode_slots + geometry refusal
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTForCausalLM, gpt_tiny_config
+
+    paddle.seed(7)
+    cfg = gpt_tiny_config()
+    cfg.attention_window = 16
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_suggest_decode_slots_arithmetic(tiny_gpt):
+    from paddle_tpu.generation.engine import GenerationEngine
+
+    eng = GenerationEngine(tiny_gpt, slots=2, cache_len=16,
+                           prefill_buckets="4,8")
+    # the static plan matches the REAL allocated arrays byte-exactly
+    assert eng.hbm_required_bytes() == \
+        eng.param_nbytes() + eng.cache_nbytes()
+    budget = eng.param_nbytes() + 3 * eng.slot_nbytes()
+    assert eng.suggest_decode_slots(budget) == 3
+    # int8 KV shrinks the per-slot cost -> more slots in the same budget
+    assert eng.suggest_decode_slots(budget, "int8") > 3
+    # a budget below the weights fits nothing
+    assert eng.suggest_decode_slots(eng.param_nbytes() - 1) == 0
+
+
+def test_generation_geometry_refused_when_over_budget(tiny_gpt):
+    from paddle_tpu.generation.engine import GenerationEngine
+    from paddle_tpu.serving.server import GenerationServer
+
+    set_flags({"device_peaks": "hbm_bytes=1000",
+               "memory_budget_check": "strict"})
+    with pytest.raises(MemoryBudgetError) as ei:
+        GenerationEngine(tiny_gpt, slots=2, cache_len=16,
+                         prefill_buckets="4,8")
+    # the refusal names the geometry and the fitting answer
+    assert "suggest_decode_slots" in str(ei.value)
+    assert "2 slot(s)" in str(ei.value)
+    # the server path (backend CLI) refuses identically: the engine is
+    # constructed inside GenerationServer
+    with pytest.raises(MemoryBudgetError):
+        GenerationServer(tiny_gpt, slots=2, cache_len=16,
+                         prefill_buckets="4,8")
+    # warn admits (engines must still boot on unknown hosts)
+    set_flags({"memory_budget_check": "warn"})
+    with pytest.warns(RuntimeWarning, match="suggest_decode_slots"):
+        eng = GenerationEngine(tiny_gpt, slots=2, cache_len=16,
+                               prefill_buckets="4,8")
+    assert eng.slots == 2

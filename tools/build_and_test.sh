@@ -30,6 +30,10 @@
 #           + spec smoke (speculative decoding: greedy token parity at
 #             exact draft+verify compile counts, self-draft acceptance,
 #             2-process prefill->decode fleet through the KV handoff)
+#           + memplan smoke (static peak-HBM planner: plan-vs-XLA
+#             accuracy envelope on BERT/ResNet/GPT smoke programs,
+#             strict pre-compile admission naming the high-water op,
+#             donation-safety golden, <1% steady-state dispatch cost)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -131,6 +135,12 @@ case "$MODE" in
     # two-process fleet serving /generate through the KV-slab handoff
     # with zero unexpected compiles on either tier
     JAX_PLATFORMS=cpu python tools/spec_decode_smoke.py
+    # memplan smoke: static liveness planner within the ±25% envelope of
+    # XLA memory_analysis on BERT/ResNet/GPT smoke programs, strict mode
+    # rejecting an over-budget program BEFORE compile with the
+    # high-water op named, the donated-then-read golden rejected, and
+    # the admission gate under 1% of the steady-state dispatch period
+    JAX_PLATFORMS=cpu python tools/memplan_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

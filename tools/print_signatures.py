@@ -56,6 +56,8 @@ MODULES = [
 # otherwise only sees constructors): (module, class, method)
 PINNED_METHODS = [
     ("paddle_tpu.static", "Program", "verify"),
+    ("paddle_tpu.static", "Program", "plan_memory"),
+    ("paddle_tpu.generation", "GenerationEngine", "suggest_decode_slots"),
 ]
 
 
