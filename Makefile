@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke
+.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke autotune-smoke
 
 lint:  # graphlint gate: pure-AST framework lint, waivers must justify every exception
 	python tools/graphlint.py --check
@@ -76,6 +76,9 @@ spec-smoke:  # speculative decoding: greedy parity, draft+verify compile counts,
 
 memplan-smoke:  # static peak-HBM planner: accuracy envelope, strict admission, <1% dispatch overhead
 	JAX_PLATFORMS=cpu python tools/memplan_smoke.py
+
+autotune-smoke:  # kernel autotuner: parity under tuned schedules, search + cache round-trip, zero re-search warm
+	JAX_PLATFORMS=cpu python tools/autotune_smoke.py
 
 check:
 	python tools/graphlint.py --check

@@ -1,0 +1,38 @@
+"""Lazy bridge from the pallas kernels to the tuning subsystem.
+
+The kernel modules (``ops/pallas/*``) register their schedule spaces
+and resolve schedules through these two functions instead of importing
+``paddle_tpu.tuning`` directly: the tuning package pulls in flags /
+profiler / monitor plumbing that must stay OUT of the kernel modules'
+import graph (ops.pallas is imported during bootstrap), and the lazy
+indirection keeps the kernels importable — falling back to their
+hardcoded default geometry — even if schedule resolution ever fails.
+"""
+from __future__ import annotations
+
+__all__ = ["register_schedule", "resolve_schedule"]
+
+
+def register_schedule(*, name, version, params, default, supported=None,
+                      bench=None, bucket=None):
+    """Declare one kernel's schedule space (see tuning/schedule.py)."""
+    from .tuning.schedule import ScheduleSpace
+    from .tuning.schedule import register_schedule as _register
+
+    return _register(ScheduleSpace(
+        name, version=version, params=params, default=default,
+        supported=supported, bench=bench, bucket=bucket))
+
+
+def resolve_schedule(kernel, **info) -> dict:
+    """Tuned schedule params on a cache hit, the kernel's byte-identical
+    defaults otherwise. Degrades to defaults on ANY resolution failure:
+    a broken tuning cache must never take a kernel down."""
+    from .tuning.schedule import resolve, schedule_space
+
+    try:
+        return resolve(kernel, **info)
+    except Exception:
+        # cache/flag plumbing failure: the kernel still runs, on its
+        # hardcoded defaults (no space registered at all stays an error)
+        return schedule_space(kernel).default_params(info)

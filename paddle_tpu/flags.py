@@ -617,3 +617,31 @@ define_flag("quantized_allreduce_block", 2048,
 define_flag("io_prefetch_overlap", True,
             "overlap dataloader H2D transfers with compute via a "
             "background prefetch thread (double-buffered)")
+
+# tuning/ + ops/pallas/* — the kernel autotuner's dispatch policy.
+# Every gated pallas kernel resolves its schedule (block rows/cols,
+# tile geometry) through tuning.resolve():
+#   off    — defaults only, zero tuner work (no cache load, no counters)
+#   cached — tuned params on a cache hit, defaults on a miss; NO search
+#   search — like cached, plus misses enqueue a background per-
+#            device_kind search whose winner applies at the next
+#            CompiledStore compile of the signature (never inline)
+# Winners persist next to FLAGS_persistent_compile_cache_dir
+# (tuning/cache.py); runtime/compiled.py folds the schedule token into
+# every compile identity so a swap is a clean recompile.
+define_flag("kernel_autotune", "cached",
+            "pallas kernel schedule policy: off | cached | search "
+            "(search tunes misses in the background, offline-style)")
+
+# models/resnet.py + nn/layers.py fused_conv_bn_relu + ops/pallas/
+# conv_bn_relu.py — fuse the vision path's conv -> batch_norm -> relu
+# triple into pallas kernels on TPU: the conv contraction runs as a
+# tiled MXU matmul whose epilogue applies the BN affine + relu in VMEM
+# (eval: one pass; training: matmul+stats pass, then normalize+relu
+# pass), so the pre-activation never round-trips HBM. The jnp fallback
+# calls the IDENTICAL conv2d/batch_norm/relu op kernels in the same
+# order, so the flag never changes numerics off-TPU — the same
+# discipline as the PR-10 fused kernels.
+define_flag("use_fused_conv_bn", True,
+            "fused pallas conv+batch_norm+relu on TPU for the vision "
+            "path (jnp fallback elsewhere; identical op sequence)")

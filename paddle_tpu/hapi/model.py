@@ -461,6 +461,14 @@ def summary(net, input_size=None, dtypes=None, cost=False):
         uncosted = []
         was_training = net.training
         net.eval()
+        # per-layer attribution needs the per-layer graph: cross-layer
+        # fusions (the conv+bn+relu triple skips conv.forward entirely)
+        # would leave their layers uncaptured and the census short. The
+        # fusion flag is scheduling-only by contract (identical math),
+        # so the unfused census is THE census.
+        from ..flags import get_flags, set_flags
+        prev_fuse = get_flags(["use_fused_conv_bn"])
+        set_flags({"use_fused_conv_bn": False})
         try:
             with no_grad():
                 net(*xs)
@@ -476,6 +484,7 @@ def summary(net, input_size=None, dtypes=None, cost=False):
                 else:
                     uncosted.append(name)
         finally:
+            set_flags(prev_fuse)
             if was_training:
                 net.train()
             for h in hooks:

@@ -23,6 +23,7 @@ from ..nn.layers import (
     Linear,
     MaxPool2D,
     Sequential,
+    fused_conv_bn_relu,
 )
 
 
@@ -41,7 +42,10 @@ class BasicBlock(Layer):
 
     def forward(self, x):
         identity = x
-        out = F.relu(self.bn1(self.conv1(x)))
+        # conv->bn->relu triples route through the fused pallas kernel
+        # (FLAGS_use_fused_conv_bn); bn2 feeds the residual add, not a
+        # relu, so it stays on the unfused path
+        out = fused_conv_bn_relu(self.conv1, self.bn1, x)
         out = self.bn2(self.conv2(out))
         if self.downsample is not None:
             identity = self.downsample(x)
@@ -65,8 +69,10 @@ class BottleneckBlock(Layer):
 
     def forward(self, x):
         identity = x
-        out = F.relu(self.bn1(self.conv1(x)))
-        out = F.relu(self.bn2(self.conv2(out)))
+        # 2 of the 3 convs per bottleneck carry a bn+relu epilogue —
+        # both fuse; bn3 feeds the residual add and stays unfused
+        out = fused_conv_bn_relu(self.conv1, self.bn1, x)
+        out = fused_conv_bn_relu(self.conv2, self.bn2, out)
         out = self.bn3(self.conv3(out))
         if self.downsample is not None:
             identity = self.downsample(x)
@@ -110,7 +116,7 @@ class ResNet(Layer):
         return Sequential(*layers)
 
     def forward(self, x):
-        x = self.maxpool(F.relu(self.bn1(self.conv1(x))))
+        x = self.maxpool(fused_conv_bn_relu(self.conv1, self.bn1, x))
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)

@@ -186,6 +186,60 @@ class AdaptiveMaxPool2D(Layer):
         return F.adaptive_max_pool2d(x, self.output_size, data_format=self.data_format)
 
 
+def fused_conv_bn_relu(conv, bn, x):
+    """``relu(bn(conv(x)))`` through the fused pallas conv+bn+relu
+    kernel (``FLAGS_use_fused_conv_bn``) when the triple is admissible:
+    a bias-free, ungrouped, undilated Conv2D feeding a matching
+    BatchNorm2D — the vision models' hot sequence. The jnp fallback
+    (and the unfused path here) executes the identical op kernels in
+    the same order, so this is a scheduling choice, never a numeric
+    one — the ``_residual_norm`` discipline applied to conv nets.
+
+    Running statistics update exactly as ``F.batch_norm`` does in
+    training (detached blend into the layer buffers).
+    """
+    from ..flags import flag
+    from ..framework.tensor import Tensor
+
+    attrs = conv._attrs
+    if (flag("use_fused_conv_bn") and isinstance(x, Tensor)
+            and conv.bias is None and attrs.get("groups", 1) == 1
+            and attrs.get("dilation", 1) in (1, (1, 1), [1, 1])
+            and isinstance(bn, _BatchNormBase)
+            and bn.data_format == ("NCHW" if conv.data_format == "NCHW"
+                                   else "NHWC")):
+        from ..framework.autograd import no_grad
+        from ..ops.pallas import conv_bn_relu as _fused
+
+        # the unfused path autocasts the conv (white-listed op) but not
+        # the bn params; mirror that exactly — x/weight take the AMP
+        # dtype, gamma/beta/running stats stay f32
+        weight = conv.weight
+        from ..amp import _enabled as _amp_state
+
+        scope = _amp_state()
+        if scope is not None and "conv2d" in scope[1]:
+            import jax.numpy as _jnp
+
+            amp_dt = str(_jnp.dtype(scope[0]))
+            if str(x.dtype) == "float32":
+                x = x.astype(amp_dt)
+            if str(weight.dtype) == "float32":
+                weight = weight.astype(amp_dt)
+
+        y, new_mean, new_var = _fused(
+            x, weight, bn.weight, bn.bias, bn._mean, bn._variance,
+            stride=attrs.get("stride", 1), padding=attrs.get("padding", 0),
+            epsilon=bn.epsilon, momentum=bn.momentum,
+            training=bn.training, data_format=conv.data_format)
+        if bn.training:
+            with no_grad():
+                bn._mean.set_value(new_mean.detach())
+                bn._variance.set_value(new_var.detach())
+        return y
+    return F.relu(bn(conv(x)))
+
+
 # -- normalization -----------------------------------------------------------
 
 

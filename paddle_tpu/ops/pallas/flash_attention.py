@@ -38,11 +38,77 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..._internal_tuning import register_schedule, resolve_schedule
 from ._platform import on_tpu_platform
 
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
+_BLOCK = 256  # default q/k tile (the historical hardcoded geometry)
+
+
+def _schedule_blocks(b, h, lq, lk, d, dtype) -> tuple:
+    """(block_q, block_k, unroll) through the autotuner; the default
+    point is the historical (256, 256, unroll=1) — byte-identical when
+    untuned. ``_effective_blocks`` still applies downstream, so a tuned
+    block that does not divide the sequence degrades to the 128 base
+    tile exactly as the defaults always have."""
+    params = resolve_schedule("flash_attention", b=int(b), h=int(h),
+                              lq=int(lq), lk=int(lk), d=int(d),
+                              dtype=str(dtype))
+    return (int(params["block_q"]), int(params["block_k"]),
+            max(1, int(params.get("unroll", 1))))
+
+
+def _flash_vmem_ok(info, c) -> bool:
+    # per-program residents (tiled fwd): q/o tiles [BQ, D] + whole-head
+    # K/V [LK, D] (2 bytes each at bf16-min) + the f32 [BQ, BK] score
+    # tile; keep under ~12 MB of the 16 MB core budget
+    d, lk = int(info["d"]), int(info["lk"])
+    tiles = 2 * (2 * c["block_q"] * d + 2 * lk * d)
+    score = 4 * c["block_q"] * c["block_k"]
+    return (c["block_q"] % 128 == 0 and c["block_k"] % 128 == 0
+            and c.get("unroll", 1) in (1, 2, 4)
+            and tiles + score <= 12 * (1 << 20))
+
+
+def _tuning_bench(info):
+    b, h = int(info["b"]), int(info["h"])
+    lq, lk, d = int(info["lq"]), int(info["lk"]), int(info["d"])
+    dtype = str(info.get("dtype", "float32"))
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, h, lq, d), jnp.float32).astype(dtype)
+    k = jax.random.normal(kk, (b, h, lk, d), jnp.float32).astype(dtype)
+    v = jax.random.normal(kv, (b, h, lk, d), jnp.float32).astype(dtype)
+    scale = float(d) ** -0.5
+
+    def builder(params):
+        bq, bk = int(params["block_q"]), int(params["block_k"])
+        unroll = max(1, int(params.get("unroll", 1)))
+        fn = jax.jit(lambda q, k, v: _pallas_fwd(
+            q, k, v, None, jnp.int32(0), True, scale, 0.0,
+            block_q=bq, block_k=bk, unroll=unroll)[0])
+
+        def run():
+            jax.block_until_ready(fn(q, k, v))
+
+        return run
+
+    return builder
+
+
+register_schedule(
+    name="flash_attention",
+    version=1,
+    params={"block_q": (128, 256, 512),
+            "block_k": (128, 256, 512),
+            "unroll": (1, 2)},
+    default=lambda info: {"block_q": _BLOCK, "block_k": _BLOCK,
+                          "unroll": 1},
+    supported=_flash_vmem_ok,
+    bench=_tuning_bench,
+)
 
 
 def _drop_threshold(rate: float) -> jnp.ndarray:
@@ -86,7 +152,7 @@ def _plain_attention(q, k, v, bias, causal, scale, rate=0.0, seed=None):
 
 
 def _fwd_core(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
-              scale, causal, block_k, seq_k, num_q, rate):
+              scale, causal, block_k, seq_k, num_q, rate, unroll=1):
     """One (batch*head, q-tile) program.
       q_ref: [1, BQ, D]; k_ref/v_ref: [1, LK, D]; bias_ref: [1, 1, BQ, LK]
       seed_ref: [1] int32 (SMEM); o_ref: [1, BQ, D]; lse_ref: [1, BQ, 1]
@@ -150,7 +216,8 @@ def _fwd_core(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
         )
         return m_new, l_new, acc_new
 
-    m, l, acc = jax.lax.fori_loop(0, num_k_live, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(0, num_k_live, body, (m0, l0, acc0),
+                                  unroll=unroll)
     lsafe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / lsafe).astype(o_ref.dtype)
     # per-row logsumexp for the backward recompute
@@ -439,7 +506,7 @@ def _use_small_path(h, lq, lk, d, block_q, block_k):
 
 
 def _pallas_fwd(q, k, v, bias, seed, causal, scale, rate,
-                block_q=256, block_k=256):
+                block_q=256, block_k=256, unroll=1):
     """Returns (out, lse): lse is the per-row logsumexp [B*H, LQ], f32."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -477,7 +544,7 @@ def _pallas_fwd(q, k, v, bias, seed, causal, scale, rate,
 
     kernel = _adapt(_fwd_core, has_bias, has_drop, scale=scale,
                     causal=causal, block_k=block_k, seq_k=lk,
-                    num_q=lq // block_q, rate=rate)
+                    num_q=lq // block_q, rate=rate, unroll=unroll)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
@@ -501,7 +568,7 @@ def _pallas_fwd(q, k, v, bias, seed, causal, scale, rate,
 
 def _dq_core(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
              seed_ref, dq_ref, *, scale, causal, block_k, seq_k, num_q,
-             rate):
+             rate, unroll=1):
     """dQ program per (bh, q-tile): walk K-tiles, recompute P from the
     saved logsumexp, regenerate the identical dropout mask per tile."""
     from jax.experimental import pallas as pl
@@ -557,13 +624,13 @@ def _dq_core(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
         )
 
     dq0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
-    dq = jax.lax.fori_loop(0, num_k_live, body, dq0)
+    dq = jax.lax.fori_loop(0, num_k_live, body, dq0, unroll=unroll)
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _dkv_core(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
               seed_ref, dk_ref, dv_ref, *, scale, causal, block_q, seq_q,
-              num_k, rate):
+              num_k, rate, unroll=1):
     """dK/dV program per (bh, k-tile): walk Q-tiles. The dropout re-seed
     uses the same (seed, bh, qi, ki) tuple as the forward, so the mask
     for each (qi, ki) tile is bit-identical despite the transposed
@@ -625,13 +692,14 @@ def _dkv_core(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, bias_ref,
 
     dk0 = jnp.zeros((bk, kt.shape[1]), jnp.float32)
     dv0 = jnp.zeros((bk, vt.shape[1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qi_start, num_q, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(qi_start, num_q, body, (dk0, dv0),
+                               unroll=unroll)
     dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
 def _pallas_bwd(q, k, v, bias, seed, causal, scale, rate, out, lse, g,
-                block_q=256, block_k=256):
+                block_q=256, block_k=256, unroll=1):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -692,7 +760,7 @@ def _pallas_bwd(q, k, v, bias, seed, causal, scale, rate, out, lse, g,
         seed_ref = ins[i] if has_drop else None
         return _dq_core(*ins[:6], bias_ref, seed_ref, *outs, scale=scale,
                         causal=causal, block_k=block_k, seq_k=lk,
-                        num_q=lq // block_q, rate=rate)
+                        num_q=lq // block_q, rate=rate, unroll=unroll)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -742,7 +810,7 @@ def _pallas_bwd(q, k, v, bias, seed, causal, scale, rate, out, lse, g,
         seed_ref = ins[i] if has_drop else None
         return _dkv_core(*ins[:6], bias_ref, seed_ref, *outs, scale=scale,
                          causal=causal, block_q=block_q, seq_q=lq,
-                         num_k=lk // block_k, rate=rate)
+                         num_k=lk // block_k, rate=rate, unroll=unroll)
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -779,29 +847,47 @@ def _supported(q, k, v, bias):
     return True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, seed, causal, scale, rate, bias_grad=True, bias=None):
+def _sched_for(q, k):
+    b, h, lq, d = q.shape
+    return _schedule_blocks(b, h, lq, k.shape[2], d, q.dtype)
+
+
+# ``sched`` (block_q, block_k, unroll) is a NONDIFF STATIC argument,
+# resolved ONCE by flash_attention() before the custom_vjp: forward and
+# backward must tile identically — the dropout PRNG re-seeds per
+# (q-tile, k-tile), so a background tuned swap-in landing between the
+# eager forward and its deferred backward would otherwise regenerate
+# different masks (silently wrong gradients).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seed, causal, scale, rate, bias_grad=True,
+           sched=(_BLOCK, _BLOCK, 1), bias=None):
     if _supported(q, k, v, bias):
-        out, _ = _pallas_fwd(q, k, v, bias, seed, causal, scale, rate)
+        bq, bk, unroll = sched
+        out, _ = _pallas_fwd(q, k, v, bias, seed, causal, scale, rate,
+                             block_q=bq, block_k=bk, unroll=unroll)
         return out
     return _plain_attention(q, k, v, bias, causal, scale, rate, seed)
 
 
 def _flash_fwd(q, k, v, seed, causal, scale, rate, bias_grad=True,
-               bias=None):
+               sched=(_BLOCK, _BLOCK, 1), bias=None):
     if _supported(q, k, v, bias):
-        out, lse = _pallas_fwd(q, k, v, bias, seed, causal, scale, rate)
+        bq, bk, unroll = sched
+        out, lse = _pallas_fwd(q, k, v, bias, seed, causal, scale, rate,
+                               block_q=bq, block_k=bk, unroll=unroll)
         return out, (q, k, v, bias, seed, out, lse)
     out = _plain_attention(q, k, v, bias, causal, scale, rate, seed)
     return out, (q, k, v, bias, seed, None, None)
 
 
-def _flash_bwd(causal, scale, rate, bias_grad, res, g):
+def _flash_bwd(causal, scale, rate, bias_grad, sched, res, g):
     q, k, v, bias, seed, out, lse = res
     dseed = np.zeros((), dtype=jax.dtypes.float0)
     if out is not None:  # pallas path
+        bq, bk, unroll = sched  # the forward's exact tiling, statically
         dq, dk, dv = _pallas_bwd(
-            q, k, v, bias, seed, causal, scale, rate, out, lse, g
+            q, k, v, bias, seed, causal, scale, rate, out, lse, g,
+            block_q=bq, block_k=bk, unroll=unroll
         )
         if bias is None:
             return dq, dk, dv, dseed, None
@@ -900,6 +986,11 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
             "Set bias.stop_gradient = True or use dropout_rate=0.0."
         )
 
+    # resolve the schedule ONCE, here, so the custom_vjp's forward and
+    # deferred backward share the exact same static tiling (a background
+    # tuned swap-in between the two can then never split them); off-TPU
+    # the kernels never run — skip resolution, keep the path tuner-free
+    sched = _sched_for(qa, ka) if on_tpu_platform() else (_BLOCK, _BLOCK, 1)
     if wrap:
         from ...framework.autograd import apply_op
 
@@ -910,10 +1001,12 @@ def flash_attention(q, k, v, bias=None, causal=False, scale=None,
         ]
         if bias is not None:
             fn = lambda q, k, v, b: _flash(q, k, v, seed, causal, scale,
-                                           rate, bias_grad, b)
+                                           rate, bias_grad, sched, b)
         else:
-            fn = lambda q, k, v: _flash(q, k, v, seed, causal, scale, rate)
+            fn = lambda q, k, v: _flash(q, k, v, seed, causal, scale,
+                                        rate, True, sched)
         return apply_op("flash_attention", fn, tensors, {})
     if ba is not None:
-        return _flash(qa, ka, va, seed, causal, scale, rate, True, ba)
-    return _flash(qa, ka, va, seed, causal, scale, rate)
+        return _flash(qa, ka, va, seed, causal, scale, rate, True, sched,
+                      ba)
+    return _flash(qa, ka, va, seed, causal, scale, rate, True, sched)

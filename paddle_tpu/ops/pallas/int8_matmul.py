@@ -24,12 +24,83 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..._internal_tuning import register_schedule, resolve_schedule
 from ._platform import on_tpu_platform
 
 __all__ = ["int8_matmul"]
 
 _LANES = 128      # last-dim tile (every dtype)
 _SUBLANES = 32    # int8 second-to-last-dim minimum tile
+_TILE = 256       # default M/N tile (the historical hardcoded geometry)
+
+
+def _schedule_tiles(pm, pk, pn) -> tuple:
+    """(tile_m, tile_n) through the autotuner; default point is the
+    historical ``min(dim, 256)`` pair — byte-identical when untuned."""
+    params = resolve_schedule("int8_matmul", m=int(pm), k=int(pk),
+                              n=int(pn), dtype="int8")
+    return (max(_SUBLANES, min(int(params["tile_m"]), pm)),
+            max(_LANES, min(int(params["tile_n"]), pn)))
+
+
+def _bucket(info):
+    # raw-shape tune() keys and padded-dim resolve() keys must collapse
+    # into one bucket: clamp dims to their tile floors first
+    from ...tuning.schedule import aligned_bucket
+
+    return aligned_bucket({"m": _SUBLANES, "k": _LANES,
+                           "n": _LANES})(info)
+
+
+def _int8_vmem_ok(info, c) -> bool:
+    # residents per program: int8 [tile_m, K] + int8 [K, tile_n]
+    # + int32 [tile_m, tile_n]; keep the sum under ~12 MB of the 16 MB
+    # core budget (the compiler's in/out buffering needs headroom)
+    k = int(info["k"])
+    b = (c["tile_m"] * k + k * c["tile_n"]
+         + 4 * c["tile_m"] * c["tile_n"])
+    return (c["tile_m"] % _SUBLANES == 0 and c["tile_n"] % _LANES == 0
+            and b <= 12 * (1 << 20))
+
+
+def _tuning_bench(info):
+    import numpy as np
+
+    m, k, n = int(info["m"]), int(info["k"]), int(info["n"])
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randint(-128, 128, (m, k)), jnp.int8)
+    w = jnp.asarray(rng.randint(-128, 128, (k, n)), jnp.int8)
+    interpret = not on_tpu_platform()
+
+    def builder(params):
+        tiles = (max(_SUBLANES, min(int(params["tile_m"]), m)),
+                 max(_LANES, min(int(params["tile_n"]), n)))
+        fn = jax.jit(lambda x, w: _pallas_matmul(
+            x, w, interpret=interpret, tiles=tiles))
+
+        def run():
+            jax.block_until_ready(fn(x, w))
+
+        return run
+
+    return builder
+
+
+register_schedule(
+    name="int8_matmul",
+    version=1,
+    params={"tile_m": (32, 64, 128, 256, 512),
+            "tile_n": (128, 256, 512)},
+    # tile floors keep the default point valid for RAW shapes too (the
+    # dispatch path always passes padded dims, where max() is a no-op)
+    default=lambda info: {"tile_m": max(_SUBLANES,
+                                        min(int(info["m"]), _TILE)),
+                          "tile_n": max(_LANES,
+                                        min(int(info["n"]), _TILE))},
+    supported=_int8_vmem_ok,
+    bench=_tuning_bench,
+    bucket=_bucket,
+)
 
 
 def _jnp_matmul(x, w):
@@ -56,7 +127,7 @@ def _pad_to(a, rows, cols):
     return jnp.pad(a, ((0, rows - r), (0, cols - c)))
 
 
-def _pallas_matmul(x, w, interpret=False):
+def _pallas_matmul(x, w, interpret=False, tiles=None):
     """Tiled int8 matmul: grid over [M/TM, N/TN], K resident per block."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -71,9 +142,10 @@ def _pallas_matmul(x, w, interpret=False):
     wp = _pad_to(w, pk, pn)
     # block geometry: full-K stripes; M/N tiles sized so the three VMEM
     # residents (int8 x-block + int8 w-block + int32 out-block) stay far
-    # under the ~16 MB budget even at large K
-    tile_m = min(pm, 256)
-    tile_n = min(pn, 256)
+    # under the ~16 MB budget even at large K. Tuned per device_kind
+    # through the schedule cache; default = the historical 256/256.
+    tile_m, tile_n = tiles if tiles is not None else _schedule_tiles(
+        pm, pk, pn)
 
     def kernel(x_ref, w_ref, o_ref):
         o_ref[:] = jnp.dot(x_ref[:], w_ref[:],

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..._internal_tuning import register_schedule, resolve_schedule
 from ._platform import on_tpu_platform
 
 __all__ = ["layernorm_residual"]
@@ -46,9 +47,61 @@ _MAX_H = 16384  # _supported bound: block_r floors at 8 rows ≤ 2 MB f32
 def _block_rows(rows, h):
     """Rows per program, scaled so one f32 row block stays ≤ ~2 MB —
     the bwd kernel keeps a handful of blocks live, so an unscaled
-    (256, H) tile blows the ~16 MB VMEM budget once H > 2048."""
+    (256, H) tile blows the ~16 MB VMEM budget once H > 2048. This is
+    the schedule space's DEFAULT point: untuned resolution returns
+    exactly this geometry."""
     cap = max(8, min(_BLOCK_R, (1 << 21) // (4 * h)))
     return min(cap, rows)
+
+
+def _schedule_block_rows(rows, h, dtype) -> int:
+    """Row-block size through the autotuner: tuned winner for this
+    (device_kind, shape-bucket, dtype) when cached, else the
+    byte-identical :func:`_block_rows` default."""
+    params = resolve_schedule("layernorm_residual", rows=int(rows),
+                              h=int(h), dtype=str(dtype))
+    return max(1, min(int(params["block_r"]), rows))
+
+
+def _tuning_bench(info):
+    """Measurement builder for the tuner: one jitted fwd pass at the
+    candidate's row block (interpret off-TPU, so the CPU smoke can
+    drive the full search pipeline)."""
+    import numpy as np
+
+    rows, h = int(info["rows"]), int(info["h"])
+    dtype = str(info.get("dtype", "float32"))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(rows, h).astype("f4")).astype(dtype)
+    r = jnp.asarray(rng.randn(rows, h).astype("f4")).astype(dtype)
+    w = jnp.asarray(rng.randn(h).astype("f4"))
+    b = jnp.asarray(rng.randn(h).astype("f4"))
+    interpret = not on_tpu_platform()
+
+    def builder(params):
+        block_r = max(1, min(int(params["block_r"]), rows))
+        fn = jax.jit(lambda x, r, w, b: _pallas_fwd(
+            x, r, w, b, 1e-5, interpret=interpret, block_r=block_r))
+
+        def run():
+            jax.block_until_ready(fn(x, r, w, b))
+
+        return run
+
+    return builder
+
+
+register_schedule(
+    name="layernorm_residual",
+    version=1,
+    params={"block_r": (8, 16, 32, 64, 128, 256, 512)},
+    default=lambda info: {"block_r": _block_rows(info["rows"], info["h"])},
+    # one row block must stay within the searchable VMEM headroom (the
+    # bwd kernel keeps several live; 4 MB/block is the admission line)
+    supported=lambda info, c: (8 <= c["block_r"] <= 1024
+                               and c["block_r"] * info["h"] * 4 <= (1 << 22)),
+    bench=_tuning_bench,
+)
 
 
 # -- reference / fallback -----------------------------------------------------
@@ -118,12 +171,13 @@ def _bwd_kernel(x_ref, r_ref, w_ref, mean_ref, rstd_ref, dy_ref, da_ref,
     dbp_ref[0] = jnp.sum(dy_m, axis=0)
 
 
-def _pallas_fwd(x2, r2, w, b, eps, interpret=False):
+def _pallas_fwd(x2, r2, w, b, eps, interpret=False, block_r=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     rows, h = x2.shape
-    block_r = _block_rows(rows, h)
+    if block_r is None:
+        block_r = _schedule_block_rows(rows, h, x2.dtype)
     grid = (pl.cdiv(rows, block_r),)
     row_spec = pl.BlockSpec((block_r, h), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
@@ -146,12 +200,13 @@ def _pallas_fwd(x2, r2, w, b, eps, interpret=False):
     return y, mean, rstd
 
 
-def _pallas_bwd(x2, r2, w, mean, rstd, dy2, interpret=False):
+def _pallas_bwd(x2, r2, w, mean, rstd, dy2, interpret=False, block_r=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     rows, h = x2.shape
-    block_r = _block_rows(rows, h)
+    if block_r is None:
+        block_r = _schedule_block_rows(rows, h, x2.dtype)
     ntiles = pl.cdiv(rows, block_r)
     row_spec = pl.BlockSpec((block_r, h), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)

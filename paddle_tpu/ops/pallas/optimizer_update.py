@@ -29,6 +29,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..._internal_tuning import register_schedule, resolve_schedule
 from ._platform import on_tpu_platform
 
 __all__ = ["fused_momentum_update"]
@@ -36,6 +37,68 @@ __all__ = ["fused_momentum_update"]
 _LANES = 128
 # minimum sublane multiple per dtype (pallas_guide.md tiling table)
 _SUBLANES = {"float32": 8, "bfloat16": 16}
+_BLOCK_R = 2048  # default rows per program: ≤ 2048×128 f4 = 1 MB / operand
+
+
+def _schedule_block_rows(rows, dtype) -> int:
+    """Row-block size through the autotuner; the default point is the
+    historical ``min(rows, 2048)`` — byte-identical when untuned."""
+    params = resolve_schedule("optimizer_update", rows=int(rows),
+                              dtype=str(dtype))
+    return max(1, min(int(params["block_r"]), rows))
+
+
+def _tuning_bench(info):
+    import numpy as np
+
+    rows = int(info["rows"])
+    dtype = str(info.get("dtype", "float32"))
+    n = rows * _LANES
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(n).astype("f4")).astype(dtype)
+    g = jnp.asarray(rng.randn(n).astype("f4")).astype(dtype)
+    v = jnp.asarray(rng.randn(n).astype("f4")).astype(dtype)
+    interpret = not on_tpu_platform()
+
+    def builder(params):
+        block_r = max(1, min(int(params["block_r"]), rows))
+        fn = jax.jit(lambda p, g, v, lr: _pallas_update(
+            p, g, v, lr, 0.9, 1e-4, False, interpret=interpret,
+            block_r=block_r))
+        lr = jnp.float32(0.1)
+
+        def run():
+            jax.block_until_ready(fn(p, g, v, lr))
+
+        return run
+
+    return builder
+
+
+def _bucket(info):
+    # raw-row tune() keys and padded-[R,128] resolve() keys must
+    # collapse into one bucket: clamp rows to the sublane floor first
+    from ...tuning.schedule import aligned_bucket
+
+    return aligned_bucket({
+        "rows": lambda i: _SUBLANES.get(str(i.get("dtype", "float32")),
+                                        8),
+    })(info)
+
+
+register_schedule(
+    name="optimizer_update",
+    version=1,
+    params={"block_r": (256, 512, 1024, 2048, 4096, 8192)},
+    default=lambda info: {"block_r": min(int(info["rows"]), _BLOCK_R)},
+    bucket=_bucket,
+    # 5 live [block_r, 128] operand blocks (3 in + 2 out) must stay far
+    # under the ~16 MB VMEM budget, bf16 sublane multiple respected
+    supported=lambda info, c: (
+        c["block_r"] >= _SUBLANES.get(info.get("dtype", "float32"), 8)
+        and 5 * c["block_r"] * _LANES * 4 <= (1 << 23)),
+    bench=_tuning_bench,
+)
 
 
 def _jnp_update(param, grad, velocity, lr, mu, wd, nesterov):
@@ -73,7 +136,7 @@ def _supported(param, grad, velocity) -> bool:
 
 
 def _pallas_update(param, grad, velocity, lr, mu, wd, nesterov,
-                   interpret=False):
+                   interpret=False, block_r=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -90,7 +153,8 @@ def _pallas_update(param, grad, velocity, lr, mu, wd, nesterov,
         return a.reshape(rows, _LANES)
 
     pf, gf, vf = flat(param), flat(grad), flat(velocity)
-    block_r = min(rows, 2048)  # ≤ 2048×128 f32 = 1 MB per operand block
+    if block_r is None:
+        block_r = _schedule_block_rows(rows, dtype)
     grid = (pl.cdiv(rows, block_r),)
     row_spec = pl.BlockSpec((block_r, _LANES), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)

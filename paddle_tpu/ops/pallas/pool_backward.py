@@ -31,9 +31,83 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ..._internal_tuning import register_schedule, resolve_schedule
 from ._platform import on_tpu_platform
 
 __all__ = ["max_pool2d_backward", "max_pool_backward_supported"]
+
+
+def _row_elems(h, w, oh, ow, ph, pw):
+    """The kernel's rough f32 working set per [N*C] row (module
+    docstring: padded planes + half-width planes + coarse planes)."""
+    hp, wp = h + 2 * ph, w + 2 * pw
+    return 3 * hp * wp + 6 * hp * ow + 6 * oh * ow + 2 * h * w
+
+
+def _default_block_rows(r, h, w, oh, ow, ph, pw):
+    """The historical policy: start at 8 rows, halve until the block
+    fits ~2 MB AND divides the collapsed [N*C] axis — the schedule
+    space's byte-identical default point."""
+    elems = _row_elems(h, w, oh, ow, ph, pw)
+    br = 8
+    while br > 1 and br * elems * 4 > (2 << 20):
+        br //= 2
+    while r % br:
+        br //= 2
+    return br
+
+
+def _schedule_block_rows(r, h, w, oh, ow, ph, pw, dtype) -> int:
+    params = resolve_schedule("pool_backward", r=int(r), h=int(h),
+                              w=int(w), oh=int(oh), ow=int(ow),
+                              ph=int(ph), pw=int(pw), dtype=str(dtype))
+    return int(params["block_rows"])
+
+
+def _tuning_bench(info):
+    import numpy as np
+    from jax import lax
+
+    r, h, w = int(info["r"]), int(info["h"]), int(info["w"])
+    oh, ow = int(info["oh"]), int(info["ow"])
+    # a 2x2/2 pool reproduces the (h, w) -> (oh, ow) geometry the shape
+    # bucket describes when oh = h//2; bench shapes should respect that
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1, r, h, w).astype("f4"))
+    y = lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, 2, 2),
+                          (1, 1, 2, 2), [(0, 0)] * 4)
+    dy = jnp.asarray(rng.randn(*y.shape).astype("f4"))
+    interpret = not on_tpu_platform()
+
+    def builder(params):
+        br = int(params["block_rows"])
+
+        def run():
+            jax.block_until_ready(max_pool2d_backward(
+                x, y, dy, kernel=(2, 2), stride=(2, 2), padding=(0, 0),
+                interpret=interpret, block_rows=br))
+
+        return run
+
+    return builder
+
+
+register_schedule(
+    name="pool_backward",
+    version=1,
+    params={"block_rows": (1, 2, 4, 8, 16)},
+    default=lambda info: {"block_rows": _default_block_rows(
+        info["r"], info["h"], info["w"], info["oh"], info["ow"],
+        info["ph"], info["pw"])},
+    # must divide the collapsed row axis exactly (the grid floor-divides)
+    # and keep the block within 2x the historical ~2 MB VMEM line
+    supported=lambda info, c: (
+        info["r"] % c["block_rows"] == 0
+        and c["block_rows"] * _row_elems(
+            info["h"], info["w"], info["oh"], info["ow"],
+            info["ph"], info["pw"]) * 4 <= (4 << 20)),
+    bench=_tuning_bench,
+)
 
 
 def _onehot(rows, cols, row_of_col_fn, dtype):
@@ -122,32 +196,41 @@ def _pool_bwd_kernel(x_ref, y_ref, dy_ref, dx_ref, *, kh, kw, sh, sw,
     dx_ref[...] = dxp[:, ph:ph + h, pw:pw + w].astype(dx_ref.dtype)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("kernel", "stride", "padding", "interpret"))
 def max_pool2d_backward(x, y, dy, *, kernel, stride, padding,
-                        interpret=False):
+                        interpret=False, block_rows=None):
     """dx for max pooling: x [N,C,H,W], y/dy [N,C,OH,OW] -> dx like x.
 
     First-max-wins tie semantics, matching XLA select_and_scatter (and the
-    reference CUDA MaxPool2dGradFunctor).
+    reference CUDA MaxPool2dGradFunctor). The rows-per-program schedule
+    resolves through the autotuner OUTSIDE the jitted impl (it is a
+    static argument, so a tuned swap retraces instead of reusing the
+    old grid).
     """
+    ph, pw = padding
+    n, c, h, w = x.shape
+    oh, ow = y.shape[2], y.shape[3]
+    if block_rows is None:
+        block_rows = _schedule_block_rows(n * c, h, w, oh, ow, ph, pw,
+                                          x.dtype)
+    return _max_pool2d_backward(x, y, dy, kernel=tuple(kernel),
+                                stride=tuple(stride),
+                                padding=tuple(padding),
+                                interpret=interpret,
+                                block_rows=int(block_rows))
+
+
+@functools.partial(
+    jax.jit, static_argnames=("kernel", "stride", "padding", "interpret",
+                              "block_rows"))
+def _max_pool2d_backward(x, y, dy, *, kernel, stride, padding,
+                         interpret, block_rows):
     kh, kw = kernel
     sh, sw = stride
     ph, pw = padding
     n, c, h, w = x.shape
     oh, ow = y.shape[2], y.shape[3]
     r = n * c
-    hp, wp = h + 2 * ph, w + 2 * pw
-    # rows per program: the kernel's f32 working set per row is roughly
-    # 3 padded planes + 6 half-width planes + 6 coarse planes; keep the
-    # block under ~2 MB so the compiler's scoped-vmem stack (which
-    # roughly doubles it with in/out buffers) stays within the 16 MB core
-    row_elems = 3 * hp * wp + 6 * hp * ow + 6 * oh * ow + 2 * h * w
-    br = 8
-    while br > 1 and br * row_elems * 4 > (2 << 20):
-        br //= 2
-    while r % br:
-        br //= 2
+    br = block_rows
     precision = (jax.lax.Precision.DEFAULT
                  if x.dtype == jnp.bfloat16
                  else jax.lax.Precision.HIGHEST)

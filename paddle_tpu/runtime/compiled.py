@@ -61,6 +61,48 @@ def any_deleted(arrays) -> bool:
     return False
 
 
+class _NullCapture:
+    """Stand-in when the tuning stack is unavailable: records nothing
+    (entries then never schedule-refresh — plain caching)."""
+
+    log: dict = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _sched_capture():
+    """Capture which kernel schedules a trace resolves
+    (tuning/schedule.py capture_resolutions) — the per-entry record
+    behind precise invalidation: a tuned swap-in rebuilds ONLY the
+    signatures that actually baked the changed schedule in, never the
+    whole fleet of compiled programs. Exception-safe: a broken tuning
+    stack degrades to no capture, never a crash."""
+    try:
+        from ..tuning.schedule import capture_resolutions
+
+        return capture_resolutions()
+    except Exception:
+        return _NullCapture()
+
+
+def _schedules_stale(entry) -> bool:
+    """Would any schedule this entry's trace resolved resolve
+    DIFFERENTLY now? (Quiet — no tuner counters, no search enqueue.)"""
+    rec = entry.resolved_schedules
+    if not rec:
+        return False  # resolved nothing (or not traced yet): immune
+    try:
+        from ..tuning.schedule import resolutions_stale
+
+        return resolutions_stale(rec)
+    except Exception:
+        return False
+
+
 class CompiledEntry:
     """One compiled program: the ``jax.jit`` callable plus its AOT slot.
 
@@ -69,9 +111,9 @@ class CompiledEntry:
     the one-time AOT compile; ``attempted`` is the double-check."""
 
     __slots__ = ("sig", "cache_key", "jitted", "meta", "aot", "record",
-                 "attempted", "lock")
+                 "attempted", "lock", "resolved_schedules", "refresh_gen")
 
-    def __init__(self, sig, cache_key, jitted, meta):
+    def __init__(self, sig, cache_key, jitted, meta, refresh_gen=0):
         self.sig = sig
         self.cache_key = cache_key
         self.jitted = jitted
@@ -80,6 +122,13 @@ class CompiledEntry:
         self.record = None
         self.attempted = False
         self.lock = threading.Lock()
+        # which kernel schedules the trace resolved (captured at first
+        # lower/dispatch): the precise-invalidation record — None until
+        # traced, {} if the program resolves no tuned kernel
+        self.resolved_schedules = None
+        # bumps each time this signature is rebuilt for a schedule
+        # swap, so the refreshed compile gets a NEW cost identity
+        self.refresh_gen = refresh_gen
 
 
 class CompiledStore:
@@ -138,8 +187,9 @@ class CompiledStore:
         with self._lock:
             self._entries.clear()
 
-    def _key_of(self, sig) -> str:
-        h = hashlib.sha1(repr(sig).encode()).hexdigest()[:10]
+    def _key_of(self, sig, refresh_gen=0) -> str:
+        ident = sig if refresh_gen == 0 else (sig, refresh_gen)
+        h = hashlib.sha1(repr(ident).encode()).hexdigest()[:10]
         return f"{self.label}#{h}"
 
     def get_or_build(self, sig, build):
@@ -150,9 +200,26 @@ class CompiledStore:
         racing a cold signature share ONE entry — the per-entry lock
         then serializes the actual XLA compile). Returns
         ``(entry, "hit" | "miss")``.
+
+        Kernel-autotuner coupling: each entry records which schedules
+        its trace resolved; when any of them would resolve differently
+        NOW (a tuned swap-in, a ``FLAGS_kernel_autotune`` flip), the
+        entry is invalidated here — counted as
+        ``<label>::schedule_refresh`` — so the swap is a clean
+        recompile, never a stale trace. Signatures that resolve no
+        tuned kernel are immune (no fleet-wide recompile waves).
         """
         with self._lock:
             entry = self._entries.get(sig)
+            refresh_gen = 0
+            if entry is not None and _schedules_stale(entry):
+                self._entries.pop(sig)
+                refresh_gen = entry.refresh_gen + 1
+                bump_counter(f"{self.label}::schedule_refresh")
+                _flight().record_event(
+                    "runtime_schedule_refresh", label=self.label,
+                    cache_key=entry.cache_key)
+                entry = None
             if entry is not None:
                 self._entries[sig] = self._entries.pop(sig)  # refresh LRU
                 if self._hit_counter:
@@ -161,7 +228,8 @@ class CompiledStore:
             if self._miss_counter:
                 bump_counter(self._miss_counter)
             jitted, meta = build()
-            entry = CompiledEntry(sig, self._key_of(sig), jitted, meta)
+            entry = CompiledEntry(sig, self._key_of(sig, refresh_gen),
+                                  jitted, meta, refresh_gen=refresh_gen)
             self._entries[sig] = entry
             cap = self.capacity
             while len(self._entries) > cap:
@@ -191,7 +259,10 @@ class CompiledStore:
             if entry.attempted:
                 return
             try:
-                lowered = entry.jitted.lower(*args)
+                with _sched_capture() as cap:
+                    lowered = entry.jitted.lower(*args)
+                # the trace just ran: record the schedules it baked in
+                entry.resolved_schedules = dict(cap.log or {})
                 entry.aot = lowered.compile()
                 entry.record = _cost.capture(
                     self.cost_label, lowered=lowered, compiled=entry.aot,
@@ -221,7 +292,14 @@ class CompiledStore:
             self._aot_compile(entry, args, capture_meta)
         runner = entry.aot if entry.aot is not None else entry.jitted
         try:
-            out = runner(*args)
+            if entry.resolved_schedules is None:
+                # AOT lowering was unavailable: the jit fallback's first
+                # call traces here — capture its schedule resolutions
+                with _sched_capture() as cap:
+                    out = runner(*args)
+                entry.resolved_schedules = dict(cap.log or {})
+            else:
+                out = runner(*args)
         except Exception:
             consumed = donated() if callable(donated) else donated
             if runner is entry.jitted or any_deleted(consumed):

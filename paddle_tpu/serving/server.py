@@ -104,6 +104,33 @@ def _jit_misses() -> int:
     return int(_pc().get("executor::jit_cache_miss", 0))
 
 
+def _tuned_kernels() -> dict:
+    """The /statz tuned-kernel table: every autotuned schedule active
+    for THIS device kind (tuning cache entries) plus the tuner's
+    dispatch counters — a reader sees which kernels run on measured
+    geometry and which still ride the defaults."""
+    from ..profiler import counters as _pc
+    from ..tuning import tuned_table
+
+    from ..flags import flag as _flag
+
+    c = _pc()
+    try:
+        rows = tuned_table()
+    except Exception:  # a broken tuning cache must not 500 /statz
+        rows = []
+    return {
+        "mode": _flag("kernel_autotune"),
+        "entries": rows,
+        "counters": {
+            "cache_hit": int(c.get("autotune::cache_hit", 0)),
+            "cache_miss": int(c.get("autotune::cache_miss", 0)),
+            "cache_reject": int(c.get("autotune::cache_reject", 0)),
+            "searches": int(c.get("autotune::search", 0)),
+        },
+    }
+
+
 def _stats_readers():
     """One registry snapshot + the counter/quantile readers both statz
     endpoints share (a change to the quantile fields must not have to be
@@ -532,6 +559,8 @@ class InferenceServer:
             # top-5 end-to-end requests from the trace store: trace_id +
             # per-stage breakdown, the jump-off point to /tracez?id=...
             "slowest": _tracing.slowest_table(5, root_prefix="serving::"),
+            # which pallas kernels run on autotuned geometry here
+            "tuned_kernels": _tuned_kernels(),
         }
         _, out["utilization"] = _utilization(self._t0, self._flops0, val)
         return out
@@ -1104,5 +1133,7 @@ class GenerationServer:
             },
             "slowest": _tracing.slowest_table(5, root_prefix="serving::"),
             "utilization": utilization,
+            # which pallas kernels run on autotuned geometry here
+            "tuned_kernels": _tuned_kernels(),
         }
         return out

@@ -1,0 +1,299 @@
+"""Declarative kernel schedule spaces + the ``resolve()`` choke point.
+
+Every gated pallas kernel (``ops/pallas/*``) registers ONE
+:class:`ScheduleSpace` here: its tunable parameters (block rows/cols,
+tile geometry, unroll factor), today's hardcoded geometry as the
+DEFAULT point, and a validity predicate that prunes candidates (VMEM
+overflow, tile misalignment) BEFORE any compile — the same role the
+kernels' ``_supported`` gates play for shape admission, applied to
+schedules.
+
+``resolve(kernel, **shape_info)`` is the only way a kernel call site
+asks for its schedule:
+
+- cache hit  -> the tuned params for this (kernel, device_kind,
+  shape-bucket, dtype, space-version) — re-validated against the EXACT
+  shape (buckets are coarser than shapes, so a tuned point may not
+  admit every shape in its bucket; an inadmissible hit degrades to the
+  default, counted as ``autotune::cache_reject``).
+- miss -> the default params, byte-identical to the pre-tuning
+  hardcoded geometry. "Untuned" means "default schedule", not a
+  separate code path.
+
+``resolve`` NEVER searches inline: on a miss under
+``FLAGS_kernel_autotune=search`` it enqueues the (kernel, shape) for
+the background tuner and still returns defaults — the swapped-in
+winner applies at the next CompiledStore compile of that signature
+(``runtime/compiled.py`` folds :func:`schedule_token` into the compile
+identity, so a swap is a clean recompile, never a stale-trace hazard).
+``off`` returns defaults without touching the cache or the counters —
+zero tuner work on the dispatch path.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+
+from ..flags import flag
+from ..profiler import bump_counter
+
+__all__ = ["ScheduleSpace", "register_schedule", "schedule_space",
+           "spaces", "resolve", "shape_bucket", "aligned_bucket",
+           "next_pow2", "capture_resolutions", "resolutions_stale"]
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (bucket edge for integer shape dims)."""
+    n = int(n)
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def shape_bucket(info: dict) -> tuple:
+    """Canonical shape-bucket key: integer dims round UP to the next
+    power of two (nearby shapes share one tuned entry; the tuned params
+    are re-validated against the exact shape at resolve time), non-int
+    values (dtype strings, bools) pass through verbatim. Deterministic
+    ordering by key name so the bucket is a stable cache-key part."""
+    parts = []
+    for k in sorted(info):
+        v = info[k]
+        if isinstance(v, bool) or not isinstance(v, int):
+            parts.append((k, v))
+        else:
+            parts.append((k, next_pow2(v)))
+    return tuple(parts)
+
+
+def aligned_bucket(floors: dict):
+    """Bucket factory for kernels whose dispatch path resolves with
+    PADDED dims while offline ``tune()`` uses raw shapes: clamp each
+    integer dim to its tile floor before the pow2 bucket, so both key
+    ONE bucket (``next_pow2(ceil_to_align(x)) == next_pow2(max(x,
+    align))`` for any power-of-two alignment). ``floors`` maps dim name
+    to an int floor or a ``callable(info) -> int`` (dtype-dependent
+    sublane floors)."""
+
+    def bucket(info):
+        parts = []
+        for k in sorted(info):
+            v = info[k]
+            if isinstance(v, bool) or not isinstance(v, int):
+                parts.append((k, v))
+                continue
+            floor = floors.get(k, 1)
+            if callable(floor):
+                floor = floor(info)
+            parts.append((k, next_pow2(max(v, int(floor)))))
+        return tuple(parts)
+
+    return bucket
+
+
+class ScheduleSpace:
+    """One kernel's declarative schedule space.
+
+    ``params`` maps each schedule parameter to its candidate values.
+    ``default(info) -> dict`` computes the historical hardcoded
+    geometry for a concrete shape (the byte-identical untuned point).
+    ``supported(info, cand) -> bool`` prunes invalid candidates
+    (VMEM overflow, unsupported tile) before any compile happens.
+    ``bench(info) -> builder`` returns a measurement builder for the
+    tuner: ``builder(cand) -> run`` where ``run()`` executes one
+    jitted call and blocks on the result (the value-fetch barrier).
+    ``version`` participates in the cache key semantics: bumping it
+    invalidates every persisted entry for the kernel (stale entries
+    degrade to defaults, counted as ``autotune::cache_reject``).
+    """
+
+    __slots__ = ("name", "version", "params", "_default", "_supported",
+                 "_bench", "_bucket")
+
+    def __init__(self, name, *, version, params, default, supported=None,
+                 bench=None, bucket=None):
+        self.name = name
+        self.version = int(version)
+        self.params = {k: tuple(v) for k, v in dict(params).items()}
+        self._default = default
+        self._supported = supported
+        self._bench = bench
+        self._bucket = bucket
+
+    # -- points --------------------------------------------------------------
+
+    def default_params(self, info: dict) -> dict:
+        return dict(self._default(dict(info)))
+
+    def is_supported(self, info: dict, cand: dict) -> bool:
+        if self._supported is None:
+            return True
+        try:
+            return bool(self._supported(dict(info), dict(cand)))
+        except Exception:
+            return False
+
+    def candidates(self, info: dict) -> list:
+        """Cartesian product of the parameter axes, default point first
+        (deduped) — the tuner must always measure the baseline it is
+        claiming a speedup over."""
+        default = self.default_params(info)
+        names = sorted(self.params)
+        out, seen = [], set()
+        for point in [default] + [
+            dict(zip(names, vals))
+            for vals in itertools.product(*(self.params[n] for n in names))
+        ]:
+            merged = {**default, **point}
+            key = tuple(sorted(merged.items()))
+            if key not in seen:
+                seen.add(key)
+                out.append(merged)
+        return out
+
+    def bucket(self, info: dict) -> tuple:
+        if self._bucket is not None:
+            return tuple(self._bucket(dict(info)))
+        return shape_bucket(info)
+
+    def bench(self, info: dict):
+        if self._bench is None:
+            from ..errors import UnimplementedError
+
+            raise UnimplementedError(
+                f"schedule space {self.name!r} registered no bench builder"
+            )
+        return self._bench(dict(info))
+
+    def __repr__(self):
+        return (f"ScheduleSpace({self.name!r}, v{self.version}, "
+                f"params={sorted(self.params)})")
+
+
+_SPACES: dict[str, ScheduleSpace] = {}
+_LOCK = threading.Lock()
+
+
+def register_schedule(space: ScheduleSpace) -> ScheduleSpace:
+    """Register a kernel's schedule space (idempotent by name: kernels
+    register at import; re-import keeps the latest definition)."""
+    with _LOCK:
+        _SPACES[space.name] = space
+    return space
+
+
+def schedule_space(name: str) -> ScheduleSpace:
+    space = _SPACES.get(name)
+    if space is None:
+        # the kernels register their spaces at import; a tune/resolve of
+        # a not-yet-imported kernel should find it, not NotFound
+        try:
+            import importlib
+
+            importlib.import_module("paddle_tpu.ops.pallas")
+        except Exception:
+            pass
+        space = _SPACES.get(name)
+    if space is None:
+        from ..errors import NotFoundError
+
+        raise NotFoundError(
+            f"unknown kernel schedule space {name!r}; "
+            f"registered: {sorted(_SPACES)}")
+    return space
+
+
+def spaces() -> dict:
+    """Snapshot of name -> ScheduleSpace."""
+    with _LOCK:
+        return dict(_SPACES)
+
+
+def _resolution(space: ScheduleSpace, info: dict):
+    """The QUIET resolution core: ``(params, outcome)`` with no
+    counters and no search enqueue — shared by :func:`resolve` (which
+    adds both) and :func:`resolutions_stale` (which must observe the
+    current state without perturbing the tuner's accounting)."""
+    default = space.default_params(info)
+    if flag("kernel_autotune") == "off":
+        return default, "off"
+    from .cache import tuning_cache
+
+    entry = tuning_cache().lookup(space, info)
+    if entry is not None:
+        params = {**default, **entry["params"]}
+        if space.is_supported(info, params):
+            return params, "hit"
+        # bucket coarser than shape: tuned point does not admit this
+        # exact shape — defaults, never a crash (and never a search)
+        return default, "reject"
+    return default, "miss"
+
+
+# trace-time resolution capture: CompiledStore records which schedules
+# a program baked in while it traced, so a tuned swap-in invalidates
+# ONLY the signatures that actually resolved the changed kernel —
+# never the whole fleet of compiled programs
+_capture = threading.local()
+
+
+class capture_resolutions:
+    """Context manager recording every ``resolve()`` outcome inside its
+    scope as ``{(kernel, info-items): params-items}`` (``.log`` after
+    exit). Re-entrant: an inner capture shadows (and restores) the
+    outer one."""
+
+    def __enter__(self):
+        self._prev = getattr(_capture, "log", None)
+        _capture.log = {}
+        return self
+
+    def __exit__(self, *exc):
+        self.log = _capture.log
+        _capture.log = self._prev
+        return False
+
+
+def _note(kernel, info, params):
+    log = getattr(_capture, "log", None)
+    if log is not None:
+        log[(kernel, tuple(sorted(info.items())))] = tuple(
+            sorted(params.items()))
+
+
+def resolutions_stale(log) -> bool:
+    """Whether any captured resolution would resolve DIFFERENTLY now —
+    the precise invalidation predicate behind ``<label>::
+    schedule_refresh``. Quiet: perturbs no counters, enqueues nothing."""
+    for (kernel, info_items), params_items in log.items():
+        space = _SPACES.get(kernel)
+        if space is None:
+            return True  # space unregistered since: rebuild to be safe
+        try:
+            params, _ = _resolution(space, dict(info_items))
+        except Exception:
+            return True
+        if tuple(sorted(params.items())) != params_items:
+            return True
+    return False
+
+
+def resolve(kernel: str, **info) -> dict:
+    """Schedule for one concrete kernel call: tuned params on a cache
+    hit, the byte-identical defaults otherwise. Dict-lookup cheap —
+    safe on the eager dispatch path and at trace time (all values are
+    static Python ints)."""
+    space = _SPACES[kernel] if kernel in _SPACES else schedule_space(kernel)
+    params, outcome = _resolution(space, info)
+    if outcome == "hit":
+        bump_counter("autotune::cache_hit")
+    elif outcome == "reject":
+        bump_counter("autotune::cache_reject")
+    elif outcome == "miss":
+        bump_counter("autotune::cache_miss")
+        if flag("kernel_autotune") == "search":
+            from .tuner import enqueue_search
+
+            enqueue_search(kernel, info)
+    _note(kernel, info, params)
+    return params

@@ -1,0 +1,255 @@
+"""The kernel tuner: offline per-device schedule search.
+
+TVM's discipline (PAPERS.md): search OFFLINE, pay nothing at steady
+state. ``KernelTuner.tune`` measures every VALID candidate of a
+kernel's schedule space for one concrete shape — invalid candidates
+(VMEM overflow, unsupported tile) are pruned by the space's predicate
+BEFORE any compile is paid — and records the winner in the persistent
+tuning cache, where ``resolve()`` finds it and ``schedule_token()``
+turns it into a clean recompile at the next CompiledStore build.
+
+Measurement is best-of-N timed jitted calls with a value-fetch barrier
+(``block_until_ready`` inside the timed run): one untimed warmup call
+absorbs the compile, then N timed calls keep the minimum — the
+standard dispersion-robust estimator for a shared box. The timer is
+injectable (``timer=``) so tests drive the whole selection pipeline
+with a deterministic fake timer and zero real compiles.
+
+Background search (``FLAGS_kernel_autotune=search``): ``resolve()``
+misses enqueue here; one daemon worker drains the queue, tunes, and
+swaps winners into the cache. Hot paths never block on it — the next
+compile of the signature picks the winner up. Tuning failures are
+counted + flight-recorded, never raised into the training loop (the
+"inconclusive never blocks" discipline).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from ..profiler import bump_counter
+from .cache import tuning_cache
+from .schedule import schedule_space
+
+__all__ = ["TuneResult", "KernelTuner", "tune", "enqueue_search",
+           "drain_background", "pending_searches"]
+
+
+def _flight():
+    from ..monitor import flight_recorder
+
+    return flight_recorder
+
+
+class TuneResult:
+    """Outcome of one ``tune()``: the winning params plus the evidence
+    (tuned-vs-default microseconds, candidate accounting)."""
+
+    __slots__ = ("kernel", "params", "default", "best_us", "default_us",
+                 "measured", "pruned", "cached")
+
+    def __init__(self, kernel, params, default, best_us, default_us,
+                 measured, pruned, cached):
+        self.kernel = kernel
+        self.params = params          # winning schedule point
+        self.default = default        # the byte-identical untuned point
+        self.best_us = best_us
+        self.default_us = default_us
+        self.measured = measured      # candidates actually timed
+        self.pruned = pruned          # candidates rejected pre-compile
+        self.cached = cached          # landed in the tuning cache
+
+    @property
+    def speedup(self) -> float:
+        return (self.default_us / self.best_us
+                if self.best_us and self.default_us else 1.0)
+
+    def __repr__(self):
+        # default_us is None when the default point itself was pruned
+        # (the space's predicate rejects it for this exact shape)
+        default = (f"{self.default_us:.1f}us"
+                   if self.default_us is not None else "pruned")
+        return (f"TuneResult({self.kernel!r}, {self.params}, "
+                f"best={self.best_us:.1f}us, default={default}, "
+                f"x{self.speedup:.3f}, measured={self.measured}, "
+                f"pruned={self.pruned})")
+
+
+class KernelTuner:
+    """Measure-and-select over a kernel's schedule space.
+
+    ``timer(run) -> seconds`` times ONE call of the zero-arg ``run``
+    (which already blocks on its outputs); the default is a wall-clock
+    ``perf_counter`` pair. ``measure_n`` best-of repetitions after one
+    untimed warmup (the warmup pays the XLA compile, so timings are
+    steady-state numbers)."""
+
+    def __init__(self, *, measure_n=5, timer=None):
+        self.measure_n = max(1, int(measure_n))
+        self._timer = timer
+
+    def _time_once(self, run) -> float:
+        if self._timer is not None:
+            return float(self._timer(run))
+        t0 = time.perf_counter()
+        run()
+        return time.perf_counter() - t0
+
+    def measure(self, run) -> float:
+        """Best-of-N microseconds for one candidate's ``run``."""
+        run()  # warmup: compile + first dispatch, never timed
+        best = float("inf")
+        for _ in range(self.measure_n):
+            best = min(best, self._time_once(run))
+        return best * 1e6
+
+    def tune(self, kernel, *, candidates=None, cache=None,
+             device_kind=None, save=True, **info) -> TuneResult:
+        """Search one (kernel, shape) and record the winner.
+
+        ``candidates`` overrides the space's full cartesian product
+        (the CPU smoke restricts it); the default point is always
+        included and measured — the claimed speedup is against the real
+        baseline, not a guess. ``save=False`` measures without touching
+        the cache (A/B reporting)."""
+        space = schedule_space(kernel)
+        default = space.default_params(info)
+        points = list(candidates) if candidates is not None else None
+        if points is None:
+            points = space.candidates(info)
+        else:
+            points = [{**default, **p} for p in points]
+            if default not in points:
+                points.insert(0, default)
+        # prune BEFORE compile: the predicate is the only code that runs
+        # for an invalid candidate
+        valid, pruned = [], 0
+        for cand in points:
+            if space.is_supported(info, cand):
+                valid.append(cand)
+            else:
+                pruned += 1
+        bump_counter("autotune::pruned", pruned)
+        builder = space.bench(info)
+        best_params, best_us, default_us = None, float("inf"), None
+        for cand in valid:
+            us = self.measure(builder(cand))
+            bump_counter("autotune::measured")
+            if cand == default:
+                default_us = us
+            if us < best_us:
+                best_params, best_us = cand, us
+        if best_params is None:
+            from ..errors import PreconditionNotMetError
+
+            raise PreconditionNotMetError(
+                f"tune({kernel!r}): no valid candidate for {info} "
+                f"({pruned} pruned)")
+        bump_counter("autotune::search")
+        store = cache if cache is not None else tuning_cache()
+        cached = False
+        if save:
+            store.put(space, info, best_params, device_kind=device_kind,
+                      best_us=round(best_us, 3),
+                      default_us=round(default_us, 3)
+                      if default_us is not None else None)
+            cached = True
+        result = TuneResult(kernel, best_params, default, best_us,
+                            default_us, len(valid), pruned, cached)
+        _flight().record_event(
+            "autotune_search", kernel=kernel,
+            params=dict(best_params),
+            best_us=round(best_us, 3),
+            default_us=(round(default_us, 3)
+                        if default_us is not None else None),
+            speedup=round(result.speedup, 3),
+            measured=len(valid), pruned=pruned)
+        return result
+
+
+_default_tuner = [None]
+
+
+def _tuner() -> KernelTuner:
+    if _default_tuner[0] is None:
+        _default_tuner[0] = KernelTuner()
+    return _default_tuner[0]
+
+
+def tune(kernel, **kw) -> TuneResult:
+    """Module-level convenience over the default tuner."""
+    return _tuner().tune(kernel, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Background search (FLAGS_kernel_autotune=search)
+# ---------------------------------------------------------------------------
+
+_bg_lock = threading.Lock()
+_bg_queue: "queue.Queue" = queue.Queue()
+_bg_pending: set = set()
+_bg_thread = [None]
+
+
+def pending_searches() -> int:
+    with _bg_lock:
+        return len(_bg_pending)
+
+
+def _bg_key(kernel, info) -> tuple:
+    space = schedule_space(kernel)
+    return (kernel, space.bucket(info))
+
+
+def _bg_worker():
+    while True:
+        kernel, info, key = _bg_queue.get()
+        try:
+            _tuner().tune(kernel, **info)
+        except Exception as e:
+            # a failed background search must never surface into the
+            # training loop — count it, record it, move on
+            bump_counter("autotune::search_error")
+            try:
+                _flight().record_event("autotune_search_error",
+                                       kernel=kernel,
+                                       error=f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+        finally:
+            with _bg_lock:
+                _bg_pending.discard(key)
+            _bg_queue.task_done()
+
+
+def enqueue_search(kernel, info: dict):
+    """Queue one (kernel, shape-bucket) for background tuning — deduped
+    so a hot loop missing the cache every step enqueues ONE search, not
+    thousands. Called by ``resolve()`` under mode=search only."""
+    try:
+        key = _bg_key(kernel, info)
+    except Exception:
+        return
+    with _bg_lock:
+        if key in _bg_pending:
+            return
+        _bg_pending.add(key)
+        if _bg_thread[0] is None or not _bg_thread[0].is_alive():
+            _bg_thread[0] = threading.Thread(
+                target=_bg_worker, name="ptpu-autotune", daemon=True)
+            _bg_thread[0].start()
+    bump_counter("autotune::enqueued")
+    _bg_queue.put((kernel, dict(info), key))
+
+
+def drain_background(timeout=60.0) -> bool:
+    """Wait for every queued background search to finish (tools/tests;
+    production never blocks on this). True when drained in time."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with _bg_lock:
+            if not _bg_pending:
+                return True
+        time.sleep(0.01)
+    return False

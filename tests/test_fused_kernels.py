@@ -28,16 +28,18 @@ from paddle_tpu.framework.tensor import to_tensor
 # the package re-exports shadow the submodule names; reach the modules
 from paddle_tpu.ops.pallas import optimizer_update as _  # noqa: F401
 from paddle_tpu.ops.pallas import layernorm_residual as _  # noqa: F401
+from paddle_tpu.ops.pallas import conv_bn_relu as _  # noqa: F401
 
 ou = sys.modules["paddle_tpu.ops.pallas.optimizer_update"]
 lnr = sys.modules["paddle_tpu.ops.pallas.layernorm_residual"]
+cbr = sys.modules["paddle_tpu.ops.pallas.conv_bn_relu"]
 
 
 @pytest.fixture
 def _flags_restored():
     yield
     set_flags({"use_fused_optimizer": True, "use_fused_layernorm": True,
-               "io_prefetch_overlap": True})
+               "use_fused_conv_bn": True, "io_prefetch_overlap": True})
 
 
 # -- fused momentum update ----------------------------------------------------
@@ -273,6 +275,228 @@ def test_pre_norm_layer_unaffected_by_flag(_flags_restored):
     set_flags({"use_fused_layernorm": False})
     b = run()
     np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+
+# -- fused conv + batch_norm + relu -------------------------------------------
+
+
+def _cbr_operands(cin=3, cout=8, kh=3, df="NCHW", seed=0):
+    rng = np.random.RandomState(seed)
+    n, h = 2, 10
+    shape = (n, cin, h, h) if df == "NCHW" else (n, h, h, cin)
+    x = jnp.asarray(rng.randn(*shape).astype("f4"))
+    w = jnp.asarray(rng.randn(cout, cin, kh, kh).astype("f4") * 0.2)
+    gamma = jnp.asarray(rng.rand(cout).astype("f4") + 0.5)
+    beta = jnp.asarray(rng.randn(cout).astype("f4") * 0.1)
+    mean = jnp.asarray(rng.randn(cout).astype("f4") * 0.1)
+    var = jnp.asarray(rng.rand(cout).astype("f4") + 0.5)
+    return x, w, gamma, beta, mean, var
+
+
+@pytest.mark.parametrize("case", [
+    dict(kh=3, stride=2, padding=1, df="NCHW", training=True),
+    dict(kh=1, stride=1, padding=0, df="NCHW", training=True),  # pointwise
+    dict(kh=3, stride=1, padding=1, df="NHWC", training=False),
+    dict(kh=3, stride=1, padding=1, df="NCHW", training=False),
+])
+def test_conv_bn_relu_interpret_parity_fwd(case):
+    """Pallas (interpret) == the unfused conv2d->batch_norm->relu op
+    sequence, including the running-stat outputs, across stride /
+    padding / layout / mode."""
+    df, training = case["df"], case["training"]
+    x, w, gamma, beta, mean, var = _cbr_operands(kh=case["kh"], df=df)
+    kw = dict(stride=case["stride"], padding=case["padding"],
+              training=training, momentum=0.9, eps=1e-5, data_format=df)
+    ref_y, ref_m, ref_v = cbr._reference(x, w, gamma, beta, mean, var,
+                                         **kw)
+    y, nm, nv = cbr._fused(x, w, gamma, beta, mean, var, interpret=True,
+                           force=True, **kw)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(nm), np.asarray(ref_m),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(nv), np.asarray(ref_v),
+                               rtol=1e-3, atol=1e-4)
+
+
+def test_conv_bn_relu_large_mean_variance_is_stable():
+    """The training statistics use a CENTERED two-pass variance: a
+    channel with mean ~100 and std ~0.1 (unnormalized-image regime)
+    must match the reference batch_norm — the one-pass E[x^2]-mean^2
+    form loses the entire variance to f32 cancellation here."""
+    rng = np.random.RandomState(0)
+    # mean ~100, std ~0.1 per channel: the cancellation regime
+    x = jnp.asarray((rng.randn(4, 1, 12, 12) * 0.1 + 100.0).astype("f4"))
+    w = jnp.asarray(np.full((8, 1, 1, 1), 1.0, "f4"))  # identity-ish conv
+    gamma = jnp.asarray(np.ones(8, "f4"))
+    beta = jnp.asarray(np.zeros(8, "f4"))
+    mean = jnp.asarray(np.zeros(8, "f4"))
+    var = jnp.asarray(np.ones(8, "f4"))
+    kw = dict(stride=1, padding=0, training=True, momentum=0.9,
+              eps=1e-5, data_format="NCHW")
+    ref_y, _, ref_v = cbr._reference(x, w, gamma, beta, mean, var, **kw)
+    y, _, nv = cbr._fused(x, w, gamma, beta, mean, var,
+                          interpret=True, force=True, **kw)
+    # the normalized output is O(1); cancellation would blow it up by
+    # orders of magnitude, so a tight relative bound pins the fix
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y),
+                               rtol=5e-3, atol=5e-3)
+    np.testing.assert_allclose(np.asarray(nv), np.asarray(ref_v),
+                               rtol=1e-2)
+
+
+@pytest.mark.parametrize("training", [True, False])
+def test_conv_bn_relu_interpret_parity_bwd(training):
+    """Pallas backward (relu-gate recompute + folded BN backward + the
+    patch-VJP dx scatter) == autodiff of the unfused sequence."""
+    x, w, gamma, beta, mean, var = _cbr_operands(seed=1)
+    kw = dict(stride=2, padding=1, training=training, momentum=0.9,
+              eps=1e-5, data_format="NCHW")
+
+    def loss(fn, x, w, g, b):
+        y, _, _ = fn(x, w, g, b, mean, var, **kw)
+        return (y * jnp.cos(y)).sum()
+
+    ref = jax.grad(lambda *a: loss(cbr._reference, *a),
+                   argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    fused = jax.grad(
+        lambda *a: loss(
+            lambda *b, **k: cbr._fused(*b, interpret=True, force=True,
+                                       **k), *a),
+        argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    for name, a, b in zip(("dx", "dw", "dgamma", "dbeta"), ref, fused):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+def test_conv_bn_relu_ragged_row_tiles_fwd_bwd():
+    """Row counts that do NOT divide the 256-row tile (2*17*17=578 ->
+    three tiles, ragged tail): the reduction kernels must mask the
+    out-of-bounds tail rows (undefined content) out of the channel
+    sums — fwd stats AND bwd partials."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(2, 3, 17, 17).astype("f4"))
+    w = jnp.asarray(rng.randn(8, 3, 3, 3).astype("f4") * 0.2)
+    gamma = jnp.asarray(rng.rand(8).astype("f4") + 0.5)
+    beta = jnp.asarray(rng.randn(8).astype("f4") * 0.1)
+    mean = jnp.asarray(np.zeros(8, "f4"))
+    var = jnp.asarray(np.ones(8, "f4"))
+    kw = dict(stride=1, padding=1, training=True, momentum=0.9,
+              eps=1e-5, data_format="NCHW")
+    ref_y, _, ref_v = cbr._reference(x, w, gamma, beta, mean, var, **kw)
+    y, _, nv = cbr._fused(x, w, gamma, beta, mean, var, interpret=True,
+                          force=True, **kw)
+    assert not np.isnan(np.asarray(y)).any()
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref_y),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(nv), np.asarray(ref_v),
+                               rtol=1e-3, atol=1e-4)
+
+    def loss(fn, x, w, g, b):
+        y, _, _ = fn(x, w, g, b, mean, var, **kw)
+        return (y * jnp.cos(y)).sum()
+
+    ref = jax.grad(lambda *a: loss(cbr._reference, *a),
+                   argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    fused = jax.grad(
+        lambda *a: loss(
+            lambda *b, **k: cbr._fused(*b, interpret=True, force=True,
+                                       **k), *a),
+        argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+    for name, a, b in zip(("dx", "dw", "dgamma", "dbeta"), ref, fused):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("training", [True, False])
+def test_resnet_conv_bn_flag_is_bit_exact_off_tpu(training,
+                                                  _flags_restored):
+    """Flag on vs off through the REAL model: off-TPU the fused op's
+    fallback IS the unfused op sequence, so outputs AND the updated
+    running statistics are bit-exact."""
+    from paddle_tpu.models import resnet18
+
+    def run(flag_on):
+        set_flags({"use_fused_conv_bn": flag_on})
+        paddle.seed(0)
+        m = resnet18(num_classes=10)
+        m.train() if training else m.eval()
+        x = to_tensor(np.random.RandomState(3)
+                      .randn(2, 3, 32, 32).astype("f4"))
+        out = m(x)
+        return (np.asarray(out), np.asarray(m.bn1._mean),
+                np.asarray(m.bn1._variance))
+
+    fused = run(True)
+    unfused = run(False)
+    for a, b in zip(fused, unfused):
+        np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+
+def test_conv_bn_relu_trains_through_compiled_step(_flags_restored):
+    """The fused triple traces into TrainStepFn (the ResNet bench's
+    configuration): identical loss trajectory flag on/off, and it
+    actually trains."""
+    from paddle_tpu.framework import jit as fjit
+    from paddle_tpu.models import resnet18
+
+    def run(flag_on):
+        set_flags({"use_fused_conv_bn": flag_on})
+        paddle.seed(1)
+        m = resnet18(num_classes=4)
+        opt = popt.Momentum(learning_rate=0.01, momentum=0.9,
+                            parameters=m.parameters())
+        step = fjit.train_step(
+            m, opt,
+            lambda mm, x, y: F.cross_entropy(mm(x), y).mean())
+        rng = np.random.RandomState(0)
+        X = rng.randn(4, 3, 32, 32).astype("f4")
+        Y = rng.randint(0, 4, (4,)).astype("int64")
+        return [float(np.asarray(step(X, Y)["loss"])) for _ in range(4)]
+
+    fused = run(True)
+    unfused = run(False)
+    np.testing.assert_allclose(fused, unfused, rtol=1e-6)
+    assert fused[-1] < fused[0]
+
+
+def test_fused_helper_falls_back_for_inadmissible_convs(_flags_restored):
+    """Grouped / biased / dilated convs never take the fused path —
+    the helper composes the plain layers instead (identical output)."""
+    set_flags({"use_fused_conv_bn": True})
+    paddle.seed(5)
+    conv = nn.Conv2D(4, 8, 3, padding=1, groups=2)  # grouped + biased
+    bn = nn.BatchNorm2D(8)
+    x = to_tensor(np.random.RandomState(7).randn(2, 4, 8, 8).astype("f4"))
+    out = nn.fused_conv_bn_relu(conv, bn, x)
+    ref = F.relu(bn(conv(x)))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0, atol=0)
+
+
+def test_conv_bn_relu_tensor_autograd_matches_unfused(_flags_restored):
+    """Gradients through the op tape: fused helper == relu(bn(conv)),
+    for conv weight and bn affine params."""
+    def run(flag_on):
+        set_flags({"use_fused_conv_bn": flag_on})
+        paddle.seed(2)
+        conv = nn.Conv2D(3, 8, 3, padding=1, bias_attr=False)
+        bn = nn.BatchNorm2D(8)
+        x = to_tensor(np.random.RandomState(11)
+                      .randn(2, 3, 8, 8).astype("f4"),
+                      stop_gradient=False)
+        out = nn.fused_conv_bn_relu(conv, bn, x)
+        out.sum().backward()
+        return (np.asarray(out), np.asarray(x.grad),
+                np.asarray(conv.weight.grad), np.asarray(bn.weight.grad),
+                np.asarray(bn.bias.grad))
+
+    fused = run(True)
+    unfused = run(False)
+    for name, a, b in zip(("out", "dx", "dw", "dgamma", "dbeta"),
+                          fused, unfused):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                   err_msg=name)
 
 
 # -- overlapped device prefetch ----------------------------------------------

@@ -34,6 +34,11 @@
 #             accuracy envelope on BERT/ResNet/GPT smoke programs,
 #             strict pre-compile admission naming the high-water op,
 #             donation-safety golden, <1% steady-state dispatch cost)
+#           + autotune smoke (kernel autotuner: pallas-vs-jnp parity on
+#             layernorm + conv+bn+relu under default AND tuned
+#             schedules, offline search with pre-compile pruning, the
+#             JSON cache round-tripping into a fresh process with zero
+#             re-search, corrupt cache degrading to defaults)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -141,6 +146,12 @@ case "$MODE" in
     # high-water op named, the donated-then-read golden rejected, and
     # the admission gate under 1% of the steady-state dispatch period
     JAX_PLATFORMS=cpu python tools/memplan_smoke.py
+    # autotune smoke: kernel autotuner — layernorm + conv+bn+relu parity
+    # under default and tuned schedules (fwd+bwd), offline search with
+    # invalid candidates pruned before compile, the versioned JSON cache
+    # round-tripping across a fresh process with zero re-search, and a
+    # truncated cache degrading to defaults (one cache_reject, no crash)
+    JAX_PLATFORMS=cpu python tools/autotune_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

@@ -50,6 +50,7 @@ MODULES = [
     "paddle_tpu.runtime",
     "paddle_tpu.generation",
     "paddle_tpu.analysis",
+    "paddle_tpu.tuning",
 ]
 
 # methods pinned as API surface beyond the module-level names (the spec
