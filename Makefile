@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke autotune-smoke
+.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke autotune-smoke ir-opt-smoke
 
 lint:  # graphlint gate: pure-AST framework lint, waivers must justify every exception
 	python tools/graphlint.py --check
@@ -79,6 +79,9 @@ memplan-smoke:  # static peak-HBM planner: accuracy envelope, strict admission, 
 
 autotune-smoke:  # kernel autotuner: parity under tuned schedules, search + cache round-trip, zero re-search warm
 	JAX_PLATFORMS=cpu python tools/autotune_smoke.py
+
+ir-opt-smoke:  # program-IR optimizer: fusion counts, numeric goldens, training byte-identity, remat strict admit
+	JAX_PLATFORMS=cpu python tools/ir_opt_smoke.py
 
 check:
 	python tools/graphlint.py --check

@@ -26,6 +26,16 @@ Two halves (ISSUE 13, in the TVM/compiler-first spirit of PAPERS.md):
   device HBM budget through :func:`check_memory_budget` behind
   ``FLAGS_memory_budget_check``, and every real compile closes the loop
   via :func:`note_actual` (``plan_accuracy`` vs XLA memory_analysis).
+- :mod:`optimizer` (IR optimizer, ISSUE 16) — the REWRITE half over the
+  same IR: a :class:`PassManager` of fusion passes (conv2d+batch_norm+
+  relu, residual-add+layer_norm, dequantized-int8 matmul/mul onto the
+  fused registry kernels), generalized constant folding + dead-op
+  elimination (the former Predictor-local ``inference/passes.py``
+  pipeline), and liveness-driven rematerialization that consults the
+  memplan resident curve to fit an over-budget program into HBM.
+  ``Executor.run`` and the Predictor drive :func:`optimize_program`
+  behind ``FLAGS_ir_opt_level``; every pass verifies pre/post and
+  replans memory, reporting per-pass stats to counters and ``/statz``.
 """
 from .verifier import (  # noqa: F401
     Finding,
@@ -53,6 +63,15 @@ from .memory import (  # noqa: F401
     note_actual,
     plan_memory,
 )
+from .optimizer import (  # noqa: F401
+    OptResult,
+    PassManager,
+    PassStats,
+    optimize_program,
+    optimizer_passes,
+    optimizer_stats,
+    register_opt_pass,
+)
 from .waivers import Waiver, load_waivers, match_waiver  # noqa: F401
 
 __all__ = [
@@ -65,6 +84,13 @@ __all__ = [
     "hbm_budget_bytes",
     "note_actual",
     "plan_memory",
+    "OptResult",
+    "PassManager",
+    "PassStats",
+    "optimize_program",
+    "optimizer_passes",
+    "optimizer_stats",
+    "register_opt_pass",
     "Finding",
     "VerifyError",
     "VerifyReport",

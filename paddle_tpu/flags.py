@@ -159,6 +159,24 @@ define_flag("memory_budget_check", "warn",
             "strict (strict rejects over-budget programs and unsafe "
             "donations with the high-water op named)")
 
+# static/executor.py Executor.run + inference/predictor.py Predictor +
+# analysis/optimizer.py — the program-IR optimizer gate, run ahead of the
+# verify/memplan gates and lowering (the switch_ir_optim role of
+# inference/api/paddle_pass_builder.cc, generalized to every executed
+# program). 0: off (programs run exactly as built). 1: fusion rewrites
+# onto the fused registry kernels (conv2d->batch_norm->relu,
+# residual-add->layer_norm, dequantized-int8 matmul/mul chains) plus
+# side-effect-safe dead-op elimination — a training program with no
+# fusible chain comes back byte-identical. 2: level 1 plus liveness-
+# driven rematerialization when the memory planner says the program is
+# over the device HBM budget (recompute cheap activations at their late
+# uses instead of holding them). The optimized clone caches per program
+# version (the verifier-cache discipline), so steady-state dispatch pays
+# one dict lookup; per-pass stats land on profiler counters and /statz.
+define_flag("ir_opt_level", 1,
+            "program-IR optimizer level: 0 off, 1 fusion+DCE, "
+            "2 +rematerialization under memory pressure")
+
 # platform/flags.cc benchmark — wired into framework/jit.py: synchronous
 # dispatch (block until ready each step) so wall-clock timings are exact
 define_flag("benchmark", False,

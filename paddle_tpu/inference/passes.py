@@ -1,49 +1,23 @@
-"""Inference IR passes.
+"""Inference IR passes — facade over the program-IR optimizer.
 
 Reference parity: inference/analysis/ir_pass_manager.cc + the pass list
-of api/paddle_pass_builder.cc. On this runtime most of the reference's
-fusion passes (conv_bn_fuse, fc_fuse, multihead_matmul_fuse, …) are
-XLA's job — the whole block compiles into one fused HLO module — so the
-passes that still pay are the *graph-shrinking* ones that XLA never
-sees: constant folding (precompute everything not reachable from a
-feed; fewer ops to trace+compile, weights pre-transformed at load time)
-and dead-op elimination (drop ops whose outputs no fetch needs).
+of api/paddle_pass_builder.cc. The Predictor-local pipeline that used to
+live here (constant folding + dead-op elimination) was generalized into
+:mod:`paddle_tpu.analysis.optimizer` (ISSUE 16) so ``Executor.run`` and
+the Predictor share one registered pass pipeline; this module keeps the
+stable load-time API — ``IrPassManager`` and the two pass functions —
+and delegates to the registered optimizer passes. The legacy stats
+shape (``{ops_before, folded, dce_removed, ops_after}``) is preserved
+for ``Predictor.pass_stats`` consumers.
 """
 from __future__ import annotations
 
-import numpy as np
+from ..analysis import optimizer as _opt
 
 __all__ = ["IrPassManager", "constant_folding_pass", "dead_op_elimination_pass"]
 
-
-def _op_outputs(op):
-    return [n for ns in op.outputs.values() for n in ns if n]
-
-
-def _op_inputs(op):
-    return [n for ns in op.inputs.values() for n in ns if n]
-
-
-def dead_op_elimination_pass(program, fetch_names):
-    """Remove top-block ops no fetch transitively depends on.
-
-    Reference: the DCE effect of ir/graph passes (e.g.
-    delete_quant_dequant leftovers); returns the number of ops removed.
-    """
-    block = program.global_block()
-    needed = set(fetch_names)
-    keep = []
-    for op in reversed(block.ops):
-        outs = _op_outputs(op)
-        if any(o in needed for o in outs):
-            keep.append(op)
-            needed.update(_op_inputs(op))
-    keep.reverse()
-    removed = len(block.ops) - len(keep)
-    block.ops[:] = keep
-    if removed:
-        program._version = getattr(program, "_version", 0) + 1
-    return removed
+# optimizer pass name -> legacy Predictor.pass_stats key
+_LEGACY_KEY = {"constant_folding": "folded", "dead_op_elimination": "dce_removed"}
 
 
 def constant_folding_pass(program, scope, feed_names, fetch_names):
@@ -51,64 +25,34 @@ def constant_folding_pass(program, scope, feed_names, fetch_names):
 
     An op whose inputs are all load-time constants (parameters in the
     scope, captured constants, or outputs of already-folded ops) runs
-    ONCE here with the real kernels; its outputs become scope-resident
-    persistable vars and the op disappears from the block. Weight
-    pre-transformations (reshape/transpose/cast of params, bias
-    reshapes, `full`-style literals) all collapse at load time.
-
-    RNG ops and control-flow ops never fold. Returns ops folded.
+    ONCE with the real kernels; its outputs become scope-resident
+    persistable vars and the op disappears from the block. RNG ops and
+    control-flow ops never fold. Returns ops folded. Delegates to the
+    registered ``constant_folding`` optimizer pass.
     """
-    from ..ops.registry import kernel
+    return _opt.constant_folding(
+        _opt.OptContext(program, feed_names, fetch_names, scope=scope))
 
-    block = program.global_block()
-    consts = dict(getattr(program, "_constants", {}) or {})
-    available = set(consts)
-    for name in scope.var_names():
-        available.add(name)
-    feeds = set(feed_names)
-    fetches = set(fetch_names)
 
-    folded = 0
-    keep = []
-    for op in block.ops:
-        ins = _op_inputs(op)
-        outs = _op_outputs(op)
-        foldable = (
-            op.type not in ("while", "cond", "scan", "feed", "fetch")
-            and not op.type.startswith("grad::")
-            and not op.attrs.get("__rng__")
-            and all(n in available and n not in feeds for n in ins)
-            and outs
-        )
-        if not foldable:
-            keep.append(op)
-            continue
-        attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
-        args = []
-        for n in ins:
-            args.append(scope.get(n) if scope.has(n) else consts[n])
-        try:
-            out = kernel(op.type)(*args, **attrs)
-        except Exception:
-            keep.append(op)  # kernel refused (e.g. eager-only guard)
-            continue
-        results = list(out) if isinstance(out, (tuple, list)) else [out]
-        for name, value in zip(op.outputs.get("Out", []), results):
-            if not name or value is None:
-                continue
-            scope.set(name, value)
-            if block.has_var(name):
-                block.var(name).persistable = True
-            available.add(name)
-        folded += 1
-    block.ops[:] = keep
-    if folded:
-        program._version = getattr(program, "_version", 0) + 1
-    return folded
+def dead_op_elimination_pass(program, fetch_names):
+    """Remove top-block ops no fetch transitively depends on.
+
+    Returns the number of ops removed. Delegates to the registered
+    ``dead_op_elimination`` optimizer pass (iterative, side-effect
+    aware: control flow, ``grad::`` replays, ``__inplace__`` ops and
+    persistable writers are always kept).
+    """
+    return _opt.dead_op_elimination(_opt.OptContext(program, (), fetch_names))
 
 
 class IrPassManager:
-    """ir_pass_manager.cc equivalent: ordered pass application with stats."""
+    """ir_pass_manager.cc equivalent: ordered pass application with stats.
+
+    Now a facade over :class:`paddle_tpu.analysis.optimizer.PassManager`
+    — same two-pass load-time pipeline, same legacy stats dict, but the
+    passes themselves (and their verify/replan contract plus per-pass
+    counters) come from the shared optimizer registry.
+    """
 
     def __init__(self, passes=None):
         self.passes = passes or ["constant_folding", "dead_op_elimination"]
@@ -117,18 +61,9 @@ class IrPassManager:
     def apply(self, program, scope, feed_names, fetch_names):
         block = program.global_block()
         self.stats = {"ops_before": len(block.ops)}
-        for name in self.passes:
-            if name == "constant_folding":
-                self.stats["folded"] = constant_folding_pass(
-                    program, scope, feed_names, fetch_names
-                )
-            elif name == "dead_op_elimination":
-                self.stats["dce_removed"] = dead_op_elimination_pass(
-                    program, fetch_names
-                )
-            else:
-                from ..errors import NotFoundError
-
-                raise NotFoundError(f"unknown inference pass {name!r}")
+        pm = _opt.PassManager(self.passes)  # NotFoundError on unknown names
+        for st in pm.apply(program, feed_names, fetch_names, level=1,
+                           scope=scope):
+            self.stats[_LEGACY_KEY.get(st.name, st.name)] = st.ops_rewritten
         self.stats["ops_after"] = len(block.ops)
         return self.stats

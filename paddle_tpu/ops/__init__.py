@@ -27,6 +27,7 @@ from . import metrics_kernels as _mk  # noqa: F401
 from . import nn_extra as _nx  # noqa: F401
 from . import quantize_kernels as _qk  # noqa: F401
 from . import compat as _compat  # noqa: F401  (reference op-type aliases)
+from . import fused_ops as _fo  # noqa: F401  (IR-optimizer fusion targets)
 from . import niche as _niche  # noqa: F401  (registry tail: tree_conv etc.)
 from . import optimizer_kernels as _ok  # noqa: F401
 from . import sequence as _seq  # noqa: F401

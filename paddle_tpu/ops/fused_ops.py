@@ -1,0 +1,55 @@
+"""First-class fused registry ops — the IR optimizer's rewrite targets.
+
+Reference parity: the *_fuse_pass outputs of inference/api/paddle_pass_builder.cc
+(conv_bn_fuse_pass, fc_elementwise_layernorm_fuse_pass, quant ops). The
+reference registers fused operators that its graph passes rewrite chains
+into; here the same role is played by thin registry entries over the
+existing pallas kernels (ops/pallas/conv_bn_relu.py,
+ops/pallas/layernorm_residual.py), so a REWRITTEN Program executes the
+fused dispatch everywhere the hand-wired nn.Layer call sites already do
+— pallas on TPU for admitted shapes, the bit-identical unfused primitive
+sequence elsewhere (the kernels' own fallback discipline). The int8
+chain rewrites onto the already-registered ``matmul_int8``/``mul_int8``
+(quantize_kernels.py) and needs no new entry.
+
+These ops are *compiler-internal*: builders never append them directly —
+``analysis/optimizer.py``'s fusion passes do, with the refusal rules
+(fetched/multi-consumer/grad-fed intermediates) enforced at rewrite time.
+"""
+from __future__ import annotations
+
+from .registry import register_op
+
+
+@register_op("fused_conv_bn_relu", num_outputs=3)
+def fused_conv_bn_relu(x, weight, scale, bias, mean, var, *, stride=1,
+                       padding=0, momentum=0.9, epsilon=1e-5, training=False,
+                       data_format="NCHW"):
+    """``relu(batch_norm(conv2d(x, weight)))`` as one registry op.
+
+    Returns ``(y, new_running_mean, new_running_var)`` — the exact
+    output structure of the ``batch_norm`` op (the optimizer keeps the
+    original stat-output names and their ``__inplace__`` aliasing, so
+    the executor's persistable write-back is unchanged). The conv must
+    be bias-free, ungrouped, undilated — the fusion pass only rewrites
+    chains that satisfy this.
+    """
+    from .pallas.conv_bn_relu import _fused
+
+    return _fused(x, weight, scale, bias, mean, var, stride=stride,
+                  padding=padding, training=bool(training),
+                  momentum=float(momentum), eps=float(epsilon),
+                  data_format=data_format)
+
+
+@register_op("fused_layernorm_residual")
+def fused_layernorm_residual(x, residual, scale, bias, *, epsilon=1e-5):
+    """``LayerNorm(x + residual)`` over the last dim as one registry op.
+
+    Same math as ``elementwise_add`` -> ``layer_norm`` with a trailing
+    ``[H]`` scale/bias (the transformer residual idiom); the pallas
+    kernel keeps one HBM round-trip instead of two.
+    """
+    from .pallas.layernorm_residual import _ln_res
+
+    return _ln_res(x, residual, scale, bias, float(epsilon))

@@ -131,6 +131,31 @@ def _tuned_kernels() -> dict:
     }
 
 
+def _ir_opt_stats() -> dict:
+    """The /statz IR-optimizer table: per-pass rewrite totals from the
+    program-IR optimizer (analysis.optimizer) plus its program-version
+    cache counters — a reader sees which fusion/remat passes actually
+    fired on the programs this process serves and whether steady-state
+    dispatch is paying the pipeline or riding the cache."""
+    from ..analysis.optimizer import optimizer_stats
+    from ..flags import flag as _flag
+    from ..profiler import counters as _pc
+
+    c = _pc()
+    try:
+        passes = optimizer_stats()
+    except Exception:  # a broken stats table must not 500 /statz
+        passes = {}
+    return {
+        "level": _flag("ir_opt_level"),
+        "passes": passes,
+        "counters": {
+            "cache_hit": int(c.get("ir_opt::cache_hit", 0)),
+            "cache_miss": int(c.get("ir_opt::cache_miss", 0)),
+        },
+    }
+
+
 def _stats_readers():
     """One registry snapshot + the counter/quantile readers both statz
     endpoints share (a change to the quantile fields must not have to be
@@ -561,6 +586,8 @@ class InferenceServer:
             "slowest": _tracing.slowest_table(5, root_prefix="serving::"),
             # which pallas kernels run on autotuned geometry here
             "tuned_kernels": _tuned_kernels(),
+            # which IR-optimizer passes rewrote the served programs
+            "ir_opt": _ir_opt_stats(),
         }
         _, out["utilization"] = _utilization(self._t0, self._flops0, val)
         return out
@@ -1135,5 +1162,7 @@ class GenerationServer:
             "utilization": utilization,
             # which pallas kernels run on autotuned geometry here
             "tuned_kernels": _tuned_kernels(),
+            # which IR-optimizer passes rewrote the served programs
+            "ir_opt": _ir_opt_stats(),
         }
         return out

@@ -763,13 +763,36 @@ class Executor:
         # trace, or XLA lowering sees it. The verdict caches on the
         # Program per version (program.py Program.verify), so the steady
         # state pays one flag read + one dict lookup; failures also land
-        # in the flight recorder as `program_verify` events.
+        # in the flight recorder as `program_verify` events. The gate
+        # judges the program AS WRITTEN — the IR optimizer below runs
+        # after it, so strict-mode findings (e.g. dead code) reject
+        # before any rewrite could paper over them.
         verify_level = str(flag("program_verify")).strip().lower()
         if verify_level not in ("", "0", "off", "false", "no"):
             with RecordEvent("executor::program_verify"):
                 program.verify(
                     feed_names=feed_names, fetch_list=fetch_names,
                     level="strict" if verify_level == "strict" else "on")
+
+        # IR optimizer gate (FLAGS_ir_opt_level): rewrite the program onto
+        # the fused registry kernels (+ DCE, + remat at level 2) BEFORE the
+        # memplan gate and lowering, so admission and compilation see what
+        # will actually run. optimize_program clones (the caller's program
+        # is never mutated), caches per program version, and hands back
+        # the ORIGINAL object when nothing was rewritten — so the
+        # RunPlan/compile caches below key on a stable identity either way.
+        try:
+            ir_level = int(str(flag("ir_opt_level")).strip() or "0")
+        except ValueError:
+            ir_level = 0
+        if ir_level > 0:
+            from ..analysis import optimizer as _iropt
+
+            with RecordEvent("executor::ir_opt"):
+                program = _iropt.optimize_program(
+                    program, feed_names, fetch_names, level=ir_level,
+                    feed_shapes={n: _feed_shape(feed[n])
+                                 for n in feed_names}).program
 
         # Static peak-HBM admission (FLAGS_memory_budget_check): plan the
         # program's liveness footprint and compare it against the device

@@ -1,0 +1,343 @@
+"""Program-IR optimizer: pass manager, fusion, DCE, remat (ISSUE 16).
+
+Hand-built programs pin the rewrite rules exactly: the three fusion
+patterns land on their fused registry ops and stay numerically golden
+through ``Executor.run``; every documented refusal (fetched
+intermediate, second consumer, ``grad::`` reader) blocks fusion;
+training programs pass through byte-identical at level 1; level-2
+rematerialization converts a strict-budget rejection into an admit;
+and the version-keyed cache makes steady-state dispatch pay one dict
+lookup.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu.static as static
+from paddle_tpu import ops, profiler
+from paddle_tpu.analysis import (
+    MemoryBudgetError,
+    optimize_program,
+    optimizer_passes,
+    optimizer_stats,
+    plan_memory,
+)
+from paddle_tpu.analysis import optimizer as iropt
+from paddle_tpu.flags import set_flags
+
+MB = 1024 * 1024
+
+
+@pytest.fixture(autouse=True)
+def _static_reset():
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    iropt.reset_optimizer_stats()
+    yield
+    set_flags({"ir_opt_level": 1, "memory_budget_check": "warn",
+               "device_peaks": ""})
+    static.disable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+
+
+def _conv_bn_relu_net():
+    """conv2d -> batch_norm(is_test) -> relu + fc head, fusion-eligible."""
+    img = static.data("img", [2, 3, 8, 8], "float32")
+    h = static.nn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=False, name="c1")
+    h = ops.relu(static.nn.batch_norm(h, is_test=True))
+    out = static.nn.fc(h, 5, name="head")
+    rng = np.random.RandomState(0)
+    return {"img": rng.randn(2, 3, 8, 8).astype("float32")}, out
+
+
+def _ln_residual_net():
+    """fc -> add(residual) -> layer_norm, fusion-eligible."""
+    x = static.data("x", [4, 16], "float32")
+    ff = static.nn.fc(x, 16, activation="relu", bias_attr=False, name="ff")
+    h = static.nn.layer_norm(ops.add(ff, x))
+    out = ops.mean(h)
+    rng = np.random.RandomState(1)
+    return {"x": rng.randn(4, 16).astype("float32")}, out
+
+
+def _types(program):
+    return [op.type for op in program.global_block().ops]
+
+
+def _golden_vs_level(feeds, fetch, level=1):
+    """Run through the real Executor at level 0 then `level`; return
+    (golden, optimized) fetch arrays."""
+    exe = static.Executor()
+    exe.run_startup()
+    set_flags({"ir_opt_level": 0})
+    golden = np.asarray(exe.run(feed=feeds, fetch_list=[fetch])[0])
+    set_flags({"ir_opt_level": level})
+    got = np.asarray(exe.run(feed=feeds, fetch_list=[fetch])[0])
+    return golden, got
+
+
+# ---------------------------------------------------------------------------
+# fusion positives
+# ---------------------------------------------------------------------------
+
+
+def test_conv_bn_relu_fuses_and_is_golden():
+    feeds, out = _conv_bn_relu_net()
+    prog = static.default_main_program()
+    golden, got = _golden_vs_level(feeds, out)
+    assert np.array_equal(golden, got)
+    res = optimize_program(prog, sorted(feeds), [out.name], level=1)
+    assert res.changed
+    types = _types(res.program)
+    assert "fused_conv_bn_relu" in types
+    assert "conv2d" not in types and "batch_norm" not in types
+    # the original program is untouched
+    assert "conv2d" in _types(prog)
+
+
+def test_layernorm_residual_fuses_and_is_golden():
+    feeds, out = _ln_residual_net()
+    prog = static.default_main_program()
+    golden, got = _golden_vs_level(feeds, out)
+    assert np.array_equal(golden, got)
+    res = optimize_program(prog, sorted(feeds), [out.name], level=1)
+    assert res.changed
+    types = _types(res.program)
+    assert "fused_layernorm_residual" in types
+    assert "layer_norm" not in types and "elementwise_add" not in types
+
+
+def test_int8_matmul_contraction():
+    """The ptq residue (qdq'd activation, dequantize_static'd int8
+    weight, f32 matmul) contracts to one quantize + matmul_int8."""
+    x = static.data("x", [4, 8], "float32")
+    block = static.default_main_program().global_block()
+    rng = np.random.RandomState(2)
+    w = rng.randn(8, 6).astype("float32")
+    w_scale = float(np.max(np.abs(w)))
+    w8 = np.clip(np.round(w / w_scale * 127.0), -127, 127).astype("int8")
+    block.create_var(name="w@int8", shape=[8, 6], dtype="int8",
+                     persistable=True)
+    static.global_scope().set("w@int8", w8)
+    block.create_var(name="w@deq", shape=[8, 6], dtype="float32")
+    block.append_op("dequantize_static", {"X": ["w@int8"]},
+                    {"Out": ["w@deq"]},
+                    {"scale": w_scale, "bit_length": 8, "dtype": "float32"})
+    block.create_var(name="x@qdq", shape=[4, 8], dtype="float32")
+    block.append_op("quant_dequant_static", {"X": ["x"]}, {"Out": ["x@qdq"]},
+                    {"scale": 4.0, "bit_length": 8})
+    block.create_var(name="y", shape=[4, 6], dtype="float32")
+    block.append_op("matmul", {"X": ["x@qdq", "w@deq"]}, {"Out": ["y"]}, {})
+
+    feeds = {"x": rng.randn(4, 8).astype("float32")}
+    prog = static.default_main_program()
+    golden, got = _golden_vs_level(feeds, "y")
+    np.testing.assert_allclose(golden, got, rtol=1e-4, atol=1e-5)
+    res = optimize_program(prog, ["x"], ["y"], level=1)
+    types = _types(res.program)
+    assert "matmul_int8" in types and "quantize_static" in types
+    assert "matmul" not in types and "quant_dequant_static" not in types
+
+
+# ---------------------------------------------------------------------------
+# fusion refusals: the negative contracts
+# ---------------------------------------------------------------------------
+
+
+def test_fetched_intermediate_blocks_fusion():
+    """Fetching the batch_norm output keeps the chain unfused — the
+    caller must receive exactly the tensor it asked for."""
+    img = static.data("img", [2, 3, 8, 8], "float32")
+    h = static.nn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=False, name="c1")
+    bn = static.nn.batch_norm(h, is_test=True)
+    ops.relu(bn)
+    prog = static.default_main_program()
+    res = optimize_program(prog, ["img"], [bn.name], level=1)
+    assert "fused_conv_bn_relu" not in _types(res.program)
+    assert "conv2d" in _types(res.program)
+
+
+def test_second_consumer_blocks_fusion():
+    """A second reader of the bn output needs the unfused value."""
+    img = static.data("img", [2, 3, 8, 8], "float32")
+    h = static.nn.conv2d(img, num_filters=4, filter_size=3, padding=1,
+                         bias_attr=False, name="c1")
+    bn = static.nn.batch_norm(h, is_test=True)
+    r = ops.relu(bn)
+    other = ops.tanh(bn)  # second consumer of the intermediate
+    out = ops.mean(ops.add(r, other))
+    prog = static.default_main_program()
+    res = optimize_program(prog, ["img"], [out.name], level=1)
+    assert "fused_conv_bn_relu" not in _types(res.program)
+
+
+def test_grad_consumer_blocks_fusion_and_training_is_byte_identical():
+    """grad:: ops replay forward intermediates: fusing them away would
+    change the backward. A training program must come back unchanged —
+    same object, same bytes."""
+    x = static.data("x", [4, 16], "float32")
+    label = static.data("label", [4, 1], "int64")
+    ff = static.nn.fc(x, 16, activation="relu", name="ff")
+    h = static.nn.layer_norm(ops.add(ff, x))
+    logits = static.nn.fc(h, 10, name="head")
+    loss = ops.mean(ops.softmax_with_cross_entropy(logits, label))
+    static.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    prog = static.default_main_program()
+    assert any(op.type.startswith("grad::") for op in prog.global_block().ops)
+    before = prog.serialize_to_string()
+    res = optimize_program(prog, ["label", "x"], [loss.name], level=1)
+    assert not res.changed
+    assert res.program is prog
+    assert prog.serialize_to_string() == before
+
+
+def test_residual_shape_mismatch_blocks_ln_fusion():
+    """add with broadcast (unequal declared shapes) is not the residual
+    pattern the fused kernel implements."""
+    x = static.data("x", [4, 16], "float32")
+    b = static.nn.create_parameter([16], "float32")
+    h = static.nn.layer_norm(ops.add(x, b))  # bias add, not residual
+    out = ops.mean(h)
+    prog = static.default_main_program()
+    res = optimize_program(prog, ["x"], [out.name], level=1)
+    assert "fused_layernorm_residual" not in _types(res.program)
+
+
+# ---------------------------------------------------------------------------
+# DCE + pass manager mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_dead_op_elimination_drops_unfetched_chain():
+    x = static.data("x", [4, 8], "float32")
+    live = ops.relu(x)
+    dead = ops.exp(x)
+    ops.tanh(dead)  # dead chain: nothing fetches it
+    prog = static.default_main_program()
+    res = optimize_program(prog, ["x"], [live.name], level=1)
+    assert res.changed
+    types = _types(res.program)
+    assert "exp" not in types and "tanh" not in types
+    assert "relu" in types
+
+
+def test_unknown_pass_name_raises():
+    from paddle_tpu.errors import NotFoundError
+
+    with pytest.raises(NotFoundError):
+        iropt.PassManager(["not_a_pass"])
+
+
+def test_registered_pipeline_order():
+    names = optimizer_passes()
+    assert names.index("fuse_conv_bn_relu") < names.index(
+        "dead_op_elimination") < names.index("rematerialize")
+
+
+def test_level_zero_is_identity():
+    feeds, out = _conv_bn_relu_net()
+    prog = static.default_main_program()
+    res = optimize_program(prog, sorted(feeds), [out.name], level=0)
+    assert res.program is prog and not res.changed and res.stats == []
+
+
+def test_optimize_result_caches_per_version():
+    feeds, out = _ln_residual_net()
+    prog = static.default_main_program()
+    profiler.reset_counters()
+    r1 = optimize_program(prog, sorted(feeds), [out.name], level=1)
+    r2 = optimize_program(prog, sorted(feeds), [out.name], level=1)
+    assert r2.program is r1.program  # same optimized clone, no re-run
+    c = profiler.counters()
+    assert c.get("ir_opt::cache_miss", 0) == 1
+    assert c.get("ir_opt::cache_hit", 0) == 1
+    # a mutation bumps the version and invalidates the cached result
+    prog.global_block().create_var(name="extra", shape=[], dtype="float32")
+    prog.global_block().append_op("relu", {"X": [out.name]},
+                                  {"Out": ["extra"]}, {})
+    prog._version += 1
+    optimize_program(prog, sorted(feeds), [out.name], level=1)
+    assert profiler.counters().get("ir_opt::cache_miss", 0) == 2
+
+
+def test_per_pass_stats_shape():
+    feeds, out = _ln_residual_net()
+    prog = static.default_main_program()
+    optimize_program(prog, sorted(feeds), [out.name], level=1)
+    stats = optimizer_stats()
+    row = stats["fuse_layernorm_residual"]
+    assert set(row) == {"runs", "ops_rewritten", "bytes_saved", "wall_ms"}
+    assert row["ops_rewritten"] >= 1 and row["runs"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# rematerialization
+# ---------------------------------------------------------------------------
+
+
+def _holding_chain():
+    """Four 1MiB activations of a 1MiB feed held across a serial-sum
+    tail: planned peak 6MiB, floor ~3MiB once recomputed late."""
+    x = static.data("x", [64, 4096], "float32")
+    held = [ops.scale(x, scale=float(i + 1)) for i in range(4)]
+    acc = ops.relu(held[0])
+    for h in held[1:]:
+        acc = ops.add(acc, h)
+    out = ops.mean(acc)
+    feeds = {"x": np.random.RandomState(3).randn(64, 4096).astype("float32")}
+    return feeds, out
+
+
+def test_remat_converts_strict_rejection_into_admit():
+    feeds, out = _holding_chain()
+    budget = 4 * MB + 256 * 1024
+    set_flags({"device_peaks": f"hbm_bytes={budget}",
+               "memory_budget_check": "strict", "ir_opt_level": 1})
+    exe = static.Executor()
+    with pytest.raises(MemoryBudgetError):
+        exe.run(feed=feeds, fetch_list=[out])
+    set_flags({"ir_opt_level": 2})
+    admitted = np.asarray(exe.run(feed=feeds, fetch_list=[out])[0])
+    set_flags({"device_peaks": "", "memory_budget_check": "warn",
+               "ir_opt_level": 0})
+    golden = np.asarray(exe.run(feed=feeds, fetch_list=[out])[0])
+    assert np.array_equal(golden, admitted)
+
+
+def test_remat_peak_reduction_at_least_20pct():
+    feeds, out = _holding_chain()
+    prog = static.default_main_program()
+    shapes = {"x": (64, 4096)}
+    set_flags({"device_peaks": f"hbm_bytes={4 * MB + 256 * 1024}"})
+    res = optimize_program(prog, ["x"], [out.name], level=2,
+                           feed_shapes=shapes)
+    set_flags({"device_peaks": ""})
+    p0 = plan_memory(prog, ["x"], [out.name], feed_shapes=shapes).peak_bytes
+    p2 = plan_memory(res.program, ["x"], [out.name],
+                     feed_shapes=shapes).peak_bytes
+    assert (p0 - p2) / p0 >= 0.20
+    assert any(op.type == "scale" and "@remat" in op.outputs["Out"][0]
+               for op in res.program.global_block().ops)
+
+
+def test_remat_not_attempted_at_level_one():
+    feeds, out = _holding_chain()
+    prog = static.default_main_program()
+    set_flags({"device_peaks": f"hbm_bytes={4 * MB + 256 * 1024}"})
+    res = optimize_program(prog, ["x"], [out.name], level=1,
+                           feed_shapes={"x": (64, 4096)})
+    set_flags({"device_peaks": ""})
+    assert not res.changed
+    assert res.program is prog
+
+
+def test_remat_noop_without_budget():
+    feeds, out = _holding_chain()
+    prog = static.default_main_program()
+    res = optimize_program(prog, ["x"], [out.name], level=2,
+                           feed_shapes={"x": (64, 4096)})
+    assert all(s.ops_rewritten == 0 for s in res.stats
+               if s.name == "rematerialize")

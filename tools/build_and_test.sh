@@ -39,6 +39,11 @@
 #             schedules, offline search with pre-compile pruning, the
 #             JSON cache round-tripping into a fresh process with zero
 #             re-search, corrupt cache degrading to defaults)
+#           + ir-opt smoke (program-IR optimizer: fused-op counts > 0
+#             on BERT/ResNet/GPT smoke programs with numeric goldens,
+#             training-program byte-identity at level 1, and remat
+#             converting a strict-mode rejection into an admit with
+#             >= 20% planned-peak reduction)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -152,6 +157,13 @@ case "$MODE" in
     # round-tripping across a fresh process with zero re-search, and a
     # truncated cache degrading to defaults (one cache_reject, no crash)
     JAX_PLATFORMS=cpu python tools/autotune_smoke.py
+    # ir-opt smoke: program-IR optimizer — conv+bn+relu / residual+ln /
+    # int8-matmul fusions firing on BERT/ResNet/GPT inference smokes
+    # with numeric goldens vs the unrewritten programs, a training
+    # program (grad:: ops) passing through byte-identical at level 1,
+    # and level-2 rematerialization turning a strict-budget rejection
+    # into an admit at >= 20% planned-peak reduction
+    JAX_PLATFORMS=cpu python tools/ir_opt_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

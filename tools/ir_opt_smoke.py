@@ -1,0 +1,258 @@
+#!/usr/bin/env python
+"""IR-optimizer smoke (ISSUE 16): the program-IR optimizer, certified.
+
+Optimizes BERT-, ResNet-, and GPT-shaped static inference programs and
+checks, end to end through ``Executor.run``:
+
+1. **Fusion fires** — at ``FLAGS_ir_opt_level=1`` every smoke program
+   contains at least one fused registry op after optimization
+   (``fused_conv_bn_relu`` on ResNet, ``fused_layernorm_residual`` on
+   BERT/GPT, ``matmul_int8`` on the GPT int8 head) and fewer ops than
+   it started with;
+2. **Numeric goldens** — the optimized programs produce the same
+   fetches as the unoptimized ones (bit-exact for the f32 fusions,
+   tight allclose for the int8 contraction whose accumulation order
+   legitimately differs);
+3. **Training byte-identity** — a training program (``grad::`` ops
+   present) is returned UNCHANGED at level 1: same object, same bytes;
+4. **Rematerialization admits** — a deliberately over-budget program
+   that ``FLAGS_memory_budget_check=strict`` rejects at level 1 is
+   admitted at level 2, with the planned peak reduced by >= 20%.
+
+Run: ``make ir-opt-smoke`` (wired into ``tools/build_and_test.sh check``).
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+MB = 1024 * 1024
+
+
+def _check(name, ok, detail=""):
+    status = "ok" if ok else "FAIL"
+    print(f"[ir-opt-smoke] {name}: {status} {detail}")
+    if not ok:
+        raise SystemExit(f"ir-opt smoke failed: {name} {detail}")
+
+
+def build_bert():
+    """BERT-shaped inference: embedding + residual-add->layer_norm
+    encoder blocks + MLM head."""
+    import paddle_tpu.static as static
+    from paddle_tpu import ops
+
+    B, S, E, V = 8, 16, 32, 128
+    ids = static.data("ids", [B, S], "int64")
+    table = static.nn.create_parameter([V, E], "float32")
+    h = ops.reshape(ops.embedding(ids, table), [B * S, E])
+    for i in range(2):
+        ff = static.nn.fc(h, E, activation="relu", name=f"enc{i}")
+        h = static.nn.layer_norm(ops.add(ff, h))
+    logits = static.nn.fc(h, V, name="mlm")
+    rng = np.random.RandomState(0)
+    feeds = {"ids": rng.randint(0, V, (B, S)).astype("int64")}
+    return feeds, logits
+
+
+def build_resnet():
+    """ResNet-shaped inference: two conv->bn->relu stages + fc head."""
+    import paddle_tpu.static as static
+    from paddle_tpu import ops
+
+    B = 4
+    img = static.data("img", [B, 3, 16, 16], "float32")
+    h = static.nn.conv2d(img, num_filters=8, filter_size=3, padding=1,
+                         bias_attr=False, name="c1")
+    h = ops.relu(static.nn.batch_norm(h, is_test=True))
+    h = static.nn.conv2d(h, num_filters=16, filter_size=3, padding=1,
+                         bias_attr=False, name="c2")
+    h = ops.relu(static.nn.batch_norm(h, is_test=True))
+    h = ops.max_pool2d(h, 2, stride=2)
+    logits = static.nn.fc(h, 10, name="head")
+    rng = np.random.RandomState(1)
+    feeds = {"img": rng.randn(B, 3, 16, 16).astype("float32")}
+    return feeds, logits
+
+
+def build_gpt():
+    """GPT-shaped inference: fc decoder stack with residual layernorms
+    plus an int8 LM head in the ``ptq.rewrite_int8_program`` residue
+    form (qdq'd activation, ``dequantize_static``'d int8 weight)."""
+    import paddle_tpu.static as static
+    from paddle_tpu import ops
+
+    B, S, E, V = 4, 16, 32, 128
+    ids = static.data("ids", [B, S], "int64")
+    table = static.nn.create_parameter([V, E], "float32")
+    h = ops.reshape(ops.embedding(ids, table), [B * S, E])
+    for i in range(2):
+        ff = static.nn.fc(h, E, activation="relu", name=f"blk{i}")
+        h = static.nn.layer_norm(ops.add(ff, h))
+
+    # int8 LM head, hand-lowered to the deploy-time residue the slim
+    # pipeline leaves for ops without a direct int8 path: the weight
+    # ships as a scope-resident int8 array restored by a load-time
+    # dequantize_static, the activation keeps its fake-quant sim op
+    block = static.default_main_program().global_block()
+    rng = np.random.RandomState(2)
+    w = rng.randn(E, V).astype("float32")
+    w_scale = float(np.max(np.abs(w)))
+    w_int8 = np.clip(np.round(w / w_scale * 127.0), -127, 127).astype("int8")
+    act_scale = 8.0  # covers the layernormed activations comfortably
+    block.create_var(name="head_w@int8", shape=[E, V], dtype="int8",
+                     persistable=True)
+    static.global_scope().set("head_w@int8", w_int8)
+    block.create_var(name="head_w@deq", shape=[E, V], dtype="float32")
+    block.append_op("dequantize_static", {"X": ["head_w@int8"]},
+                    {"Out": ["head_w@deq"]},
+                    {"scale": w_scale, "bit_length": 8, "dtype": "float32"})
+    block.create_var(name=f"{h.name}@qdq", shape=[B * S, E], dtype="float32")
+    block.append_op("quant_dequant_static", {"X": [h.name]},
+                    {"Out": [f"{h.name}@qdq"]},
+                    {"scale": act_scale, "bit_length": 8})
+    block.create_var(name="gpt_logits", shape=[B * S, V], dtype="float32")
+    block.append_op("matmul", {"X": [f"{h.name}@qdq", "head_w@deq"]},
+                    {"Out": ["gpt_logits"]}, {})
+    feeds = {"ids": rng.randint(0, V, (B, S)).astype("int64")}
+    return feeds, "gpt_logits"
+
+
+_EXPECT_FUSED = {
+    "bert": ("fused_layernorm_residual",),
+    "resnet": ("fused_conv_bn_relu",),
+    "gpt": ("fused_layernorm_residual", "matmul_int8"),
+}
+
+# the int8 contraction accumulates in int32 and dequantizes once, so it
+# is not bit-identical to the f32 matmul of the dequantized grid
+_TOL = {"bert": 0.0, "resnet": 0.0, "gpt": 1e-4}
+
+
+def _run_smoke(name, build):
+    import paddle_tpu.static as static
+    from paddle_tpu.analysis import optimizer as iropt
+    from paddle_tpu.flags import set_flags
+
+    static.global_scope().clear()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        feeds, fetch = build()
+    exe = static.Executor()
+    exe.run_startup(startup)
+
+    set_flags({"ir_opt_level": 0})
+    golden = np.asarray(exe.run(main, feed=feeds, fetch_list=[fetch])[0])
+    set_flags({"ir_opt_level": 1})
+    got = np.asarray(exe.run(main, feed=feeds, fetch_list=[fetch])[0])
+
+    fetch_name = fetch if isinstance(fetch, str) else fetch.name
+    res = iropt.optimize_program(
+        main, sorted(feeds), [fetch_name], level=1,
+        feed_shapes={k: np.shape(v) for k, v in feeds.items()})
+    before = len(main.global_block().ops)
+    after_ops = [op.type for op in res.program.global_block().ops]
+    counts = {t: after_ops.count(t) for t in _EXPECT_FUSED[name]}
+    _check(f"{name} fusion fires", res.changed and all(
+        c > 0 for c in counts.values()),
+        f"(ops {before}->{len(after_ops)}, fused {counts})")
+
+    tol = _TOL[name]
+    diff = float(np.max(np.abs(golden - got)))
+    denom = float(np.max(np.abs(golden))) or 1.0
+    ok = diff == 0.0 if tol == 0.0 else diff / denom <= tol
+    _check(f"{name} numerically golden", ok,
+           f"(max abs diff {diff:.3g}, tol {tol})")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu.static as static
+    from paddle_tpu import ops
+    from paddle_tpu.analysis import MemoryBudgetError, plan_memory
+    from paddle_tpu.analysis import optimizer as iropt
+    from paddle_tpu.flags import set_flags
+
+    static.enable_static()
+
+    # 1+2) fusion fires and stays numerically golden on all three
+    for name, build in (("bert", build_bert), ("resnet", build_resnet),
+                        ("gpt", build_gpt)):
+        _run_smoke(name, build)
+
+    # 3) a training program is byte-identical at level 1
+    static.global_scope().clear()
+    main_p, startup = static.Program(), static.Program()
+    with static.program_guard(main_p, startup):
+        feeds, logits = build_bert()
+        label = static.data("label", [8 * 16, 1], "int64")
+        loss = ops.mean(ops.softmax_with_cross_entropy(logits, label))
+        static.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    before = main_p.serialize_to_string()
+    res = iropt.optimize_program(main_p, sorted(feeds) + ["label"],
+                                 [loss.name], level=1)
+    _check("training program byte-identical at level 1",
+           (not res.changed) and res.program is main_p
+           and main_p.serialize_to_string() == before,
+           f"({sum(op.type.startswith('grad::') for op in main_p.global_block().ops)} grad ops kept)")
+
+    # 4) remat: strict-rejected at level 1, admitted at level 2
+    static.global_scope().clear()
+    remat_p = static.Program()
+    with static.program_guard(remat_p, static.Program()):
+        x = static.data("x", [64, 4096], "float32")  # 1 MiB
+        held = [ops.scale(x, scale=float(i + 1)) for i in range(4)]
+        acc = ops.relu(held[0])
+        for h in held[1:]:
+            acc = ops.add(acc, h)
+        out = ops.mean(acc)
+    feeds = {"x": np.random.RandomState(3).randn(64, 4096).astype("float32")}
+    budget = 4 * MB + 256 * 1024
+    set_flags({"device_peaks": f"hbm_bytes={budget}",
+               "memory_budget_check": "strict", "ir_opt_level": 1})
+    exe = static.Executor()
+    try:
+        exe.run(remat_p, feed=feeds, fetch_list=[out])
+        _check("strict rejects over-budget program at level 1", False)
+    except MemoryBudgetError as e:
+        _check("strict rejects over-budget program at level 1", True,
+               f"(peak {e.peak_bytes / MB:.1f}MiB > {budget / MB:.2f}MiB)")
+    set_flags({"ir_opt_level": 2})
+    admitted = np.asarray(exe.run(remat_p, feed=feeds, fetch_list=[out])[0])
+    set_flags({"device_peaks": "", "memory_budget_check": "warn",
+               "ir_opt_level": 0})
+    golden = np.asarray(exe.run(remat_p, feed=feeds, fetch_list=[out])[0])
+    _check("remat admits under strict budget",
+           float(np.max(np.abs(golden - admitted))) == 0.0,
+           f"(result {float(admitted):.6f}, bit-exact)")
+
+    set_flags({"device_peaks": f"hbm_bytes={budget}"})
+    shapes = {"x": (64, 4096)}
+    res = iropt.optimize_program(remat_p, ["x"], [out.name], level=2,
+                                 feed_shapes=shapes)
+    p0 = plan_memory(remat_p, ["x"], [out.name], feed_shapes=shapes).peak_bytes
+    p2 = plan_memory(res.program, ["x"], [out.name],
+                     feed_shapes=shapes).peak_bytes
+    set_flags({"device_peaks": ""})
+    _check("remat peak reduction >= 20%", (p0 - p2) / p0 >= 0.20,
+           f"({p0 / MB:.1f}MiB -> {p2 / MB:.1f}MiB, "
+           f"-{100 * (p0 - p2) / p0:.0f}%)")
+
+    stats = iropt.optimizer_stats()
+    _check("per-pass stats recorded",
+           all(stats.get(p, {}).get("ops_rewritten", 0) > 0
+               for p in ("fuse_conv_bn_relu", "fuse_layernorm_residual",
+                         "fuse_int8_matmul", "rematerialize")),
+           f"({ {k: v['ops_rewritten'] for k, v in stats.items()} })")
+
+    print("[ir-opt-smoke] PASS")
+
+
+if __name__ == "__main__":
+    main()
