@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke autotune-smoke ir-opt-smoke
+.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke autotune-smoke ir-opt-smoke slo-smoke
 
 lint:  # graphlint gate: pure-AST framework lint, waivers must justify every exception
 	python tools/graphlint.py --check
@@ -82,6 +82,9 @@ autotune-smoke:  # kernel autotuner: parity under tuned schedules, search + cach
 
 ir-opt-smoke:  # program-IR optimizer: fusion counts, numeric goldens, training byte-identity, remat strict admit
 	JAX_PLATFORMS=cpu python tools/ir_opt_smoke.py
+
+slo-smoke:  # fleet SLO plane: wedged backend pages via burn rate, /fleetz == pooled golden, scaler sees burn
+	JAX_PLATFORMS=cpu python tools/slo_smoke.py
 
 check:
 	python tools/graphlint.py --check

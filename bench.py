@@ -579,6 +579,133 @@ def bench_tracing_overhead(requests=160, iters_direct=4000):
     }
 
 
+def bench_observability_overhead(requests=160, iters_direct=20000,
+                                 backends=8):
+    """Labeled metric families + /fleetz merge cost (target < 2%).
+
+    The SLO plane adds two prices. (1) The hot serving path now observes
+    into LABELED histogram children (child lookup under the family lock
+    plus parent propagation) where it used to observe a bare histogram —
+    certified with the tracing row's discipline: the tight-loop
+    per-observe delta (labeled minus bare, best-of-3) scaled by the
+    labeled observes one served predict request records (queue-wait +
+    e2e = 2), over the measured per-request period of a live
+    batcher+replica loop. (2) The router's fleet merge — per-backend
+    ``registry_snapshot()`` serialization plus the label-aware
+    elementwise bucket merge across the fleet — measured directly and
+    reported per scrape; it runs on the PROBER thread, so it is reported
+    against the probe period, not the request period.
+    """
+    import tempfile
+    import time as _time
+
+    import paddle_tpu.static as static
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.monitor import (histogram, merge_histogram_snapshots,
+                                    registry_snapshot)
+    from paddle_tpu.serving import DynamicBatcher, ReplicaPool
+
+    # serving-shaped bucket ladder; distinct names so the registry's
+    # real serving families stay untouched
+    ladder = (1.0, 2.0, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0,
+              1000.0)
+    h_bare = histogram("bench/obs_bare_ms", buckets=ladder)
+    h_lab = histogram("bench/obs_labeled_ms", buckets=ladder)
+
+    def _bare_us(n=iters_direct):
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            h_bare.observe(7.0)
+        return (_time.perf_counter() - t0) / n * 1e6
+
+    def _labeled_us(n=iters_direct):
+        # the batcher resolves labels() per observe (tenant varies per
+        # request), so the lookup is part of the certified price
+        t0 = _time.perf_counter()
+        for _ in range(n):
+            h_lab.labels(kind="predict", bucket="4",
+                         tenant="default").observe(7.0)
+        return (_time.perf_counter() - t0) / n * 1e6
+
+    bare_us = min(_bare_us() for _ in range(3))
+    labeled_us = min(_labeled_us() for _ in range(3))
+    per_observe_delta_us = max(0.0, labeled_us - bare_us)
+
+    # live request period: same mini-model loop the tracing row uses
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        x = static.data("x", [None, 32], "float32")
+        y = static.nn.fc(static.nn.fc(x, 64, name="ob_fc1"), 8,
+                         name="ob_fc2")
+        exe = static.Executor()
+        exe.run_startup()
+        model_dir = tempfile.mkdtemp(prefix="ptpu_bench_obs_")
+        static.save_inference_model(model_dir, ["x"], [y], exe)
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+    pred = create_predictor(Config(model_dir))
+    batcher = DynamicBatcher(["x"], buckets=(1, 2, 4),
+                             queue_capacity=64, batch_timeout_ms=0.5)
+    pool = ReplicaPool(pred, batcher, replicas=2)
+    pool.warmup()
+    pool.start()
+    rng = np.random.RandomState(0)
+    feeds = [rng.randn((i % 3) + 1, 32).astype("float32")
+             for i in range(requests)]
+    try:
+        t0 = _time.perf_counter()
+        for a in feeds:
+            batcher.predict({"x": a}, timeout=30)
+        period_us = (_time.perf_counter() - t0) / len(feeds) * 1e6
+    finally:
+        pool.stop(drain=False)
+    observes_per_request = 2  # predict path: queue-wait + e2e
+    overhead = per_observe_delta_us * observes_per_request / period_us
+
+    # fleet merge: one backend snapshot serialization + the label-aware
+    # merge across the fleet's serving histograms (prober-thread work)
+    for v in (3.0, 30.0, 300.0):
+        for t in ("a", "b", "c"):
+            h_lab.labels(kind="predict", bucket="4", tenant=t).observe(v)
+    t0 = _time.perf_counter()
+    snap_reps = 20
+    for _ in range(snap_reps):
+        snap = registry_snapshot()
+    snapshot_us = (_time.perf_counter() - t0) / snap_reps * 1e6
+    hist_snaps = {name: s for name, s in snap.items()
+                  if isinstance(s, dict) and s.get("kind") == "histogram"}
+    fleet = [hist_snaps] * backends
+    t0 = _time.perf_counter()
+    merge_reps = 20
+    for _ in range(merge_reps):
+        for name in hist_snaps:
+            merge_histogram_snapshots([b[name] for b in fleet],
+                                      name=name)
+    merge_us = (_time.perf_counter() - t0) / merge_reps * 1e6
+    return {
+        "metric": "observability_overhead",
+        "value": round(overhead * 100, 3),
+        "unit": "percent",
+        "target_pct": 2.0,
+        "within_target": bool(overhead < 0.02),
+        "per_observe_us": {"labeled": round(labeled_us, 3),
+                           "bare": round(bare_us, 3),
+                           "delta": round(per_observe_delta_us, 3)},
+        "observes_per_request": observes_per_request,
+        "request_period_us": round(period_us, 1),
+        "fleet_merge": {
+            "backends": backends,
+            "histograms": len(hist_snaps),
+            "snapshot_us": round(snapshot_us, 1),
+            "merge_us": round(merge_us, 1),
+            "per_scrape_us": round(snapshot_us + merge_us, 1),
+        },
+    }
+
+
 def bench_serving_throughput(requests=120, rows_cycle=(1, 2, 3, 4),
                              levels=(1, 4, 16)):
     """Online-serving throughput: the dynamic batcher + replica pool vs
@@ -2002,6 +2129,8 @@ def main():
     result["flight_recorder_overhead"] = bench_flight_recorder_overhead()
     # per-request trace spans + tail-sampled store, on vs off (target < 2%)
     result["tracing_overhead"] = bench_tracing_overhead()
+    # labeled-family observes on the hot path + /fleetz merge (target < 2%)
+    result["observability_overhead"] = bench_observability_overhead()
     # online serving: batcher+replicas vs sequential single-request calls
     result["serving_throughput"] = bench_serving_throughput()
     # generative decoding: continuous vs static batching, mixed lengths,

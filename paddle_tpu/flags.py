@@ -651,6 +651,46 @@ define_flag("kernel_autotune", "cached",
             "pallas kernel schedule policy: off | cached | search "
             "(search tunes misses in the background, offline-style)")
 
+# monitor/registry.py — hard per-family cardinality bound for labeled
+# metric children (``metric.labels(**dims)``). Once a family holds this
+# many distinct label sets, every NEW set collapses into one shared
+# series whose label values are all "other" (plus a single
+# metric_series_overflow flight event), so an unbounded dimension (a
+# hostile tenant header) can never grow registry memory without limit.
+# Read at labels() time, so set_flags applies to live families.
+define_flag("metrics_max_series", 64,
+            "max distinct label sets per metric family before new sets "
+            "collapse into the shared 'other' overflow series")
+
+# monitor/slo.py — declarative serving objectives installed by every
+# fleet entrypoint (serving/backend.py, serving/router.py) via
+# install_from_flags(). ';'-separated entries, '|'-separated fields:
+#   name|selector|threshold_ms=250|target=0.99|window_s=3600
+#   name|bad_selector|error_ratio=<total_selector>|target=0.999
+# selector grammar: metric or metric{k=v,k2=v2} (labels subset-match
+# the family's labeled series). Empty (default): no objectives.
+define_flag("slo_objectives", "",
+            "SLO definitions 'name|selector|k=v|...' joined by ';' "
+            "(fields: threshold_ms | error_ratio, target, window_s, "
+            "alert_burn); empty disables")
+
+# monitor/slo.py SLOEngine — period of the background good/total
+# sampler the burn-rate windows are computed over. Shorter intervals
+# sharpen the fast (5m-style) window at the cost of more registry
+# snapshots; the engine keeps at most one slow window of samples.
+define_flag("slo_sample_interval_s", 10.0,
+            "seconds between SLO engine good/total samples of the "
+            "metric registry")
+
+# monitor/slo.py + serving/scaler.py — burn-rate alert threshold (the
+# Google-SRE multi-window convention: 14.4x burn consumes a 30-day
+# budget in ~2 days). An SLO alerts when BOTH its fast and slow
+# windows burn at/above this; the autoscaler treats the same
+# double-window-confirmed burn as scale-up pressure.
+define_flag("slo_burn_alert", 14.4,
+            "error-budget burn-rate multiple at which an SLO alerts "
+            "(both windows) and the autoscaler sees up-pressure")
+
 # models/resnet.py + nn/layers.py fused_conv_bn_relu + ops/pallas/
 # conv_bn_relu.py — fuse the vision path's conv -> batch_norm -> relu
 # triple into pallas kernels on TPU: the conv contraction runs as a

@@ -33,6 +33,11 @@ Three layers:
   generation, and a tail-sampled trace store (always keep error/
   deadline/retried traces plus the slowest-K per window) served on
   ``/tracez``.
+- :mod:`monitor.slo` — error-budget objectives: declarative
+  :class:`SLO` definitions over (label-aware) metric selectors,
+  multi-window burn-rate evaluation (fast 5m / slow 1h), ``/sloz``
+  payloads, ``slo_burn`` flight events at alert transitions, and the
+  confirmed-burn signal the autoscaler consumes.
 - :mod:`monitor.flight_recorder` — fault diagnosis: ring-buffer flight
   recorder (executor runs, collectives with per-group sequence numbers
   and fingerprints, PS RPCs, dataloader lifecycle, flag changes, XLA
@@ -59,6 +64,7 @@ from .registry import (  # noqa: F401
     all_metrics,
     collect_hbm_gauges,
     counter,
+    format_labels,
     gauge,
     hbm_watermark_bytes,
     histogram,
@@ -103,6 +109,16 @@ from .tracing import (  # noqa: F401
 )
 from . import cluster  # noqa: F401
 from . import flight_recorder  # noqa: F401
+# slo.install_from_flags stays module-qualified: the package-level name
+# belongs to flight_recorder's (PR 9)
+from . import slo  # noqa: F401
+from .slo import (  # noqa: F401
+    SLO,
+    SLOEngine,
+    current_burn,
+    install_slo,
+    sloz_payload,
+)
 from . import debug_server  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
@@ -121,7 +137,7 @@ __all__ = [
     "counter", "gauge", "histogram",
     "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
     "registry_snapshot", "reset_registry", "all_metrics",
-    "histogram_quantile", "merge_histogram_snapshots",
+    "histogram_quantile", "merge_histogram_snapshots", "format_labels",
     "collect_hbm_gauges", "hbm_watermark_bytes", "install_jax_listeners",
     "export_prometheus", "prometheus_text", "export_merged_chrome_trace",
     "PROMETHEUS_CONTENT_TYPE",
@@ -132,6 +148,8 @@ __all__ = [
     "current_context", "current_span", "format_traceparent",
     "parse_traceparent", "start_span", "start_trace",
     "flight_recorder", "debug_server",
+    "slo", "SLO", "SLOEngine", "install_slo", "sloz_payload",
+    "current_burn",
     "FlightRecorder", "HangWatchdog", "dump_now", "install_from_flags",
     "DebugServer", "start_debug_server", "stop_debug_server",
 ]

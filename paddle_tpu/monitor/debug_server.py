@@ -20,6 +20,10 @@ ports; serving-scale TPU jobs (Gemma-on-Cloud-TPU ops runbooks) expect a
 - ``/tracez``        — the tail-sampled trace store (monitor.tracing):
   retained-trace list, one span tree by ``?id=``, chrome-trace view via
   ``?id=...&format=chrome``.
+- ``/metricz``       — alias of ``/metrics`` matching the serving
+  servers' scrape route (one target path fleet-wide).
+- ``/sloz``          — error-budget burn per installed SLO
+  (monitor.slo): fast/slow window burn rates, alert state.
 
 Loopback-bound on purpose: the debug surface exposes run internals, so
 reaching it from outside the host goes through whatever port-forwarding
@@ -91,6 +95,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _routes(self):
         from . import cluster as _cluster
         from . import cost_model as _cost
+        from . import slo as _slo
         from .export import PROMETHEUS_CONTENT_TYPE, prometheus_text
 
         return {
@@ -98,6 +103,12 @@ class _Handler(BaseHTTPRequestHandler):
                 json.dumps(healthz(), indent=1), "application/json"),
             "/metrics": lambda: (
                 prometheus_text(), PROMETHEUS_CONTENT_TYPE),
+            # scrape-target alias matching the serving servers' route
+            "/metricz": lambda: (
+                prometheus_text(), PROMETHEUS_CONTENT_TYPE),
+            "/sloz": lambda: (
+                json.dumps(_slo.sloz_payload(), indent=1, default=str),
+                "application/json"),
             "/flightrecorder": lambda: (
                 json.dumps(_flight.get_recorder().snapshot(reason="debugz"),
                            indent=1, default=str), "application/json"),

@@ -71,10 +71,30 @@ def _fmt(v) -> str:
     return str(int(v))
 
 
+def _histogram_lines(lines, pname, snap, sel=""):
+    """Emit one histogram series (bucket/sum/count). ``sel`` is a
+    pre-escaped selector body (``k="v",...`` from format_labels) for a
+    labeled child, empty for the bare family."""
+    pre = f"{sel}," if sel else ""
+    acc = 0
+    for le, c in zip(snap["bounds"] + ["+Inf"], snap["buckets"]):
+        acc += c
+        le_s = le if isinstance(le, str) else repr(float(le))
+        lines.append(f'{pname}_bucket{{{pre}le="{le_s}"}} {acc}')
+    suffix = f"{{{sel}}}" if sel else ""
+    lines.append(f"{pname}_sum{suffix} {_fmt(snap['sum'])}")
+    lines.append(f"{pname}_count{suffix} {snap['count']}")
+
+
 def prometheus_text() -> str:
     """Render the registry + profiler counters in the Prometheus text
     exposition format (one # TYPE line per family, # HELP when the
     metric carries help text).
+
+    Labeled families emit every child series with its label selector
+    AND the bare parent series; for counters/histograms the parent is
+    the exact aggregate over labels (child updates propagate up in the
+    registry), so scrapers that ignore labels keep reading totals.
 
     Name-collision safety: ``_prom_name`` is lossy ('/' and ':' both
     become '_'), so two distinct registry names can sanitize to the same
@@ -108,16 +128,14 @@ def prometheus_text() -> str:
             lines.append(f"# HELP {pname} {_escape_help(m.help)}")
         if snap["kind"] == "histogram":
             lines.append(f"# TYPE {pname} histogram")
-            acc = 0
-            for le, c in zip(snap["bounds"] + ["+Inf"], snap["buckets"]):
-                acc += c
-                le_s = le if isinstance(le, str) else repr(float(le))
-                lines.append(f'{pname}_bucket{{le="{le_s}"}} {acc}')
-            lines.append(f"{pname}_sum {_fmt(snap['sum'])}")
-            lines.append(f"{pname}_count {snap['count']}")
+            _histogram_lines(lines, pname, snap)
+            for sel, sub in (snap.get("series") or {}).items():
+                _histogram_lines(lines, pname, sub, sel)
         else:
             lines.append(f"# TYPE {pname} {snap['kind']}")
             lines.append(f"{pname} {_fmt(snap['value'])}")
+            for sel, sub in (snap.get("series") or {}).items():
+                lines.append(f"{pname}{{{sel}}} {_fmt(sub['value'])}")
     # the profiler's always-on dispatch counters live outside the
     # registry (PR 1 predates it); export them under the same roof —
     # collisions with registry names are just as fatal for the scraper

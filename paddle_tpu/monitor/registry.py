@@ -25,6 +25,7 @@ __all__ = [
     "STAT_INT", "STAT_FLOAT", "stat_add", "stat_reset",
     "registry_snapshot", "reset_registry", "all_metrics",
     "histogram_quantile", "merge_histogram_snapshots",
+    "format_labels",
     "collect_hbm_gauges", "hbm_watermark_bytes",
     "install_jax_listeners",
 ]
@@ -36,6 +37,40 @@ _metrics: dict[str, "_Metric"] = {}
 DEFAULT_BUCKETS = (0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
                    1000.0, 5000.0, 30000.0)
 
+# label value every dimension collapses to once a family hits
+# FLAGS_metrics_max_series — one shared series absorbs the overflow so
+# a hostile/unbounded dimension can never grow memory past the bound
+OVERFLOW_LABEL_VALUE = "other"
+
+
+def _escape_label_value(v) -> str:
+    """Escape a label VALUE per the prometheus exposition format:
+    backslash, double-quote and newline are the three characters with
+    wire meaning inside a quoted label value."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def format_labels(labels) -> str:
+    """Canonical selector body for one label set — sorted keys, escaped
+    values: ``k="v",k2="v2"``. This exact string keys the ``series``
+    dict in snapshots and is what :func:`prometheus_text` emits inside
+    ``{}``, so snapshot consumers and scrapers agree on series identity.
+    Accepts a dict or an iterable of (key, value) pairs."""
+    items = sorted(labels.items() if isinstance(labels, dict) else labels)
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items)
+
+
+def _max_series() -> int:
+    # lazy flag read: the registry is imported before flags in some
+    # entrypoints, and set_flags must apply to live families
+    try:
+        from ..flags import flag
+
+        return int(flag("metrics_max_series"))
+    except Exception:
+        return 64
+
 
 class _Metric:
     kind = "untyped"
@@ -44,6 +79,94 @@ class _Metric:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
+        # labeled child series (prometheus label semantics), keyed by
+        # the sorted ((key, value), ...) tuple. For counters and
+        # histograms every child update propagates into the parent, so
+        # the bare family stays the exact aggregate over its labels and
+        # label-free readers (/statz, /histz merges) see totals.
+        self._children: dict = {}
+        self._label_keys = None  # fixed by the first labels() call
+        self._labels = ()        # ((k, v), ...) — set on children only
+        self._parent = None
+        self._overflowed = False
+
+    def _new_child(self):
+        return type(self)(self.name, help=self.help)
+
+    def labels(self, **dims):
+        """Child metric for one label set (``labels(kind="predict",
+        tenant="a")``), get-or-create. The family's label KEYS are
+        fixed by the first call; a later call with different keys
+        raises — mixed key sets would make series identity ambiguous.
+
+        Cardinality is hard-bounded by ``FLAGS_metrics_max_series``:
+        once the family holds that many distinct label sets, every NEW
+        set collapses into one shared series whose label values are all
+        ``"other"`` (recording a single ``metric_series_overflow``
+        flight event), so an unbounded dimension — a hostile tenant
+        header — costs one series, never unbounded memory."""
+        if self._parent is not None:
+            raise ValueError(
+                f"metric {self.name!r}: labels() called on a labeled "
+                "child; call it on the family root")
+        if not dims:
+            raise ValueError(
+                f"metric {self.name!r}: labels() needs at least one "
+                "label")
+        keys = tuple(sorted(dims))
+        key = tuple((k, str(dims[k])) for k in keys)
+        first_overflow = False
+        with self._lock:
+            if self._label_keys is None:
+                self._label_keys = keys
+            elif keys != self._label_keys:
+                raise ValueError(
+                    f"metric {self.name!r} labeled with keys "
+                    f"{list(self._label_keys)}, got {list(keys)}; a "
+                    "family's label keys are fixed by its first use")
+            child = self._children.get(key)
+            if child is None and len(self._children) >= _max_series():
+                key = tuple((k, OVERFLOW_LABEL_VALUE) for k in keys)
+                child = self._children.get(key)
+                first_overflow = not self._overflowed
+                self._overflowed = True
+            if child is None:
+                child = self._new_child()
+                child._parent = self
+                child._labels = key
+                self._children[key] = child
+        if first_overflow:
+            try:
+                from . import flight_recorder as _flight
+
+                _flight.record_event(
+                    "metric_series_overflow", metric=self.name,
+                    max_series=_max_series())
+            except Exception:
+                pass
+        return child
+
+    def series(self) -> dict:
+        """Live labeled children by selector body (``k="v",...``)."""
+        with self._lock:
+            children = list(self._children.values())
+        return {format_labels(c._labels): c for c in children}
+
+    def _series_snapshots(self) -> dict:
+        with self._lock:
+            children = list(self._children.values())
+        out = {}
+        for c in children:
+            s = c.snapshot()
+            s["labels"] = dict(c._labels)
+            out[format_labels(c._labels)] = s
+        return out
+
+    def _reset_children(self):
+        with self._lock:
+            children = list(self._children.values())
+        for c in children:
+            c._reset()
 
 
 class Counter(_Metric):
@@ -58,6 +181,8 @@ class Counter(_Metric):
     def inc(self, n=1):
         with self._lock:
             self._value += n
+        if self._parent is not None:
+            self._parent.inc(n)
 
     @property
     def value(self):
@@ -65,15 +190,24 @@ class Counter(_Metric):
             return self._value
 
     def snapshot(self):
-        return {"kind": self.kind, "value": self.value}
+        snap = {"kind": self.kind, "value": self.value}
+        series = self._series_snapshots()
+        if series:
+            snap["series"] = series
+        return snap
 
     def _reset(self):
         with self._lock:
             self._value = 0
+        self._reset_children()
 
 
 class Gauge(_Metric):
-    """Set-to-current-value stat (HBM in use, queue depth, lr)."""
+    """Set-to-current-value stat (HBM in use, queue depth, lr).
+
+    Gauge children do NOT propagate into the parent: "sum of last-set
+    values" has no meaning for a set-semantics stat, so the parent and
+    each labeled child are independent series."""
 
     kind = "gauge"
 
@@ -95,11 +229,16 @@ class Gauge(_Metric):
             return self._value
 
     def snapshot(self):
-        return {"kind": self.kind, "value": self.value}
+        snap = {"kind": self.kind, "value": self.value}
+        series = self._series_snapshots()
+        if series:
+            snap["series"] = series
+        return snap
 
     def _reset(self):
         with self._lock:
             self._value = 0.0
+        self._reset_children()
 
 
 class Histogram(_Metric):
@@ -119,6 +258,11 @@ class Histogram(_Metric):
         self._sum = 0.0
         self._count = 0
 
+    def _new_child(self):
+        # children must share the family's bucket ladder or label-aware
+        # merges would mis-bin
+        return Histogram(self.name, buckets=self.bounds, help=self.help)
+
     def observe(self, v):
         v = float(v)
         i = bisect.bisect_left(self.bounds, v)
@@ -126,6 +270,8 @@ class Histogram(_Metric):
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+        if self._parent is not None:
+            self._parent.observe(v)
 
     @property
     def count(self):
@@ -153,16 +299,21 @@ class Histogram(_Metric):
 
     def snapshot(self):
         with self._lock:
-            return {
+            snap = {
                 "kind": self.kind, "sum": self._sum, "count": self._count,
                 "bounds": list(self.bounds), "buckets": list(self._counts),
             }
+        series = self._series_snapshots()
+        if series:
+            snap["series"] = series
+        return snap
 
     def _reset(self):
         with self._lock:
             self._counts = [0] * (len(self.bounds) + 1)
             self._sum = 0.0
             self._count = 0
+        self._reset_children()
 
 
 def _get(name, cls, **kwargs):
@@ -223,17 +374,19 @@ def stat_reset(name):
     STAT_INT(name).set(0)
 
 
-def histogram_quantile(h: Histogram, q: float) -> float:
+def histogram_quantile(h: Histogram, q: float):
     """Approximate quantile from the bucketed counts (prometheus
     histogram_quantile semantics: linear interpolation inside the
     matching bucket; observations in the +Inf bucket clamp to the
-    largest finite bound). Returns 0.0 on an empty histogram."""
+    largest finite bound). Returns ``None`` on an empty histogram —
+    0.0 would be indistinguishable from a real 0ms quantile on a
+    merged/fleet view, so callers render the series as absent."""
     if not 0.0 <= q <= 1.0:
         raise ValueError(f"quantile must be in [0, 1], got {q}")
     snap = h.snapshot()
     total = snap["count"]
     if total == 0:
-        return 0.0
+        return None
     target = q * total
     acc, lo = 0, 0.0
     for bound, c in zip(snap["bounds"], snap["buckets"]):
@@ -255,6 +408,13 @@ def merge_histogram_snapshots(snapshots, name="merged") -> Histogram:
     pooled histogram (same bounds), so the router's merged quantiles
     match the single-histogram golden. All snapshots must share the
     same bounds; a mismatch raises rather than silently mis-binning.
+
+    Label-aware: snapshots carrying a ``series`` dict (labeled
+    families) get their per-selector child snapshots merged the same
+    elementwise way; the merged children hang off the returned
+    histogram's :meth:`~_Metric.series` so fleet quantiles exist per
+    labeled series too. A series only some sources carry merges over
+    the sources that have it.
     """
     snapshots = [s for s in snapshots if s]
     if not snapshots:
@@ -279,6 +439,17 @@ def merge_histogram_snapshots(snapshots, name="merged") -> Histogram:
     h._counts = counts
     h._count = total
     h._sum = sum_
+    per_series: dict = {}
+    for s in snapshots:
+        for sub in (s.get("series") or {}).values():
+            labels = tuple(sorted((sub.get("labels") or {}).items()))
+            per_series.setdefault(labels, []).append(sub)
+    for labels, subs in per_series.items():
+        child = merge_histogram_snapshots(subs, name=name)
+        # static merged data: labeled for series(), but no parent link —
+        # nothing observes into a merge result
+        child._labels = labels
+        h._children[labels] = child
     return h
 
 

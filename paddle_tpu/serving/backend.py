@@ -179,6 +179,11 @@ def main(argv=None) -> int:
         print(f"backend refused: {e}", file=sys.stderr, flush=True)
         return 2
     srv.start(warmup=True)  # /healthz flips ready only after warmup
+    # per-backend SLOs from FLAGS_slo_objectives (the launcher passes
+    # the flag through the child env); no-op when empty
+    from ..monitor import slo as _slo
+
+    _slo.install_from_flags()
     if args.port_file:
         _announce_port(args.port_file, srv.port)
     print(f"serving backend ready on {srv.url} "

@@ -95,14 +95,15 @@ class _Request:
     optional absolute deadline, and a completion event the submitter
     waits on."""
 
-    __slots__ = ("inputs", "rows", "deadline", "t_submit", "result",
-                 "error", "trace", "_done")
+    __slots__ = ("inputs", "rows", "deadline", "t_submit", "tenant",
+                 "result", "error", "trace", "_done")
 
-    def __init__(self, inputs, rows, deadline, t_submit):
+    def __init__(self, inputs, rows, deadline, t_submit, tenant="default"):
         self.inputs = inputs
         self.rows = rows
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.t_submit = t_submit
+        self.tenant = tenant  # label dimension on the stage histograms
         self.result = None
         self.error = None
         # the submitter's trace context (the HTTP handler's server
@@ -249,11 +250,14 @@ class DynamicBatcher:
                 "FLAGS_serving_batch_buckets")
         return rows
 
-    def submit(self, inputs, deadline_ms=None) -> _Request:
+    def submit(self, inputs, deadline_ms=None, tenant=None) -> _Request:
         """Enqueue one request (dict feed_name -> array with leading
         batch axis). Returns the request handle; ``wait()`` it.
         Raises :class:`QueueFullError` on a full queue and
-        :class:`ServingClosedError` after ``close()``."""
+        :class:`ServingClosedError` after ``close()``. ``tenant``
+        labels the request's series on the stage histograms (default
+        tenant when unset; the registry's cardinality bound keeps a
+        hostile value at one ``other`` series)."""
         inputs = {n: np.asarray(v) for n, v in inputs.items()}
         rows = self._validate(inputs)
         if deadline_ms is None:
@@ -262,7 +266,8 @@ class DynamicBatcher:
         now = self._clock()
         deadline = (now + float(deadline_ms) / 1e3
                     if deadline_ms is not None else None)
-        req = _Request(inputs, rows, deadline, now)
+        req = _Request(inputs, rows, deadline, now,
+                       tenant="default" if tenant is None else str(tenant))
         with self._lock:
             if self._closed:
                 raise ServingClosedError(
@@ -386,14 +391,19 @@ class DynamicBatcher:
     def _assemble(self, picked, rows, t_first):
         with RecordEvent("serving::assemble"):
             now = self._clock()
+            bucket = next(b for b in self.buckets if b >= rows)
             for req in picked:
-                self._h_queue.observe((now - req.t_submit) * 1e3)
+                # labeled observe: the child propagates into the bare
+                # family, so /histz and the merge goldens keep exact
+                # totals while /metricz gains per-dimension series
+                self._h_queue.labels(
+                    kind="predict", bucket=str(bucket),
+                    tenant=req.tenant).observe((now - req.t_submit) * 1e3)
                 # queue-wait is knowable only now: record it backwards
                 # into each member's trace
                 _tracing.record_interval(
                     "serving::queue_wait", req.trace, req.t_submit, now,
                     rows=req.rows)
-            bucket = next(b for b in self.buckets if b >= rows)
             asp = _tracing.begin_span("serving::assemble")
             feed = {}
             for n in self.feed_names:
@@ -435,7 +445,9 @@ class DynamicBatcher:
             offset += req.rows
             req.done(result=req_out)
             self._m_responses.inc()
-            self._h_e2e.observe((now - req.t_submit) * 1e3)
+            self._h_e2e.labels(
+                kind="predict", bucket=str(batch.bucket),
+                tenant=req.tenant).observe((now - req.t_submit) * 1e3)
 
     def fail(self, batch, error):
         """Complete every request of a failed dispatch with ``error``."""

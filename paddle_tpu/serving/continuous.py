@@ -55,10 +55,11 @@ class GenerationRequest:
     __slots__ = ("prompt", "prompt_len", "max_new_tokens", "temperature",
                  "deadline", "t_submit", "t_first_token", "tokens",
                  "finish_reason", "on_token", "error", "trace",
-                 "handoff", "_done")
+                 "handoff", "tenant", "_done")
 
     def __init__(self, prompt, max_new_tokens, temperature, deadline,
-                 t_submit, on_token=None, handoff=None, prompt_len=None):
+                 t_submit, on_token=None, handoff=None, prompt_len=None,
+                 tenant=None):
         self.prompt = prompt
         # a disaggregated admission knows the prompt LENGTH (slab
         # metadata) even when the tokens themselves did not ride along
@@ -75,6 +76,8 @@ class GenerationRequest:
         self.temperature = temperature
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.t_submit = t_submit
+        # label dimension on the per-request latency histograms
+        self.tenant = "default" if tenant is None else str(tenant)
         self.t_first_token = None
         self.tokens = []
         self.finish_reason = None  # "eos" | "length" | None
@@ -109,8 +112,12 @@ class GenerationRequest:
 class ContinuousBatcher:
     """Slot scheduler + decode-loop worker over one GenerationEngine."""
 
-    def __init__(self, engine, queue_capacity=None, clock=time.monotonic):
+    def __init__(self, engine, queue_capacity=None, clock=time.monotonic,
+                 kind="generate"):
         self.engine = engine
+        # the backend's fleet role ("generate" | "decode" | ...): label
+        # dimension on every latency series this scheduler observes
+        self.kind = str(kind)
         self.queue_capacity = int(
             queue_capacity if queue_capacity is not None
             else flag("generation_queue_capacity"))
@@ -172,7 +179,8 @@ class ContinuousBatcher:
         return self.engine.extra_compiles()
 
     def submit(self, prompt, max_new_tokens=None, temperature=None,
-               deadline_ms=None, on_token=None) -> GenerationRequest:
+               deadline_ms=None, on_token=None,
+               tenant=None) -> GenerationRequest:
         """Enqueue one generation request. Validation happens at
         ADMISSION TIME here (a malformed prompt must be rejected before
         it can occupy a decode slot); a full queue raises
@@ -186,7 +194,7 @@ class ContinuousBatcher:
                     if deadline_ms is not None and float(deadline_ms) > 0
                     else None)
         req = GenerationRequest(prompt, max_new, temperature, deadline,
-                                now, on_token=on_token)
+                                now, on_token=on_token, tenant=tenant)
         return self._enqueue(req)
 
     def _enqueue(self, req) -> GenerationRequest:
@@ -213,7 +221,7 @@ class ContinuousBatcher:
     def submit_prefilled(self, planes, length, first_token,
                          max_new_tokens=None, temperature=None,
                          deadline_ms=None, on_token=None,
-                         prompt=None) -> GenerationRequest:
+                         prompt=None, tenant=None) -> GenerationRequest:
         """Enqueue a handed-off generation: the prompt was prefilled on
         a PREFILL-tier backend and arrives as a KV slab (window-width
         per-slot planes + true length + the first sampled token).
@@ -248,7 +256,7 @@ class ContinuousBatcher:
         req = GenerationRequest(
             prompt, max_new, temperature, deadline, now,
             on_token=on_token, prompt_len=length,
-            handoff=(planes, length, int(first_token)))
+            handoff=(planes, length, int(first_token)), tenant=tenant)
         return self._enqueue(req)
 
     def generate(self, prompt, max_new_tokens=None, temperature=None,
@@ -314,7 +322,11 @@ class ContinuousBatcher:
             req.t_first_token if req.t_first_token is not None
             else req.t_submit,
             now, tokens=len(req.tokens), finish_reason=reason)
-        self._h_e2e.observe((now - req.t_submit) * 1e3)
+        # labeled observe: the child propagates into the bare family,
+        # so /histz merges keep exact totals while /metricz gains the
+        # per-kind/per-tenant series
+        self._h_e2e.labels(kind=self.kind, tenant=req.tenant).observe(
+            (now - req.t_submit) * 1e3)
         self._m_responses.inc()
         _flight.record_event(
             "generation_complete", reason=reason,
@@ -392,7 +404,8 @@ class ContinuousBatcher:
                         "request reached a decode slot"))
                     continue
             req.t_first_token = self._clock()
-            self._h_ttft.observe((req.t_first_token - req.t_submit) * 1e3)
+            self._h_ttft.labels(kind=self.kind, tenant=req.tenant).observe(
+                (req.t_first_token - req.t_submit) * 1e3)
             if midbatch:
                 self._m_midbatch.inc()
             _flight.record_event(
@@ -481,10 +494,12 @@ class ContinuousBatcher:
             # tokens): the plain path observes the step time unchanged;
             # a speculative round amortizes its two dispatches over the
             # mean tokens each busy stream emitted
+            # kind-labeled only: one step serves slots of mixed tenants
+            h_token = self._h_token.labels(kind=self.kind)
             if engine.speculative and emitted:
-                self._h_token.observe(dt_ms * len(busy) / emitted)
+                h_token.observe(dt_ms * len(busy) / emitted)
             else:
-                self._h_token.observe(dt_ms)
+                h_token.observe(dt_ms)
             self._m_busy.set(self.live_slots)
         # drained exit: nothing queued, nothing active
         self._m_busy.set(self.live_slots)

@@ -80,6 +80,17 @@ _BACKEND_READ_ERRORS = (ConnectionError, IncompleteRead, socket.timeout,
                         OSError)
 
 
+def _quantile_row(h) -> dict | None:
+    """p50/p99/count for one merged histogram, or None when it holds no
+    observations (quantiles of nothing are not 0ms)."""
+    p50 = histogram_quantile(h, 0.5)
+    if p50 is None:
+        return None
+    return {"p50_ms": round(p50, 3),
+            "p99_ms": round(histogram_quantile(h, 0.99), 3),
+            "count": h.count}
+
+
 class NoBackendError(UnavailableError):
     """No backend admitted the request within the retry budget (503)."""
 
@@ -109,7 +120,7 @@ class BackendState:
         "queue_depth", "queue_capacity", "load", "mean_fill",
         "slot_occupancy", "compiles", "consecutive_failures",
         "admitted", "completed", "evictions", "last_probe_t",
-        "last_error",
+        "last_error", "metrics", "metrics_t",
     )
 
     def __init__(self, url):
@@ -130,6 +141,10 @@ class BackendState:
         self.evictions = 0
         self.last_probe_t = 0.0
         self.last_error = None
+        # last /metricz?format=snapshot scrape (registry snapshot dict),
+        # the /fleetz merge feed; stale-tolerant for one probe period
+        self.metrics = {}
+        self.metrics_t = 0.0
 
     def score(self) -> float:
         """P2C comparison key: fresher router-side in-flight count plus
@@ -155,12 +170,15 @@ class _RouterHandler(_BaseHandler):
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         if self._get_common(path):
             return
-        if path == "/":
+        if path == "/fleetz":
+            self._reply(200, self._srv.fleetz())
+        elif path == "/":
             self._reply(200, {
                 "service": "paddle_tpu serving router",
                 "routes": ["/predict (POST)", "/generate (POST)",
-                           "/healthz", "/statz", "/loadz", "/histz",
-                           "/tracez", "/metrics"]})
+                           "/healthz", "/statz", "/loadz", "/fleetz",
+                           "/histz", "/tracez", "/metrics", "/metricz",
+                           "/sloz"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -826,6 +844,20 @@ class Router:
             self._evict(b, reason=getattr(e, "reason", "probe_timeout"))
         finally:
             b.last_probe_t = time.monotonic()
+        # fleet-metrics scrape rides the same probe pass: the latest
+        # registry snapshot (labeled series included) lands on the
+        # state, so /fleetz is a dict merge, never a fan-out of
+        # on-demand backend GETs. Failure keeps the previous snapshot —
+        # load/health already decided rotation, and metrics one probe
+        # period stale merge fine.
+        try:
+            mz_status, mz = self._get_json(b, "/metricz?format=snapshot")
+            if mz_status == 200 and isinstance(mz, dict):
+                with self._lock:
+                    b.metrics = mz.get("metrics") or {}
+                    b.metrics_t = time.monotonic()
+        except (BackendUnavailableError, BackendTimeoutError):
+            pass
 
     def probe_once(self):
         for b in self.backend_states():
@@ -916,15 +948,56 @@ class Router:
         out = {}
         for name, snaps in per_name.items():
             merged = merge_histogram_snapshots(snaps, name=name)
-            if merged.count == 0:
+            row = _quantile_row(merged)
+            if row is None:
                 continue
-            out[name] = {
-                "p50_ms": round(histogram_quantile(merged, 0.5), 3),
-                "p99_ms": round(histogram_quantile(merged, 0.99), 3),
-                "count": merged.count,
-                "backends": len(snaps),
-            }
+            row["backends"] = len(snaps)
+            out[name] = row
         return out
+
+    def fleetz(self) -> dict:
+        """``GET /fleetz``: fleet-merged labeled quantiles. Per backend
+        kind, per ``serving/*`` histogram, the elementwise bucket sum of
+        every in-rotation backend's last prober-scraped snapshot —
+        exact, identical to one pooled histogram — with quantiles per
+        labeled series riding along. Empty series are omitted entirely
+        (a fake 0ms p99 is worse than no row). No backend I/O happens
+        here: the prober already paid for the snapshots."""
+        groups: dict = {}
+        states = self.backend_states()
+        scraped = 0
+        for b in states:
+            if not b.in_rotation or not b.metrics:
+                continue
+            scraped += 1
+            kind = b.kind or "unknown"
+            for name, snap in b.metrics.items():
+                if (not isinstance(snap, dict)
+                        or snap.get("kind") != "histogram"
+                        or not name.startswith("serving/")):
+                    continue
+                groups.setdefault(kind, {}).setdefault(
+                    name, []).append(snap)
+        fleet: dict = {}
+        for kind, per_name in groups.items():
+            for name, snaps in per_name.items():
+                try:
+                    merged = merge_histogram_snapshots(snaps, name=name)
+                except ValueError:
+                    continue  # mixed bucket ladders: skip, don't 500
+                row = _quantile_row(merged)
+                if row is None:
+                    continue
+                row["backends"] = len(snaps)
+                series = {}
+                for sel, child in sorted(merged.series().items()):
+                    srow = _quantile_row(child)
+                    if srow is not None:
+                        series[sel] = srow
+                if series:
+                    row["series"] = series
+                fleet.setdefault(kind, {})[name] = row
+        return {"backends_scraped": scraped, "fleet": fleet}
 
     def healthz(self) -> dict:
         return {
@@ -1019,6 +1092,11 @@ def main(argv=None) -> int:
                     port=args.port,
                     probe_interval_s=args.probe_interval_s,
                     retries=args.retries).start()
+    # router-local SLOs (e.g. over serving/router_e2e_ms) from
+    # FLAGS_slo_objectives; no-op when the flag is empty
+    from ..monitor import slo as _slo
+
+    _slo.install_from_flags()
     if args.port_file:
         from .backend import _announce_port
 

@@ -79,6 +79,10 @@ class FleetSignals:
     host: dict = field(default_factory=dict)  # cluster.local_snapshot()
     kind: str | None = None
     kinds: dict = field(default_factory=dict)
+    # confirmed SLO burn rate (monitor.slo.current_burn(): max over
+    # objectives of min(fast, slow) window burn) — queue depth says the
+    # fleet is BUSY, burn says users are already losing error budget
+    slo_burn: float = 0.0
 
 
 def _kind_split(states) -> dict:
@@ -310,6 +314,10 @@ class AutoScaler:
         self.interval_s = float(
             interval_s if interval_s is not None
             else flag("serving_scaler_interval_s"))
+        # burn at/above this (both SLO windows confirming) is up-pressure
+        # on its own: latency SLOs can burn while queues stay shallow
+        # (e.g. a wedged-but-answering backend)
+        self.burn_alert = float(flag("slo_burn_alert"))
         self.clock = clock
         self.owned: dict[str, LaunchedBackend] = {}
         self._up_streak = 0
@@ -345,6 +353,10 @@ class AutoScaler:
                           and b.url in self.owned)]
         healthy = [b for b in states if b.in_rotation]
         depths = [b.queue_depth for b in healthy]
+        # the scaler runs in-process with the router, so the router-side
+        # SLO engine's confirmed burn is a local read, not an RPC
+        from ..monitor import slo as _slo
+
         return FleetSignals(
             time=self.clock(),
             backends_total=len(states),
@@ -356,6 +368,7 @@ class AutoScaler:
             host=_cluster.local_snapshot(),
             kind=self.kind,
             kinds=_kind_split(all_states),
+            slo_burn=_slo.current_burn(),
         )
 
     # -- decision ------------------------------------------------------------
@@ -378,9 +391,12 @@ class AutoScaler:
             self._up_streak = self._down_streak = 0
             return None
         # zero healthy backends IS up-pressure regardless of queue math:
-        # the fleet is dark and the router is answering 503s
+        # the fleet is dark and the router is answering 503s; a
+        # confirmed SLO burn past the alert threshold likewise — error
+        # budget is being spent NOW even if queues look shallow
         up = (sig.backends_healthy == 0
-              or sig.mean_queue_depth >= self.up_queue_depth)
+              or sig.mean_queue_depth >= self.up_queue_depth
+              or sig.slo_burn >= self.burn_alert)
         down = (not up
                 and sig.mean_queue_depth <= self.down_queue_depth
                 and sig.total_inflight == 0)

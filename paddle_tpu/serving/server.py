@@ -35,7 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ..errors import InvalidArgumentError
-from ..monitor import all_metrics, counter
+from ..monitor import all_metrics, counter, gauge
 from ..monitor import cost_model as _cost
 from ..monitor import flight_recorder as _flight
 from ..monitor import histogram_quantile, registry_snapshot
@@ -200,6 +200,31 @@ def _utilization(t0, flops0, val):
     }
 
 
+def _utilization_window(state, val):
+    """Windowed serving MFU/goodput: the executed-FLOPs delta over the
+    wall since the PREVIOUS statz read (the stats window), published as
+    the ``serving/mfu`` and ``serving/goodput_flops_per_s`` gauges so
+    the fleet scrape (/metricz, /fleetz) sees utilization without
+    redoing the ledger math. ``state`` is the server's mutable
+    ``[t_last, flops_last]`` cell; returns the statz block (None until
+    a full window has elapsed)."""
+    now = time.monotonic()
+    flops = val("cost/executed_flops")
+    dt = now - state[0]
+    block = None
+    if dt > 1e-3:
+        rate = max(0.0, flops - state[1]) / dt
+        m = _cost.mfu(rate, _cost.device_peaks())
+        gauge("serving/goodput_flops_per_s").set(round(rate, 3))
+        gauge("serving/mfu").set(round(m, 6))
+        block = {"window_s": round(dt, 3),
+                 "goodput_flops_per_s": round(rate, 3),
+                 "mfu": round(m, 6)}
+        state[0] = now
+        state[1] = flops
+    return block
+
+
 class ServingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer with a fleet-sized accept backlog. The
     stdlib default (request_queue_size=5) refuses connections under a
@@ -325,6 +350,24 @@ class _BaseHandler(BaseHTTPRequestHandler):
             )
 
             self._reply(200, prometheus_text(), PROMETHEUS_CONTENT_TYPE)
+        elif path == "/metricz":
+            # the fleet scrape surface: prometheus text by default;
+            # ?format=snapshot is the machine feed (labeled series
+            # included) the router's prober merges into /fleetz
+            if _tracing.parse_query(self.path).get("format") == "snapshot":
+                self._reply(200, {"metrics": registry_snapshot()})
+            else:
+                from ..monitor.export import (
+                    PROMETHEUS_CONTENT_TYPE,
+                    prometheus_text,
+                )
+
+                self._reply_raw(200, prometheus_text().encode("utf-8"),
+                                PROMETHEUS_CONTENT_TYPE)
+        elif path == "/sloz":
+            from ..monitor import slo as _slo
+
+            self._reply(200, _slo.sloz_payload())
         else:
             return False
         return True
@@ -339,7 +382,8 @@ class _ServingHandler(_BaseHandler):
             self._reply(200, {
                 "service": "paddle_tpu serving",
                 "routes": ["/predict (POST)", "/healthz", "/statz",
-                           "/loadz", "/histz", "/tracez", "/metrics"]})
+                           "/loadz", "/histz", "/tracez", "/metrics",
+                           "/metricz", "/sloz"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -372,11 +416,13 @@ class _ServingHandler(_BaseHandler):
             deadline_ms = body.get("deadline_ms")
             if deadline_ms is not None:
                 deadline_ms = float(deadline_ms)  # "abc" -> 400, not 500
+            tenant = body.get("tenant")
         except (ValueError, TypeError, InvalidArgumentError) as e:
             self._reply(400, {"error": str(e)})
             return
         req = self._try_submit(
-            lambda: srv.batcher.submit(inputs, deadline_ms=deadline_ms))
+            lambda: srv.batcher.submit(inputs, deadline_ms=deadline_ms,
+                                       tenant=tenant))
         if req is None:
             return
         _tracing.annotate(rows=int(req.rows))
@@ -454,6 +500,7 @@ class InferenceServer:
         # statz attributes only the delta since construction to serving
         self._flops0 = registry_snapshot().get(
             "cost/executed_flops", {}).get("value", 0.0)
+        self._mfu_window = [self._t0, self._flops0]
         self.draining = False
         self._stopped = False
         from . import _register_live
@@ -590,6 +637,8 @@ class InferenceServer:
             "ir_opt": _ir_opt_stats(),
         }
         _, out["utilization"] = _utilization(self._t0, self._flops0, val)
+        out["utilization"]["window"] = _utilization_window(
+            self._mfu_window, val)
         return out
 
 
@@ -617,7 +666,7 @@ class _GenerationHandler(_BaseHandler):
                 "kind": self._srv.kind,
                 "routes": [f"{_KIND_ROUTES[self._srv.kind]} (POST)",
                            "/healthz", "/statz", "/loadz", "/histz",
-                           "/tracez", "/metrics"]})
+                           "/tracez", "/metrics", "/metricz", "/sloz"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -667,6 +716,10 @@ class _GenerationHandler(_BaseHandler):
             "deadline_ms": float(deadline_ms)
             if deadline_ms is not None else None,
             "stream": bool(body.get("stream", False)),
+            # tenant dimension for the labeled serving histograms (the
+            # cardinality bound makes a hostile value cost one series)
+            "tenant": str(body["tenant"])
+            if body.get("tenant") is not None else None,
         }
 
     def _check_ready(self, srv) -> bool:
@@ -707,7 +760,7 @@ class _GenerationHandler(_BaseHandler):
         submit = lambda **kw: srv.scheduler.submit(  # noqa: E731
             p["prompt"], max_new_tokens=p["max_new_tokens"],
             temperature=p["temperature"], deadline_ms=p["deadline_ms"],
-            **kw)
+            tenant=p["tenant"], **kw)
         if p["stream"]:
             self._generate_stream(srv, submit)
             return
@@ -752,7 +805,7 @@ class _GenerationHandler(_BaseHandler):
         blob = pack_kv_slab(planes, length, first, meta={
             "params": {k: p[k] for k in
                        ("prompt", "max_new_tokens", "temperature",
-                        "deadline_ms", "stream")},
+                        "deadline_ms", "stream", "tenant")},
             "cache": srv.cache_geometry(),
         })
         self._reply_raw(200, blob, HANDOFF_CONTENT_TYPE)
@@ -795,7 +848,7 @@ class _GenerationHandler(_BaseHandler):
             max_new_tokens=p.get("max_new_tokens"),
             temperature=p.get("temperature"),
             deadline_ms=p.get("deadline_ms"),
-            prompt=p.get("prompt"), **kw)
+            prompt=p.get("prompt"), tenant=p.get("tenant"), **kw)
         if stream:
             self._generate_stream(srv, submit)
             return
@@ -932,7 +985,7 @@ class GenerationServer:
                 top_k=top_k, kv_cache_dtype=kv_cache_dtype,
                 draft_model=draft_model, draft_k=draft_k)
         self.scheduler = ContinuousBatcher(
-            self.engine, queue_capacity=queue_capacity)
+            self.engine, queue_capacity=queue_capacity, kind=self.kind)
         # prefill tier: prefill_export mutates no cache state, so
         # handler threads run a few forwards CONCURRENTLY (XLA overlaps
         # one dispatch's compute with the next one's host prep) behind
@@ -958,6 +1011,7 @@ class GenerationServer:
         snap = registry_snapshot()
         self._flops0 = snap.get(
             "cost/executed_flops", {}).get("value", 0.0)
+        self._mfu_window = [self._t0, self._flops0]
         self._tokens0 = snap.get(
             "serving/gen_tokens_total", {}).get("value", 0)
         self.draining = False
@@ -1115,6 +1169,7 @@ class GenerationServer:
     def statz(self) -> dict:
         val, quantiles = _stats_readers()
         uptime, utilization = _utilization(self._t0, self._flops0, val)
+        utilization["window"] = _utilization_window(self._mfu_window, val)
         tokens = val("serving/gen_tokens_total") - self._tokens0
         out = {
             **self.healthz(),

@@ -406,7 +406,8 @@ def test_histogram_quantile():
     assert 1.0 < monitor.histogram_quantile(h, 0.5) <= 10.0
     assert monitor.histogram_quantile(h, 0.99) == 100.0  # +Inf clamps
     empty = monitor.histogram("t_serving_q_empty")
-    assert monitor.histogram_quantile(empty, 0.5) == 0.0
+    # no observations -> no quantile (None), not a fabricated 0ms
+    assert monitor.histogram_quantile(empty, 0.5) is None
     with pytest.raises(ValueError):
         monitor.histogram_quantile(h, 1.5)
 

@@ -44,6 +44,10 @@
 #             training-program byte-identity at level 1, and remat
 #             converting a strict-mode rejection into an admit with
 #             >= 20% planned-peak reduction)
+#           + slo smoke (fleet SLO plane: labeled /metricz series, a
+#             wedged backend paging via multi-window burn rate with a
+#             slo_burn flight event, /fleetz quantiles equal to the
+#             pooled-histogram golden, the scaler reading the burn)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -164,6 +168,13 @@ case "$MODE" in
     # and level-2 rematerialization turning a strict-budget rejection
     # into an admit at >= 20% planned-peak reduction
     JAX_PLATFORMS=cpu python tools/ir_opt_smoke.py
+    # slo smoke: fleet SLO plane — labeled per-kind/tenant series on
+    # /metricz (text + snapshot modes), one wedged backend driving its
+    # fast+slow window burns past the alert threshold (slo_burn flight
+    # event) while the healthy backend stays quiet, router /fleetz
+    # p50/p99 exactly equal to the hand-merged pooled histogram, and
+    # the autoscaler reading the confirmed burn as up-pressure
+    JAX_PLATFORMS=cpu python tools/slo_smoke.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

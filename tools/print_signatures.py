@@ -51,6 +51,8 @@ MODULES = [
     "paddle_tpu.generation",
     "paddle_tpu.analysis",
     "paddle_tpu.tuning",
+    "paddle_tpu.monitor",
+    "paddle_tpu.monitor.slo",
 ]
 
 # methods pinned as API surface beyond the module-level names (the spec
@@ -59,6 +61,11 @@ PINNED_METHODS = [
     ("paddle_tpu.static", "Program", "verify"),
     ("paddle_tpu.static", "Program", "plan_memory"),
     ("paddle_tpu.generation", "GenerationEngine", "suggest_decode_slots"),
+    # the labeled-family API: child metrics per label set
+    ("paddle_tpu.monitor", "Counter", "labels"),
+    ("paddle_tpu.monitor", "Gauge", "labels"),
+    ("paddle_tpu.monitor", "Histogram", "labels"),
+    ("paddle_tpu.monitor", "Histogram", "series"),
 ]
 
 

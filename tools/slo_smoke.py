@@ -1,0 +1,248 @@
+#!/usr/bin/env python
+"""CI smoke for the fleet SLO plane (`make slo-smoke`).
+
+Boots the real fleet shape — two backend processes behind a Router —
+with one latency SLO installed fleet-wide via ``FLAGS_slo_objectives``
+in the children's env, wedges ONE backend (a huge --batch-timeout-ms
+holds every request far past the SLO threshold), and asserts the
+error-budget contracts end to end:
+
+- the wedged backend's ``/sloz`` shows both window burns past the alert
+  threshold with ``alerting=true`` and a ``slo_burn`` flight event; the
+  healthy backend's burn stays at zero;
+- ``/metricz`` serves prometheus text with the labeled per-kind series,
+  and ``/metricz?format=snapshot`` the JSON registry snapshot;
+- router ``/fleetz`` p50/p99 for ``serving/e2e_ms`` exactly equal a
+  hand-merge of the two backends' own snapshots (the fleet view IS the
+  pooled histogram);
+- a router-local SLO over ``serving/router_e2e_ms`` pushes its
+  confirmed burn through ``FleetSignals.slo_burn`` and the autoscaler
+  reads it as up-pressure even though queues are shallow.
+
+Exit 0 on success; a failure is a real SLO-plane regression.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from urllib.request import Request, urlopen
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+IN_DIM = 16
+THRESHOLD_MS = 50.0
+WEDGE_TIMEOUT_MS = 400.0
+REQUESTS = 12
+OBJECTIVE = ("predict-fast|serving/e2e_ms{kind=predict}"
+             f"|threshold_ms={THRESHOLD_MS}|target=0.99|window_s=120")
+
+
+def _build_model_dir():
+    import paddle_tpu.static as static
+
+    static.enable_static()
+    static.reset_default_programs()
+    static.global_scope().clear()
+    try:
+        x = static.data("x", [None, IN_DIM], "float32")
+        y = static.nn.fc(static.nn.fc(x, 64, name="ssm_fc1"), 8,
+                         name="ssm_fc2")
+        exe = static.Executor()
+        exe.run_startup()
+        d = tempfile.mkdtemp(prefix="ptpu_slo_smoke_")
+        static.save_inference_model(d, ["x"], [y], exe)
+    finally:
+        static.disable_static()
+        static.reset_default_programs()
+    return d
+
+
+def _get(url, timeout=10):
+    with urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+def _post_predict(url, rows, timeout=30):
+    a = np.random.RandomState(rows).randn(rows, IN_DIM).astype("float32")
+    body = json.dumps({"inputs": a.tolist(),
+                       "tenant": "smoke"}).encode()
+    with urlopen(Request(url + "/predict", data=body,
+                         headers={"Content-Type": "application/json"}),
+                 timeout=timeout) as r:
+        return r.status
+
+
+def main():
+    from paddle_tpu import monitor
+    from paddle_tpu.monitor import flight_recorder as _flight
+    from paddle_tpu.monitor import slo as slo_mod
+    from paddle_tpu.serving import Router
+    from paddle_tpu.serving.scaler import AutoScaler, launch_process
+
+    model_dir = _build_model_dir()
+    env = {"FLAGS_slo_objectives": OBJECTIVE,
+           "FLAGS_slo_sample_interval_s": "0.2",
+           "JAX_PLATFORMS": "cpu"}
+    print("booting 1 healthy + 1 wedged backend process ...", flush=True)
+    common = ["--model-dir", model_dir, "--port", "0",
+              "--buckets", "1,2,4", "--queue-capacity", "256"]
+    healthy = launch_process(
+        "paddle_tpu.serving.backend",
+        common + ["--batch-timeout-ms", "1"], env=env)
+    # the wedge: every request waits out the batch window, far past the
+    # 50ms SLO threshold — slow-but-answering, so /healthz stays green
+    # and only the SLO plane sees the violation
+    wedged = launch_process(
+        "paddle_tpu.serving.backend",
+        common + ["--batch-timeout-ms", str(WEDGE_TIMEOUT_MS)], env=env)
+    backends = [healthy, wedged]
+    router = Router(backends=[b.url for b in backends],
+                    probe_interval_s=0.2).start()
+    try:
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline and router.healthy_count < 2:
+            time.sleep(0.05)
+        assert router.healthy_count == 2, router.healthz()
+
+        # router-local objective over the router's own e2e histogram,
+        # sampled manually around the burst (deterministic windows)
+        slo_mod.reset_engine()
+        rslo = slo_mod.install_slo(slo_mod.SLO(
+            "router-fast", "serving/router_e2e_ms",
+            threshold_ms=THRESHOLD_MS, target=0.99, window_s=120.0))
+        slo_mod.engine().sample()
+
+        # -- traffic. Sequential requests all tie at score 0 and P2C
+        # tie-breaks by URL, so force a phase with ONLY the wedged
+        # backend in rotation — the router e2e histogram must contain
+        # threshold-busting requests deterministically, not by port
+        # order luck. Direct posts give each backend's own /sloz a
+        # guaranteed share too.
+        for i in range(REQUESTS):
+            assert _post_predict(router.url, rows=(i % 3) + 1) == 200
+        router.remove_backend(healthy.url)
+        for i in range(6):
+            assert _post_predict(router.url, rows=1) == 200
+        router.add_backend(healthy.url)
+        for b in backends:
+            for i in range(4):
+                assert _post_predict(b.url, rows=1) == 200
+        slo_mod.engine().sample()
+        print(f"traffic done: {REQUESTS} mixed + 6 wedge-only via "
+              "router, 4 direct per backend", flush=True)
+
+        # -- /metricz both modes on a live backend ---------------------
+        status, ctype, raw = _get(healthy.url + "/metricz")
+        assert status == 200 and ctype.startswith("text/plain"), ctype
+        assert b'serving_e2e_ms_bucket{' in raw, (
+            "labeled series missing from prometheus text")
+        assert b'kind="predict"' in raw and b'tenant="smoke"' in raw
+        status, ctype, raw = _get(healthy.url +
+                                  "/metricz?format=snapshot")
+        assert status == 200 and "json" in ctype, ctype
+        assert "serving/e2e_ms" in json.loads(raw)["metrics"]
+        print("/metricz OK: prometheus text with kind/tenant labels + "
+              "JSON snapshot mode", flush=True)
+
+        # -- /sloz: wedged burns past alert, healthy does not ----------
+        wz = hz = None
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            wz = json.loads(_get(wedged.url + "/sloz")[2])["slos"][0]
+            hz = json.loads(_get(healthy.url + "/sloz")[2])["slos"][0]
+            if wz["alerting"] and hz["samples"] >= 2:
+                break
+            time.sleep(0.2)
+        assert wz["name"] == "predict-fast" and wz["samples"] >= 2, wz
+        assert wz["alerting"], (
+            "wedged backend never crossed the alert burn", wz)
+        assert wz["burn"]["fast"] >= wz["alert_burn"], wz
+        assert wz["burn"]["slow"] >= wz["alert_burn"], wz
+        assert not hz["alerting"], (
+            "healthy backend must not page", hz)
+        assert (hz["burn"]["fast"] or 0.0) < wz["alert_burn"], hz
+        # the router-local objective crossed alert too (>= 6 wedge-only
+        # requests of <= 22 against a 1% budget): its transition left a
+        # slo_burn flight event in THIS process's recorder
+        burns = [e for e in _flight.events()
+                 if e.get("kind") == "slo_burn"]
+        assert burns and burns[-1]["slo"] == "router-fast", (
+            "slo_burn flight event missing for the router-local SLO")
+        print(f"/sloz OK: wedged burn fast={wz['burn']['fast']}x "
+              f"slow={wz['burn']['slow']}x >= alert "
+              f"{wz['alert_burn']}x; healthy fast="
+              f"{hz['burn']['fast']}x; router-local slo_burn flight "
+              "event recorded", flush=True)
+
+        # -- /fleetz == hand-merged golden -----------------------------
+        name = "serving/e2e_ms"
+        snaps = [json.loads(_get(b.url + "/metricz?format=snapshot")[2])
+                 ["metrics"] for b in backends]
+        golden = monitor.merge_histogram_snapshots(
+            [s[name] for s in snaps], name=name)
+        fz = row = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            fz = json.loads(_get(router.url + "/fleetz")[2])
+            row = fz["fleet"].get("predict", {}).get(name)
+            if row and row["count"] == golden.count:
+                break
+            time.sleep(0.1)
+        assert row is not None and fz["backends_scraped"] == 2, fz
+        assert row["count"] == golden.count, (row, golden.count)
+        for q, key in ((0.5, "p50_ms"), (0.99, "p99_ms")):
+            want = round(monitor.histogram_quantile(golden, q), 3)
+            assert row[key] == want, (key, row[key], want)
+        assert row["series"], "labeled series missing from /fleetz"
+        print(f"/fleetz OK: fleet p50={row['p50_ms']}ms "
+              f"p99={row['p99_ms']}ms over {row['count']} requests == "
+              "hand-merged golden, labeled series attached", flush=True)
+
+        # -- the scaler sees the burn ----------------------------------
+        burn = slo_mod.current_burn()
+        assert burn > 0.0, "router-local SLO produced no confirmed burn"
+        sc = AutoScaler(router, launcher=None, min_backends=1,
+                        max_backends=4, up_queue_depth=1e9,
+                        down_queue_depth=-1.0, window=2,
+                        cooldown_s=0.0, interval_s=60.0)
+        try:
+            sig = sc.signals()
+            assert sig.slo_burn == burn, (sig.slo_burn, burn)
+            if burn >= sc.burn_alert:
+                assert sc.decide(sig) is None  # hysteresis tick 1
+                assert sc.decide(sig) == "up", (
+                    "confirmed burn past alert must be up-pressure")
+                verdict = "decide()=up"
+            else:
+                verdict = "below alert (no page), signal plumbed"
+        finally:
+            sc.stop(drain=False)
+        print(f"scaler OK: FleetSignals.slo_burn={round(burn, 2)}x "
+              f"(alert {sc.burn_alert}x), {verdict}", flush=True)
+
+        print("slo-smoke OK: labeled /metricz, burn-rate paging on the "
+              "wedged backend only, /fleetz == pooled golden, scaler "
+              "sees the burn")
+        return 0
+    finally:
+        slo_mod.reset_engine()
+        try:
+            router.stop(drain=False)
+        except Exception:
+            pass
+        for b in backends:
+            try:
+                b.proc.kill()
+                b.proc.wait(10)
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())
