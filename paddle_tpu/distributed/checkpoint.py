@@ -94,6 +94,12 @@ def _flight():
     return flight_recorder
 
 
+def _goodput():
+    from ..monitor import goodput
+
+    return goodput
+
+
 def _counter(name):
     from ..monitor import registry
 
@@ -310,7 +316,10 @@ def save(path, state, shardings=None, *, step=None, mesh=None, keep=None,
 
     if async_ is None:
         async_ = bool(flag("checkpoint_async"))
-    with RecordEvent("checkpoint::capture"):
+    # the capture runs on the calling (step) thread: its seconds are
+    # checkpoint badput in the goodput ledger (deducted from the step
+    # frame's compute when called inside one)
+    with RecordEvent("checkpoint::capture"), _goodput().span("checkpoint"):
         named, _ = _named_leaves(state)
         names = [n for n, _ in named]
         leaves = _snapshot_leaves([l for _, l in named])
@@ -366,7 +375,12 @@ def _write_snapshot(final, names, leaves, specs, meta, keep,
     t0 = time.perf_counter()
     tmp = final + ".tmp"
     os.makedirs(tmp, exist_ok=True)
-    with RecordEvent("checkpoint::serialize"):
+    # serialize + publish seconds: foreground checkpoint badput when the
+    # save is sync (this runs on the step thread); automatically filed
+    # as overlapped background work when the async writer thread runs it
+    # under a live step frame (overlapped work costs no wall time)
+    with _goodput().span("checkpoint"), \
+            RecordEvent("checkpoint::serialize"):
         from ..framework import serialization as _ser
 
         entries = {}
@@ -404,7 +418,7 @@ def _write_snapshot(final, names, leaves, specs, meta, keep,
         rec = _await_peer_commit(tmp, r, deadline)
         files[rec["file"]] = {"crc32": rec["crc32"], "size": rec["size"]}
     write_manifest(tmp, files, **meta, entries=entries)
-    with RecordEvent("checkpoint::publish"):
+    with _goodput().span("checkpoint"), RecordEvent("checkpoint::publish"):
         if os.path.exists(final):
             shutil.rmtree(final, ignore_errors=True)
         os.replace(tmp, final)
@@ -413,6 +427,15 @@ def _write_snapshot(final, names, leaves, specs, meta, keep,
     _flight().record_event(
         "checkpoint_saved", path=final, step=meta["step"],
         world=world, ms=round((time.perf_counter() - t0) * 1e3, 3))
+    led = _goodput().active_ledger()
+    if led is not None:
+        # re-publish the goodput sidecar after every snapshot
+        # publication: a resume can never land on a checkpoint newer
+        # than the ledger's lost-work pricing basis
+        try:
+            led.publish()
+        except OSError:
+            pass
     if keep:
         _rotate(final, int(keep))
 
@@ -603,7 +626,7 @@ def restore_train_step(step_obj, path):
     import jax
     import jax.numpy as jnp
 
-    with RecordEvent("checkpoint::restore"):
+    with RecordEvent("checkpoint::restore"), _goodput().span("restore"):
         flat, manifest = load(path)
         named, treedef = _named_leaves(step_obj.state)
         names = [n for n, _ in named]
@@ -661,6 +684,12 @@ def restore_train_step(step_obj, path):
             new_mesh=json.dumps(dict(mesh.shape) if mesh else None))
     _flight().record_event("checkpoint_restored", path=str(path),
                            step=manifest.get("step", -1))
+    led = _goodput().active_ledger()
+    if led is not None:
+        # price the resume: steps the previous life committed AFTER this
+        # manifest must be recomputed — the ledger charges them to
+        # lost_work as they re-commit
+        led.note_resume(int(manifest.get("step", -1)))
     return manifest
 
 

@@ -305,12 +305,24 @@ def renegotiate_world(monitor: HeartbeatMonitor, members=None,
     """
     from ..errors import FatalError
     from ..monitor import flight_recorder as _flight
+    from ..monitor import goodput as _goodput
 
     members = sorted(members if members is not None
                      else range(monitor.world_size))
     vote_dir = os.path.join(monitor.root, f"world_gen_{int(generation)}")
     os.makedirs(vote_dir, exist_ok=True)
     deadline = time.monotonic() + float(timeout)
+    # renegotiation wall time is elastic badput in the goodput ledger —
+    # the span closes on every exit (agreement, eviction, timeout)
+    with _goodput.span("renegotiate"):
+        return _renegotiate_loop(monitor, members, generation, timeout,
+                                 poll, vote_dir, deadline, _flight,
+                                 FatalError)
+
+
+def _renegotiate_loop(monitor, members, generation, timeout, poll,
+                      vote_dir, deadline, _flight, FatalError):
+    generation = int(generation)  # loop-invariant (host int)
     my_vote = None
     while True:
         dead = set(monitor.dead_ranks())
@@ -324,10 +336,10 @@ def renegotiate_world(monitor: HeartbeatMonitor, members=None,
         agreed = _votes_agree(vote_dir, survivors)
         if agreed is not None:
             world = ElasticWorld(
-                generation=int(generation), survivors=agreed,
+                generation=generation, survivors=agreed,
                 rank=agreed.index(monitor.rank), world_size=len(agreed))
             _flight.record_event(
-                "elastic_world_agreed", generation=int(generation),
+                "elastic_world_agreed", generation=generation,
                 survivors=agreed, rank=world.rank)
             return world
         if time.monotonic() > deadline:

@@ -691,6 +691,32 @@ define_flag("slo_burn_alert", 14.4,
             "error-budget burn-rate multiple at which an SLO alerts "
             "(both windows) and the autoscaler sees up-pressure")
 
+# monitor/goodput.py — lifetime training goodput/badput ledger. The
+# directory holds the GOODPUT.json sidecar (atomic tmp->rename + CRC,
+# the checkpoint publication discipline), so a kill -9 restart CONTINUES
+# the same lifetime accounting instead of starting a fresh wall clock.
+# Empty (default): ledger off — zero step-path cost.
+define_flag("goodput_dir", "",
+            "directory for the training goodput ledger's GOODPUT.json "
+            "sidecar; empty disables the ledger")
+
+# How often the ledger re-publishes its sidecar, piggybacked on step
+# commits (0 = every committed step — what the goodput smoke uses so the
+# kill -9 window is one step wide). The ledger also publishes after
+# every checkpoint publication, so the sidecar is never staler than the
+# newest snapshot a resume could land on.
+define_flag("goodput_publish_interval_s", 30.0,
+            "seconds between goodput sidecar publications (piggybacked "
+            "on step commits; 0 publishes every step)")
+
+# Optional goodput-ratio SLO driven through monitor/slo.py's burn-rate
+# engine: error mode over goodput/badput_seconds_total (bad) vs
+# goodput/wall_seconds_total (total), i.e. the objective is
+# "goodput >= target". 0 (default): no objective installed.
+define_flag("goodput_slo_target", 0.0,
+            "goodput-ratio SLO target (e.g. 0.9) installed through the "
+            "burn-rate engine; 0 disables")
+
 # models/resnet.py + nn/layers.py fused_conv_bn_relu + ops/pallas/
 # conv_bn_relu.py — fuse the vision path's conv -> batch_norm -> relu
 # triple into pallas kernels on TPU: the conv contraction runs as a

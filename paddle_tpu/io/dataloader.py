@@ -310,6 +310,8 @@ class _DevicePrefetcher:
             batch = self.buf.pop(0)
             self._fill()
         _mon.counter("io/batches").inc()
+        # feeds io/input_wait_ms_total (counter), the monitor's window
+        # input-wait ratio, and the goodput ledger's input_wait phase
         record_input_wait_ms((time.perf_counter() - t0) * 1e3)
         return batch
 

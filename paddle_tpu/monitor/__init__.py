@@ -38,6 +38,14 @@ Three layers:
   multi-window burn-rate evaluation (fast 5m / slow 1h), ``/sloz``
   payloads, ``slo_burn`` flight events at alert transitions, and the
   confirmed-burn signal the autoscaler consumes.
+- :mod:`monitor.goodput` — lifetime training goodput/badput ledger:
+  every second of wall time classified into exclusive phases (compute,
+  input wait, compile, checkpoint, restore, renegotiate, restart lost
+  work, aborted steps, idle) with a crash-surviving ``GOODPUT.json``
+  sidecar, ``goodput/seconds_total{phase=…}`` labeled counters, the
+  ``/goodputz`` endpoint, per-rank ``/clusterz`` rows, a chrome-trace
+  phase track, and an optional burn-rate SLO
+  (``FLAGS_goodput_slo_target``).
 - :mod:`monitor.flight_recorder` — fault diagnosis: ring-buffer flight
   recorder (executor runs, collectives with per-group sequence numbers
   and fingerprints, PS RPCs, dataloader lifecycle, flag changes, XLA
@@ -95,6 +103,15 @@ from .training_monitor import (  # noqa: F401
     active_monitor,
     record_input_wait_ms,
 )
+from . import goodput  # noqa: F401
+from .goodput import (  # noqa: F401
+    GoodputLedger,
+    active_ledger,
+    goodputz_payload,
+    install_goodput_slo,
+    start_ledger,
+    stop_ledger,
+)
 from . import tracing  # noqa: F401
 from .tracing import (  # noqa: F401
     SpanContext,
@@ -142,6 +159,8 @@ __all__ = [
     "export_prometheus", "prometheus_text", "export_merged_chrome_trace",
     "PROMETHEUS_CONTENT_TYPE",
     "TrainingMonitor", "record_input_wait_ms", "active_monitor",
+    "goodput", "GoodputLedger", "start_ledger", "stop_ledger",
+    "active_ledger", "goodputz_payload", "install_goodput_slo",
     "cost_model", "CostRecord", "device_peaks", "mfu", "hbm_bw_util",
     "roofline_class", "cluster",
     "tracing", "SpanContext", "TraceStore", "annotate",

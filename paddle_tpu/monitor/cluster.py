@@ -31,6 +31,7 @@ import time
 from ..flags import flag
 from . import cost_model as _cost
 from . import flight_recorder as _flight
+from . import goodput as _goodput
 from . import registry as _reg
 from . import training_monitor as _tm
 
@@ -71,7 +72,25 @@ def local_snapshot() -> dict:
     identity + HBM so the cluster view has no holes."""
     mon = _tm.active_monitor()
     snap = mon.snapshot() if mon is not None else {}
+    led = _goodput.active_ledger()
+    if led is not None:
+        g = led.snapshot()
+        life = g["lifetime"]
+        goodput_row = {
+            "goodput": round(float(life["goodput"]), 6),
+            "goodput_wall_s": round(float(life["wall_s"]), 3),
+            "goodput_compute_s": round(
+                float(life["phases"]["compute"]), 3),
+            "lost_work_s": round(float(life["phases"]["lost_work"]), 3),
+            "lost_steps": int(life["lost_steps"]),
+            "resumes": int(life["resumes"]),
+        }
+    else:
+        goodput_row = {}
     return {
+        # per-rank lifetime goodput (empty when the ledger is off): the
+        # fleet aggregate in clusterz_payload is wall-weighted over these
+        **goodput_row,
         "rank": _flight._safe_rank(),
         "world": _flight._safe_world(),
         "pid": os.getpid(),
@@ -181,6 +200,27 @@ def detect_stragglers(by_rank, threshold=None):
     return out, median
 
 
+def _fleet_goodput(by_rank) -> dict | None:
+    """Wall-weighted fleet goodput over the ranks reporting a ledger
+    row: sum(compute) / sum(wall) is the job's aggregate ratio (a
+    per-rank mean would let a short-lived rank swing the answer).
+    None when no rank runs a ledger."""
+    rows = [s for s in by_rank.values() if "goodput_wall_s" in s]
+    if not rows:
+        return None
+    wall = sum(float(s.get("goodput_wall_s", 0.0)) for s in rows)
+    compute = sum(float(s.get("goodput_compute_s", 0.0)) for s in rows)
+    return {
+        "ranks_reporting": len(rows),
+        "wall_s": round(wall, 3),
+        "compute_s": round(compute, 3),
+        "goodput": round(compute / wall, 6) if wall > 0 else 0.0,
+        "lost_work_s": round(
+            sum(float(s.get("lost_work_s", 0.0)) for s in rows), 3),
+        "resumes": sum(int(s.get("resumes", 0)) for s in rows),
+    }
+
+
 def clusterz_payload(timeout_s=5.0, channel=None, threshold=None) -> dict:
     """The ``/clusterz`` endpoint body: publish this rank's snapshot,
     collect every peer's, run straggler detection, and record the verdict
@@ -200,6 +240,7 @@ def clusterz_payload(timeout_s=5.0, channel=None, threshold=None) -> dict:
         "median_step_ms": round(median, 3),
         "straggler_threshold": thr,
         "stragglers": stragglers,
+        "fleet_goodput": _fleet_goodput(by_rank),
     }
     if stragglers or missing:
         _flight.record_event(

@@ -24,6 +24,9 @@ ports; serving-scale TPU jobs (Gemma-on-Cloud-TPU ops runbooks) expect a
   servers' scrape route (one target path fleet-wide).
 - ``/sloz``          — error-budget burn per installed SLO
   (monitor.slo): fast/slow window burn rates, alert state.
+- ``/goodputz``      — the lifetime training goodput ledger
+  (monitor.goodput): exclusive phase seconds, goodput ratio,
+  lost-work/resume accounting, conservation check.
 
 Loopback-bound on purpose: the debug surface exposes run internals, so
 reaching it from outside the host goes through whatever port-forwarding
@@ -95,6 +98,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _routes(self):
         from . import cluster as _cluster
         from . import cost_model as _cost
+        from . import goodput as _goodput
         from . import slo as _slo
         from .export import PROMETHEUS_CONTENT_TYPE, prometheus_text
 
@@ -109,6 +113,9 @@ class _Handler(BaseHTTPRequestHandler):
             "/sloz": lambda: (
                 json.dumps(_slo.sloz_payload(), indent=1, default=str),
                 "application/json"),
+            "/goodputz": lambda: (
+                json.dumps(_goodput.goodputz_payload(), indent=1,
+                           default=str), "application/json"),
             "/flightrecorder": lambda: (
                 json.dumps(_flight.get_recorder().snapshot(reason="debugz"),
                            indent=1, default=str), "application/json"),

@@ -250,6 +250,12 @@ def export_merged_chrome_trace(path, device_trace_dir=None) -> str:
     # the tail-sampled traces ride along: a p99 outlier's span tree
     # lands next to the host/device timeline it happened inside
     events.extend(_retained_trace_events(host))
+    # goodput phase track (monitor.goodput): same perf_counter clock
+    # family as the host spans, so no re-basing — a checkpoint stall or
+    # lost-work replay reads directly against dispatch/kernel occupancy
+    from . import goodput as _goodput
+
+    events.extend(_goodput.chrome_events())
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

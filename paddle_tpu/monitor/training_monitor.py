@@ -25,6 +25,7 @@ import weakref
 from .. import profiler
 from ..flags import flag
 from . import cost_model as _cost
+from . import goodput as _goodput
 from . import registry as _reg
 from . import tracing as _tracing
 
@@ -45,8 +46,21 @@ def active_monitor():
 def record_input_wait_ms(ms: float):
     """Account time a consumer spent blocked waiting on input (called by
     the DataLoader/prefetcher wait paths); feeds the monitor's
-    input-wait ratio."""
-    _reg.gauge("io/input_wait_ms").add(float(ms))
+    input-wait ratio and the goodput ledger's ``input_wait`` phase.
+
+    The canonical series is the COUNTER ``io/input_wait_ms_total``
+    (monotone accumulation — the prometheus type the add-only semantics
+    always were); the same value still mirrors into the legacy gauge
+    ``io/input_wait_ms`` because external readers (kernel smoke, fused-
+    kernel tests) fetch that name by kind — a same-name kind migration
+    would TypeError at every such site."""
+    ms = float(ms)
+    _reg.counter("io/input_wait_ms_total").inc(ms)
+    # deprecated back-compat alias; remove once nothing reads the gauge
+    _reg.gauge("io/input_wait_ms").add(ms)
+    led = _goodput.active_ledger()
+    if led is not None:
+        led.note_phase("input_wait", ms / 1e3)
 
 
 def _cache_rate(hits, misses):
@@ -62,9 +76,10 @@ def _fmt_util(v: float) -> str:
 
 
 class _StepSpan:
-    def __init__(self, mon, examples):
+    def __init__(self, mon, examples, global_step=None):
         self._mon = mon
         self._examples = examples
+        self._global_step = global_step
 
     def __enter__(self):
         self._mon.step_begin()
@@ -72,7 +87,8 @@ class _StepSpan:
 
     def __exit__(self, *exc):
         if exc[0] is None:
-            self._mon.step_end(examples=self._examples)
+            self._mon.step_end(examples=self._examples,
+                               global_step=self._global_step)
         else:
             # a failed step must not pollute the aggregates OR leave the
             # begun-state armed (a stale _t_begin would let a later bare
@@ -108,6 +124,9 @@ class TrainingMonitor:
         self._step_ms = _reg.histogram(f"monitor/{name}/step_ms")
         self._examples = _reg.counter(f"monitor/{name}/examples")
         self._steps = _reg.counter(f"monitor/{name}/steps")
+        # lifetime goodput ledger: one env var (FLAGS_goodput_dir) turns
+        # it on for any monitored run; None when the flag is unset
+        _goodput.maybe_start_from_flags()
         # jax compile events (registry-fed by the jax.monitoring
         # listeners) expose retrace storms in the periodic line
         _reg.install_jax_listeners()
@@ -128,7 +147,7 @@ class TrainingMonitor:
             "jit_hit": c.get("executor::jit_cache_hit", 0),
             "jit_miss": c.get("executor::jit_cache_miss", 0),
             "compiles": self._compile_events(),
-            "input_wait_ms": _reg.gauge("io/input_wait_ms").value,
+            "input_wait_ms": _reg.counter("io/input_wait_ms_total").value,
             # executed-work ledger (cost_model.note_run): differencing it
             # over the window gives the window's FLOPs/bytes for MFU
             "flops": _reg.counter("cost/executed_flops").value,
@@ -153,11 +172,16 @@ class TrainingMonitor:
 
     # -- step API -----------------------------------------------------------
 
-    def step(self, examples=None):
-        """Context manager wrapping one training step."""
-        return _StepSpan(self, examples)
+    def step(self, examples=None, global_step=None):
+        """Context manager wrapping one training step. ``global_step``
+        (the run's global step index, surviving restarts) drives the
+        goodput ledger's lost-work attribution on resume."""
+        return _StepSpan(self, examples, global_step=global_step)
 
     def step_begin(self):
+        led = _goodput.active_ledger()
+        if led is not None:
+            led.step_begin()
         self._span = profiler.RecordEvent(
             f"monitor::{self.name}::step").begin()
         # step-scoped trace: everything the step touches (executor runs,
@@ -180,14 +204,29 @@ class TrainingMonitor:
 
     def step_abort(self):
         """Discard an in-flight step (the body raised): drop its span,
-        disarm the begin-state, and count it separately."""
+        disarm the begin-state, and count it separately. The step's wall
+        time does NOT vanish — it lands in the goodput ledger's
+        ``aborted`` badput, and a flight event names the step, so an
+        abort storm is visible in both the lifetime accounting and the
+        post-mortem dump."""
+        dt_ms = (0.0 if self._t_begin is None
+                 else (time.perf_counter() - self._t_begin) * 1e3)
         self._t_begin = None
         if self._span is not None:
             self._span = None  # never end()ed: the span is not recorded
         self._trace_end(error="step aborted")
         _reg.counter(f"monitor/{self.name}/aborted_steps").inc()
+        _reg.counter(f"monitor/{self.name}/aborted_step_ms").inc(dt_ms)
+        led = _goodput.active_ledger()
+        if led is not None:
+            led.step_abort()
+        from . import flight_recorder as _flight
 
-    def step_end(self, examples=None):
+        _flight.record_event(
+            "step_aborted", monitor=self.name,
+            step=self.step_count + 1, ms=round(dt_ms, 3))
+
+    def step_end(self, examples=None, global_step=None):
         """Close the step; returns the log line if this step emitted one
         (None otherwise)."""
         if self._t_begin is None:
@@ -198,6 +237,13 @@ class TrainingMonitor:
             self._span.end()
             self._span = None
         self._trace_end()
+        led = _goodput.active_ledger()
+        if led is not None:
+            # global_step stays None when the caller doesn't thread one:
+            # lost-work attribution needs a restart-surviving index, and
+            # guessing from the per-life step_count would misfile fresh
+            # post-resume steps as recomputation
+            led.step_commit(global_step=global_step)
         self.step_count += 1
         self._steps.inc()
         self._step_ms.observe(dt_ms)
@@ -271,6 +317,12 @@ class TrainingMonitor:
         )
         self.last_line = line
         self._log_fn(line)
+        # the lifetime ledger reports on the same cadence: one window
+        # line (rates) + one goodput line (where the wall time went)
+        led = _goodput.active_ledger()
+        if led is not None:
+            led.flush_metrics()
+            led.emit_line(self._log_fn)
         self._reset_window()
         return line
 
@@ -296,6 +348,10 @@ class TrainingMonitor:
             self.step_abort()
         interval = (self._interval if self._interval is not None
                     else flag("monitor_interval"))
-        if self._win_steps and interval:
-            return self._emit()
-        return None
+        line = self._emit() if (self._win_steps and interval) else None
+        # final ledger sync even when no window line flushed: the last
+        # partial window's seconds must not be lost on a short run
+        led = _goodput.active_ledger()
+        if led is not None:
+            led.close()
+        return line

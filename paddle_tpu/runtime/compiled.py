@@ -254,16 +254,21 @@ class CompiledStore:
         under the per-entry lock: a second worker on the same cold
         signature waits for the executable instead of recompiling."""
         from ..monitor import cost_model as _cost
+        from ..monitor import goodput as _goodput
 
         with entry.lock:
             if entry.attempted:
                 return
             try:
-                with _sched_capture() as cap:
+                # trace + XLA compile are badput in the goodput ledger's
+                # taxonomy: a span here covers both, and the ledger
+                # deducts it from the enclosing step frame's compute
+                with _goodput.span("compile"), _sched_capture() as cap:
                     lowered = entry.jitted.lower(*args)
                 # the trace just ran: record the schedules it baked in
                 entry.resolved_schedules = dict(cap.log or {})
-                entry.aot = lowered.compile()
+                with _goodput.span("compile"):
+                    entry.aot = lowered.compile()
                 entry.record = _cost.capture(
                     self.cost_label, lowered=lowered, compiled=entry.aot,
                     key=entry.cache_key, cache_key=entry.cache_key,

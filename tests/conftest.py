@@ -76,5 +76,6 @@ def _reset_telemetry():
     _memplan.reset_accuracy_records()
     monitor.tracing.reset_store()
     monitor.cluster.stop_publisher()
+    monitor.goodput.reset_ledger()
     monitor.flight_recorder.reset_recorder()
     monitor.flight_recorder.stop_watchdog()

@@ -1,0 +1,140 @@
+"""Bench trend check: compare the two newest BENCH_r*.json and warn on
+>20% regressions of headline rows.
+
+Each BENCH_r*.json (written by the growth driver around ``bench.py``)
+has the shape ``{"n", "cmd", "rc", "tail", "parsed"}`` where ``parsed``
+nests headline rows — dicts carrying a ``metric`` name and a numeric
+``value`` (throughput: higher is better) — at arbitrary depth
+(``secondary``, ``executor_dispatch``, ...). This tool walks both
+trees, pairs rows by metric name, and reports the delta.
+
+Exit status is 0 even when regressions are found (a trend WARNING, not
+a gate) unless ``--strict`` is passed, so CI can surface drift without
+flaking on noisy CPU runners.
+
+Usage::
+
+    python tools/bench_trend.py [--dir REPO] [--threshold 0.20] [--strict]
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_NUM_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def find_latest_pair(directory):
+    """Return (older_path, newer_path) of the two highest-numbered
+    BENCH_r*.json, or None if fewer than two exist."""
+    runs = []
+    for path in glob.glob(os.path.join(directory, "BENCH_r*.json")):
+        m = _NUM_RE.search(os.path.basename(path))
+        if m:
+            runs.append((int(m.group(1)), path))
+    runs.sort()
+    if len(runs) < 2:
+        return None
+    return runs[-2][1], runs[-1][1]
+
+
+def headline_rows(parsed):
+    """Flatten ``parsed`` into {metric_name: value} over every nested
+    dict that carries a ``metric`` name and a numeric ``value``."""
+    rows = {}
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return
+        name = node.get("metric")
+        val = node.get("value")
+        if isinstance(name, str) and isinstance(val, (int, float)):
+            rows.setdefault(name, float(val))
+        for v in node.values():
+            walk(v)
+
+    walk(parsed)
+    return rows
+
+
+def lower_is_better(name):
+    """Overhead/latency-style rows regress UPWARD; throughput rows
+    regress downward."""
+    n = name.lower()
+    return ("overhead" in n or n.endswith("_pct") or n.endswith("_ms")
+            or n.endswith("_us") or "latency" in n)
+
+
+def compare(old, new, threshold=0.20):
+    """Return (report_lines, regressions) comparing two parsed trees."""
+    old_rows = headline_rows(old.get("parsed") or {})
+    new_rows = headline_rows(new.get("parsed") or {})
+    lines, regressions = [], []
+    for name in sorted(set(old_rows) | set(new_rows)):
+        if name not in old_rows:
+            lines.append(f"  NEW      {name} = {new_rows[name]:g}")
+            continue
+        if name not in new_rows:
+            lines.append(f"  DROPPED  {name} (was {old_rows[name]:g})")
+            regressions.append((name, old_rows[name], None))
+            continue
+        o, n = old_rows[name], new_rows[name]
+        if o <= 0:
+            lines.append(f"  SKIP     {name}: non-positive baseline {o:g}")
+            continue
+        delta = (n - o) / o
+        worse = delta >= threshold if lower_is_better(name) \
+            else delta <= -threshold
+        better = delta <= -threshold if lower_is_better(name) \
+            else delta >= threshold
+        tag = "ok"
+        if worse:
+            tag = "REGRESSED"
+            regressions.append((name, o, n))
+        elif better:
+            tag = "improved"
+        lines.append(f"  {tag:<9}{name}: {o:g} -> {n:g} ({delta:+.1%})")
+    return lines, regressions
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.20,
+                    help="relative drop that counts as a regression")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when regressions are found")
+    args = ap.parse_args(argv)
+
+    pair = find_latest_pair(args.dir)
+    if pair is None:
+        print("[bench-trend] fewer than two BENCH_r*.json runs; "
+              "nothing to compare")
+        return 0
+    old_path, new_path = pair
+    with open(old_path) as f:
+        old = json.load(f)
+    with open(new_path) as f:
+        new = json.load(f)
+    print(f"[bench-trend] {os.path.basename(old_path)} -> "
+          f"{os.path.basename(new_path)} "
+          f"(threshold {args.threshold:.0%})")
+    lines, regressions = compare(old, new, args.threshold)
+    for line in lines:
+        print(line)
+    if regressions:
+        for name, o, n in regressions:
+            where = "dropped" if n is None else f"{o:g} -> {n:g}"
+            print(f"[bench-trend] WARNING: {name} regressed "
+                  f">{args.threshold:.0%} ({where})")
+        return 1 if args.strict else 0
+    print("[bench-trend] no headline regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

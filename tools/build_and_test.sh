@@ -48,6 +48,11 @@
 #             wedged backend paging via multi-window burn rate with a
 #             slo_burn flight event, /fleetz quantiles equal to the
 #             pooled-histogram golden, the scaler reading the burn)
+#           + goodput smoke (training goodput ledger: >= 0.8 goodput
+#             steady-state with 2% phase-conservation, kill -9 mid-save
+#             resume continuing the lifetime ledger with recomputation
+#             charged to lost_work) + bench trend (two newest
+#             BENCH_r*.json, >20% headline regressions warned)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -175,6 +180,16 @@ case "$MODE" in
     # p50/p99 exactly equal to the hand-merged pooled histogram, and
     # the autoscaler reading the confirmed burn as up-pressure
     JAX_PLATFORMS=cpu python tools/slo_smoke.py
+    # goodput smoke: training goodput ledger — uninterrupted run at
+    # goodput >= 0.8 with phase seconds summing to wall within 2%
+    # (conservation), then a kill -9 inside a checkpoint save with the
+    # resume continuing the lifetime ledger from the GOODPUT.json
+    # sidecar (lifetime wall > post-restart wall) and the recomputed
+    # steps charged to lost_work, not compute
+    JAX_PLATFORMS=cpu python tools/goodput_smoke.py
+    # bench trend: two newest BENCH_r*.json compared, >20% headline
+    # regressions warned (non-fatal: CPU-runner noise)
+    python tools/bench_trend.py
     ;;
   *)
     echo "unknown mode: $MODE (fast|full|bench|check)" >&2

@@ -1,0 +1,148 @@
+"""Goodput-ledger smoke: the conservation + restart-continuity contract
+exercised by a REAL kill -9.
+
+Drives tests/fixtures/goodput_trainer.py (checkpointing trainer with a
+controlled phase mix) through two runs:
+
+  run 1  uninterrupted — asserts the steady-state contract: goodput >=
+         0.8, phase seconds sum to measured wall within 2%
+         (conservation), zero lost work, a published GOODPUT.json
+         sidecar, and a parseable [monitor:goodput] line.
+  run 2  FLAGS_fault_injection kills the process -9 INSIDE the 2nd
+         checkpoint save (the torn-save window), then a relaunch
+         resumes from the last intact snapshot — asserts the ledger
+         CONTINUED: sidecar loaded, lifetime wall > post-restart wall,
+         the recomputed steps charged to lost_work (not compute),
+         lost_work > 0, lifetime totals monotone across the resume, and
+         conservation still within 2% on the chaos run.
+
+Wired into `make goodput-smoke` and tools/build_and_test.sh check.
+"""
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "goodput_trainer.py")
+
+GOODPUT_LINE = re.compile(
+    r"\[monitor:goodput\] wall_s=[\d.]+ goodput=[\d.eE+-]+ "
+    r"compute_s=[\d.]+ input_wait_s=[\d.]+ compile_s=[\d.]+ "
+    r"checkpoint_s=[\d.]+ restore_s=[\d.]+ renegotiate_s=[\d.]+ "
+    r"lost_work_s=[\d.]+ aborted_s=[\d.]+ idle_s=[\d.]+ "
+    r"steps=\d+ lost_steps=\d+ resumes=\d+")
+
+
+def run_fixture(root, extra_env=None, expect_kill=False, timeout=240):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["GOODPUT_CKPT_DIR"] = os.path.join(root, "ckpt")
+    env["FLAGS_goodput_dir"] = os.path.join(root, "goodput")
+    # publish the sidecar on every commit: the kill window is one step
+    env["FLAGS_goodput_publish_interval_s"] = "0"
+    env.update(extra_env or {})
+    os.makedirs(env["GOODPUT_CKPT_DIR"], exist_ok=True)
+    p = subprocess.run([sys.executable, FIXTURE], env=env,
+                       capture_output=True, text=True, timeout=timeout)
+    if expect_kill:
+        assert p.returncode == -9, (
+            f"expected SIGKILL death, got rc={p.returncode}\n"
+            f"{p.stderr[-2000:]}")
+        return None
+    assert p.returncode == 0, p.stderr[-3000:]
+    line = [l for l in p.stdout.strip().splitlines()
+            if l.startswith("{")][-1]
+    return json.loads(line)
+
+
+def check_conservation(out, label):
+    err = float(out["conservation_error"])
+    assert err <= 0.02, (
+        f"[{label}] phases overrun wall by {err:.1%} (> 2%): "
+        f"{out['phases']}")
+    total = sum(out["phases"].values())
+    assert abs(total - out["wall_s"]) <= 0.02 * out["wall_s"] + 1e-6, (
+        f"[{label}] phase sum {total:.3f}s != wall {out['wall_s']:.3f}s")
+    print(f"[goodput-smoke] {label}: wall={out['wall_s']:.2f}s "
+          f"goodput={out['goodput']:.3f} conservation_err={err:.4f}")
+
+
+def main():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    root = tempfile.mkdtemp(prefix="ptpu_goodput_")
+    try:
+        # -- run 1: uninterrupted steady state ---------------------------
+        d1 = os.path.join(root, "clean")
+        out = run_fixture(d1)
+        check_conservation(out, "run1")
+        assert out["goodput"] >= 0.8, (
+            f"steady-state goodput {out['goodput']:.3f} < 0.8: "
+            f"{out['phases']}")
+        assert out["lost_steps"] == 0 and out["resumes"] == 0, out
+        assert out["phases"]["compute"] > 0
+        assert out["phases"]["input_wait"] > 0, (
+            "input-wait feed never reached the ledger", out["phases"])
+        assert out["phases"]["checkpoint"] > 0, (
+            "sync checkpoint saves left no checkpoint seconds",
+            out["phases"])
+        sidecar = os.path.join(d1, "goodput", "GOODPUT.json")
+        assert os.path.isfile(sidecar), "sidecar never published"
+        glines = [l for l in out["monitor_lines"]
+                  if l.startswith("[monitor:goodput]")]
+        assert glines and all(GOODPUT_LINE.match(l) for l in glines), (
+            "goodput line missing or unparseable", glines)
+        print(f"[goodput-smoke] run1: {len(glines)} parseable "
+              "[monitor:goodput] lines, sidecar published")
+
+        # -- run 2: kill -9 inside the 2nd save, then resume -------------
+        d2 = os.path.join(root, "chaos")
+        run_fixture(d2, expect_kill=True, extra_env={
+            "FLAGS_fault_injection": "kill:point=mid_save,n=2"})
+        assert os.path.isfile(os.path.join(d2, "goodput", "GOODPUT.json")), (
+            "kill run died before any sidecar publication")
+        pre = json.load(open(os.path.join(d2, "goodput", "GOODPUT.json")))
+        pre_life_wall = float(pre["body"]["wall_s"])
+        pre_steps = int(pre["body"]["steps"])
+        print(f"[goodput-smoke] run2: killed -9 mid-save; sidecar holds "
+              f"{pre_steps} steps / {pre_life_wall:.2f}s")
+
+        out2 = run_fixture(d2)
+        check_conservation(out2, "run2-resume")
+        assert out2["resumed_from"] >= 0 and out2["sidecar_loaded"], out2
+        assert out2["resumes"] == 1, out2
+        # the ledger CONTINUED: lifetime accounting spans both lives
+        life = out2["lifetime"]
+        assert life["wall_s"] > out2["wall_s"], (
+            "lifetime wall did not extend past the post-restart wall",
+            life["wall_s"], out2["wall_s"])
+        assert life["wall_s"] >= pre_life_wall, "lifetime wall regressed"
+        assert life["steps"] >= pre_steps + out2["steps_run"] - \
+            out2["lost_steps"] - 1 or life["steps"] > pre_steps, (
+            "lifetime steps not monotone", life, pre_steps)
+        # recomputation landed in lost_work, NOT compute: exactly the
+        # steps committed after the manifest the resume loaded
+        expected_lost = out2["max_committed_step"] - out2["resumed_from"]
+        assert out2["lost_steps"] >= 1, out2
+        assert out2["phases"]["lost_work"] > 0, out2["phases"]
+        assert out2["lost_work_priced_s"] > 0, out2
+        assert out2["lost_steps"] <= expected_lost, (
+            "more lost steps than the recompute window", out2)
+        print(f"[goodput-smoke] run2-resume: resumed_from="
+              f"{out2['resumed_from']} lost_steps={out2['lost_steps']} "
+              f"lost_work_s={out2['phases']['lost_work']:.3f} "
+              f"priced={out2['lost_work_priced_s']:.3f}s "
+              f"lifetime_wall={life['wall_s']:.2f}s")
+        print("[goodput-smoke] PASS: goodput >= 0.8 steady-state, 2% "
+              "conservation on both runs, kill -9 resume continued the "
+              "lifetime ledger with recomputation charged to lost_work")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -52,6 +52,7 @@ MODULES = [
     "paddle_tpu.analysis",
     "paddle_tpu.tuning",
     "paddle_tpu.monitor",
+    "paddle_tpu.monitor.goodput",
     "paddle_tpu.monitor.slo",
 ]
 
