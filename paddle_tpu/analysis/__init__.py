@@ -67,6 +67,7 @@ from .optimizer import (  # noqa: F401
     OptResult,
     PassManager,
     PassStats,
+    measure_pass_deltas,
     optimize_program,
     optimizer_passes,
     optimizer_stats,
@@ -87,6 +88,7 @@ __all__ = [
     "OptResult",
     "PassManager",
     "PassStats",
+    "measure_pass_deltas",
     "optimize_program",
     "optimizer_passes",
     "optimizer_stats",

@@ -729,3 +729,20 @@ define_flag("goodput_slo_target", 0.0,
 define_flag("use_fused_conv_bn", True,
             "fused pallas conv+batch_norm+relu on TPU for the vision "
             "path (jnp fallback elsewhere; identical op sequence)")
+
+# monitor/opprof.py profile_program — per-op replay measurement
+# discipline: each op's jitted kernel is warmed `opprof_warmup` times,
+# then timed best-of-`opprof_repeats` behind block_until_ready. Raise
+# repeats for tighter numbers on a noisy host; the smoke/CI defaults
+# keep a full BERT-smoke replay under a second on the CPU runner.
+define_flag("opprof_warmup", 1,
+            "per-op replay profiler: warmup runs before timing each op")
+define_flag("opprof_repeats", 3,
+            "per-op replay profiler: timed runs per op (best-of-N)")
+
+# monitor/opprof.py top_ops / profilez_payload — how many ops the
+# /statz top-K table and the default /profilez view keep (the full
+# per-op table stays in the stored profile; /profilez?topk=N overrides
+# per request).
+define_flag("opprof_topk", 10,
+            "top-K ops by device time shown on /statz and /profilez")

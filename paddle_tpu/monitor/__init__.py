@@ -136,6 +136,15 @@ from .slo import (  # noqa: F401
     install_slo,
     sloz_payload,
 )
+from . import opprof  # noqa: F401
+from .opprof import (  # noqa: F401
+    TIME_ACCURACY_ENVELOPE,
+    attribute_trace,
+    op_scope_name,
+    parse_op_scope,
+    profile_program,
+    profilez_payload,
+)
 from . import debug_server  # noqa: F401
 from .flight_recorder import (  # noqa: F401
     FlightRecorder,
@@ -166,6 +175,8 @@ __all__ = [
     "tracing", "SpanContext", "TraceStore", "annotate",
     "current_context", "current_span", "format_traceparent",
     "parse_traceparent", "start_span", "start_trace",
+    "opprof", "TIME_ACCURACY_ENVELOPE", "op_scope_name", "parse_op_scope",
+    "attribute_trace", "profile_program", "profilez_payload",
     "flight_recorder", "debug_server",
     "slo", "SLO", "SLOEngine", "install_slo", "sloz_payload",
     "current_burn",

@@ -128,7 +128,8 @@ class CostRecord:
                  "argument_bytes", "output_bytes", "temp_bytes",
                  "alias_bytes", "peak_hbm_bytes", "partial", "meta",
                  "runs", "created_t", "predicted_peak_bytes",
-                 "plan_accuracy")
+                 "plan_accuracy", "predicted_op_us", "measured_op_us",
+                 "time_accuracy")
 
     def __init__(self, key, label, cost, mem, meta):
         self.key = key
@@ -160,6 +161,12 @@ class CostRecord:
         # record's arg+out+temp-alias)
         self.predicted_peak_bytes = None
         self.plan_accuracy = None
+        # closed by monitor.opprof.profile_program: calibrated-roofline
+        # predicted per-op µs vs the replay-measured total (the time-
+        # accuracy analog of plan_accuracy; ratio, 1.0 = perfect)
+        self.predicted_op_us = None
+        self.measured_op_us = None
+        self.time_accuracy = None
 
     def to_dict(self) -> dict:
         return {
@@ -173,6 +180,10 @@ class CostRecord:
             "predicted_peak_bytes": self.predicted_peak_bytes,
             "plan_accuracy": (round(self.plan_accuracy, 4)
                               if self.plan_accuracy is not None else None),
+            "predicted_op_us": self.predicted_op_us,
+            "measured_op_us": self.measured_op_us,
+            "time_accuracy": (round(self.time_accuracy, 4)
+                              if self.time_accuracy is not None else None),
             "arithmetic_intensity": (
                 self.flops / self.bytes_accessed
                 if self.bytes_accessed else 0.0),

@@ -27,6 +27,9 @@ ports; serving-scale TPU jobs (Gemma-on-Cloud-TPU ops runbooks) expect a
 - ``/goodputz``      — the lifetime training goodput ledger
   (monitor.goodput): exclusive phase seconds, goodput ratio,
   lost-work/resume accounting, conservation check.
+- ``/profilez``      — per-op device-time profiles (monitor.opprof):
+  replay-measured op table with MFU/roofline per op, trace-attribution
+  coverage, time-accuracy closure; ``?program=``/``?topk=`` views.
 
 Loopback-bound on purpose: the debug surface exposes run internals, so
 reaching it from outside the host goes through whatever port-forwarding
@@ -142,8 +145,19 @@ class _Handler(BaseHTTPRequestHandler):
         routes = self._routes()
         try:
             if path in ("/", "/debugz", "/index"):
-                body = _index_text(list(routes) + ["/tracez"])
+                body = _index_text(list(routes) + ["/tracez", "/profilez"])
                 ctype, status = "text/plain", 200
+            elif path == "/profilez":
+                # query-carrying route (?program=, ?topk=): the per-op
+                # replay/attribution profiles (monitor.opprof) — 404 for
+                # an unknown program name keeps its real status
+                from . import opprof as _opprof
+                from . import tracing as _tracing
+
+                status, payload = _opprof.profilez_payload(
+                    _tracing.parse_query(self.path))
+                body = json.dumps(payload, indent=1, default=str)
+                ctype = "application/json"
             elif path == "/tracez":
                 # query-carrying route (?id=, ?format=chrome): handled
                 # outside the zero-arg routes table so the 404 for a

@@ -256,6 +256,13 @@ def export_merged_chrome_trace(path, device_trace_dir=None) -> str:
     from . import goodput as _goodput
 
     events.extend(_goodput.chrome_events())
+    # per-op replay tracks (monitor.opprof): one synthetic thread per
+    # stored profile, ops laid end-to-end at measured durations —
+    # relative layout, so durations/shares/order are the signal, not
+    # absolute alignment against the host clock
+    from . import opprof as _opprof
+
+    events.extend(_opprof.chrome_events())
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)

@@ -129,21 +129,48 @@ def record_event(name):
         yield
 
 
+def _note_double_start(**fields):
+    bump_counter("profiler::double_start")
+    try:
+        from ..monitor import flight_recorder as _flight
+
+        _flight.record_event("profiler_double_start", **fields)
+    except Exception:
+        pass
+
+
 def start_profiler(state="All", tracer_option="Default", trace_dir=None):
     """EnableProfiler equivalent. state: CPU | GPU | All (accepted for
-    compat; device tracing starts whenever state != CPU)."""
-    _enabled[0] = True
-    if state != "CPU":
-        import jax
+    compat; device tracing starts whenever state != CPU).
 
-        d = trace_dir or "/tmp/paddle_tpu_trace"
-        os.makedirs(d, exist_ok=True)
-        try:
-            jax.profiler.start_trace(d)
-            _device_trace_dir[0] = d
-            _last_device_trace_dir[0] = d
-        except Exception:
-            _device_trace_dir[0] = None  # already tracing / unsupported
+    Idempotent under a live trace: a second start used to let
+    ``jax.profiler.start_trace`` raise out of the training loop (and the
+    blanket except then wiped the live dir, orphaning the first trace so
+    ``stop_profiler`` could never close it). Now a double start is a
+    no-op flagged with a ``profiler_double_start`` flight event +
+    ``profiler::double_start`` counter, and the original trace keeps its
+    owner."""
+    _enabled[0] = True
+    if state == "CPU":
+        return
+    import jax
+
+    if _device_trace_dir[0] is not None:
+        _note_double_start(trace_dir=_device_trace_dir[0])
+        return
+    d = trace_dir or "/tmp/paddle_tpu_trace"
+    os.makedirs(d, exist_ok=True)
+    try:
+        jax.profiler.start_trace(d)
+        _device_trace_dir[0] = d
+        _last_device_trace_dir[0] = d
+    except RuntimeError:
+        # a trace this module does not own is live (e.g. opprof's replay
+        # trace, or user code driving jax.profiler directly): same no-op
+        # contract, and never raise out of the training loop
+        _note_double_start(trace_dir=d, owner="external")
+    except Exception:
+        _device_trace_dir[0] = None  # device tracing unsupported
 
 
 def stop_profiler(sorted_key=None, profile_path=None, file=None):

@@ -36,6 +36,8 @@ from __future__ import annotations
 import hashlib
 import threading
 
+import jax
+
 from ..flags import flag
 from ..profiler import bump_counter
 
@@ -262,8 +264,14 @@ class CompiledStore:
             try:
                 # trace + XLA compile are badput in the goodput ledger's
                 # taxonomy: a span here covers both, and the ledger
-                # deducts it from the enclosing step frame's compute
-                with _goodput.span("compile"), _sched_capture() as cap:
+                # deducts it from the enclosing step frame's compute.
+                # The named_scope prefixes every op stamp the traced
+                # function emits (executor._exec_one's opprof stamps)
+                # with this store's label, so a device-trace row reads
+                # executor/matmul#0/3/... and attribution can tell which
+                # runtime (executor, serving replica, ...) issued the op.
+                with _goodput.span("compile"), _sched_capture() as cap, \
+                        jax.named_scope(self.label):
                     lowered = entry.jitted.lower(*args)
                 # the trace just ran: record the schedules it baked in
                 entry.resolved_schedules = dict(cap.log or {})

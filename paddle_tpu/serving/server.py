@@ -20,6 +20,9 @@ threads) exposing:
   math, extended to serving.
 - ``GET /metrics`` — the Prometheus dump (every ``serving/*`` metric
   rides the same exporter the training stack uses).
+- ``GET /profilez`` — per-op device-time profiles (monitor.opprof):
+  replay-measured op table, attribution coverage, time-accuracy
+  closure; ``?program=``/``?topk=`` views. Served by both server kinds.
 
 ``stop(drain=True)`` is a graceful drain: new work is refused (503),
 queued work is flushed through the replicas, waiting HTTP handlers get
@@ -154,6 +157,19 @@ def _ir_opt_stats() -> dict:
             "cache_miss": int(c.get("ir_opt::cache_miss", 0)),
         },
     }
+
+
+def _opprof_stats() -> dict:
+    """The /statz per-op profiler block: stored replay profiles + the
+    top-K ops by measured device time (monitor.opprof) — a reader sees
+    which ops actually dominate the programs this process serves, with
+    the time-accuracy closure next to the predicted cost sheets."""
+    from ..monitor import opprof as _opprof
+
+    try:
+        return _opprof.opprof_stats()
+    except Exception:  # a broken profile store must not 500 /statz
+        return {"programs": [], "latest": None, "top_ops": []}
 
 
 def _stats_readers():
@@ -343,6 +359,12 @@ class _BaseHandler(BaseHTTPRequestHandler):
             status, payload = _tracing.tracez_payload(
                 _tracing.parse_query(self.path))
             self._reply(status, payload)
+        elif path == "/profilez":
+            from ..monitor import opprof as _opprof
+
+            status, payload = _opprof.profilez_payload(
+                _tracing.parse_query(self.path))
+            self._reply(status, payload)
         elif path == "/metrics":
             from ..monitor.export import (
                 PROMETHEUS_CONTENT_TYPE,
@@ -382,8 +404,8 @@ class _ServingHandler(_BaseHandler):
             self._reply(200, {
                 "service": "paddle_tpu serving",
                 "routes": ["/predict (POST)", "/healthz", "/statz",
-                           "/loadz", "/histz", "/tracez", "/metrics",
-                           "/metricz", "/sloz"]})
+                           "/loadz", "/histz", "/tracez", "/profilez",
+                           "/metrics", "/metricz", "/sloz"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -635,6 +657,8 @@ class InferenceServer:
             "tuned_kernels": _tuned_kernels(),
             # which IR-optimizer passes rewrote the served programs
             "ir_opt": _ir_opt_stats(),
+            # per-op replay profiles + top-K ops by measured device time
+            "opprof": _opprof_stats(),
         }
         _, out["utilization"] = _utilization(self._t0, self._flops0, val)
         out["utilization"]["window"] = _utilization_window(
@@ -666,7 +690,8 @@ class _GenerationHandler(_BaseHandler):
                 "kind": self._srv.kind,
                 "routes": [f"{_KIND_ROUTES[self._srv.kind]} (POST)",
                            "/healthz", "/statz", "/loadz", "/histz",
-                           "/tracez", "/metrics", "/metricz", "/sloz"]})
+                           "/tracez", "/profilez", "/metrics",
+                           "/metricz", "/sloz"]})
         else:
             self._reply(404, {"error": f"unknown path {path!r}"})
 
@@ -1219,5 +1244,7 @@ class GenerationServer:
             "tuned_kernels": _tuned_kernels(),
             # which IR-optimizer passes rewrote the served programs
             "ir_opt": _ir_opt_stats(),
+            # per-op replay profiles + top-K ops by measured device time
+            "opprof": _opprof_stats(),
         }
         return out

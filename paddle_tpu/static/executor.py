@@ -36,6 +36,7 @@ from ..flags import flag, watch_flag
 from ..framework import random as _random
 from ..monitor import flight_recorder as _flight
 from ..monitor import tracing as _tracing
+from ..monitor.opprof import op_scope_name as _op_scope
 from ..runtime.compiled import CompiledStore
 from ..framework.place import Place, _default_place
 from ..framework.tensor import Tensor
@@ -532,10 +533,10 @@ class _BlockRunner:
 
     def exec_ops(self, op_list, env, base_key, written_persist, block=None,
                  iter_idx=None):
-        for op in op_list:
+        for op_index, op in enumerate(op_list):
             try:
                 self._exec_one(op, env, base_key, written_persist, block,
-                               iter_idx)
+                               iter_idx, op_index)
             except Exception as e:
                 # PADDLE_ENFORCE behavior (platform/enforce.h): append the
                 # failing op's context to the message, preserving the
@@ -554,7 +555,7 @@ class _BlockRunner:
                 raise
 
     def _exec_one(self, op, env, base_key, written_persist, block=None,
-                  iter_idx=None):
+                  iter_idx=None, op_index=None):
             in_names = op_in_names(op)
             out_names = op_out_names(op)
             attrs = {k: v for k, v in op.attrs.items() if not k.startswith("__")}
@@ -625,8 +626,15 @@ class _BlockRunner:
                 # named_scope → HLO metadata, so device profiles attribute
                 # fused kernels back to the framework op; the RecordEvent
                 # costs only at trace time (once per compile) and gives the
-                # reference-style per-op host table (profiler.h:126)
-                with RecordEvent(f"op::{op.type}"), jax.named_scope(op.type):
+                # reference-style per-op host table (profiler.h:126). The
+                # scope carries the STAMPED identity op.type#<block>/<index>
+                # (monitor/opprof grammar) so a trace row maps back to one
+                # Program op, not just an op type — same-type ops in
+                # different blocks stay distinguishable.
+                scope_name = op.type if op_index is None else _op_scope(
+                    op.type, block.idx if block is not None else 0, op_index)
+                with RecordEvent(f"op::{op.type}"), \
+                        jax.named_scope(scope_name):
                     out = fn_k(*arrays, **f_attrs)
                 results = list(out) if isinstance(out, (tuple, list)) else [out]
 

@@ -75,6 +75,7 @@ def _reset_telemetry():
 
     _memplan.reset_accuracy_records()
     monitor.tracing.reset_store()
+    monitor.opprof.reset_profiles()
     monitor.cluster.stop_publisher()
     monitor.goodput.reset_ledger()
     monitor.flight_recorder.reset_recorder()

@@ -51,8 +51,13 @@
 #           + goodput smoke (training goodput ledger: >= 0.8 goodput
 #             steady-state with 2% phase-conservation, kill -9 mid-save
 #             resume continuing the lifetime ledger with recomputation
-#             charged to lost_work) + bench trend (two newest
-#             BENCH_r*.json, >20% headline regressions warned)
+#             charged to lost_work)
+#           + opprof smoke (per-op device-time attribution: >= 0.9
+#             stamped-scope coverage + time-accuracy envelope on the
+#             BERT/ResNet/GPT smokes, measured fused-conv win,
+#             /profilez end to end, idle stamping < 1% of dispatch)
+#           + bench trend (two newest BENCH_r*.json, >20% headline
+#             regressions warned)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -187,6 +192,14 @@ case "$MODE" in
     # sidecar (lifetime wall > post-restart wall) and the recomputed
     # steps charged to lost_work, not compute
     JAX_PLATFORMS=cpu python tools/goodput_smoke.py
+    # opprof smoke: per-op device-time attribution — replay profiles of
+    # the BERT/ResNet/GPT smokes with stamped-scope trace coverage
+    # >= 0.9 and per-program time-accuracy inside the documented
+    # envelope, top-op sanity (matmul/conv family leads by FLOPs), the
+    # conv+bn+relu fusion win measured per op (not asserted from
+    # theory), /profilez served end to end, and idle stamping under 1%
+    # of the steady-state dispatch period
+    JAX_PLATFORMS=cpu python tools/opprof_smoke.py
     # bench trend: two newest BENCH_r*.json compared, >20% headline
     # regressions warned (non-fatal: CPU-runner noise)
     python tools/bench_trend.py
