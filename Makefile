@@ -24,7 +24,7 @@ DIST_FLAGS := -n auto --dist loadfile
 endif
 endif
 
-.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke autotune-smoke ir-opt-smoke slo-smoke goodput-smoke opprof-smoke bench-trend
+.PHONY: test test-fast test-seq bench check lint trace-smoke debugz-smoke mfu-smoke serve-smoke gen-smoke router-smoke chaos-smoke tracez-smoke kernel-smoke quant-smoke spec-smoke memplan-smoke autotune-smoke ir-opt-smoke slo-smoke goodput-smoke opprof-smoke paged-smoke bench-trend
 
 lint:  # graphlint gate: pure-AST framework lint, waivers must justify every exception
 	python tools/graphlint.py --check
@@ -91,6 +91,9 @@ goodput-smoke:  # goodput ledger: >=0.8 steady-state, 2% conservation, kill -9 r
 
 opprof-smoke:  # per-op attribution: >=0.9 coverage, time-accuracy envelope, measured fusion win, /profilez, <1% idle
 	JAX_PLATFORMS=cpu python tools/opprof_smoke.py
+
+paged-smoke:  # paged KV: ring parity at bounded compiles, shared-prefix FLOPs+TTFT win, >=1.3x slots at equal HBM, strict pool admission
+	JAX_PLATFORMS=cpu python tools/paged_smoke.py
 
 bench-trend:  # compare the two newest BENCH_r*.json, warn on >20% headline regressions
 	python tools/bench_trend.py

@@ -1435,6 +1435,143 @@ def bench_decode_throughput(requests=16, slots=4, cache_len=64,
     }
 
 
+def bench_paged_kv(cache_len=64, page_size=4,
+                   prefill_buckets=(4, 8, 16, 32, 48, 64), slots=4):
+    """Paged KV cache vs the contiguous ring on three axes.
+
+    Shared-prefix sweep (the tentpole economics): requests repeating a
+    templated prefix at 0/25/50/75/90/95% of the prompt admit through
+    the radix prefix index — matched full pages are retained (CoW
+    shared), and only the unmatched suffix is prefilled, in the
+    smallest bucket that holds it. Per ratio the row reports the
+    measured per-tenant hit rate, the prefill-FLOPs-saved fraction
+    ``1 - suffix_bucket/full_bucket`` (program-size accounting — on a
+    bucketed ladder the saving is exactly the bucket shrink), and
+    measured TTFT (admit wall time), which must scale down together.
+
+    Capacity: the SAME mixed short/long sweep that needs ``slots`` full
+    ring windows runs token-identically on a page pool 1.6x smaller —
+    short requests hold only the pages they touch and idle prefix-cache
+    pages evict under pressure — i.e. >= 1.3x slots at equal HBM.
+
+    Parity: every paged row above decodes the ring engine's exact
+    greedy tokens, at exactly len(prefill ladder) + 1 compiled programs
+    (the unified full/suffix prefill is ONE program per bucket;
+    ``shared_len`` is a traced scalar, not a shape).
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu import profiler
+    from paddle_tpu.generation import COMPILE_COUNTER, GenerationEngine
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(
+        vocab_size=512, hidden_size=128, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=256,
+        max_position_embeddings=256, hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0, attention_window=cache_len)
+    model = GPTForCausalLM(cfg)
+    ring = GenerationEngine(model, slots=slots, cache_len=cache_len,
+                            prefill_buckets=prefill_buckets)
+    ring.warmup()
+    eng = GenerationEngine(model, slots=slots, cache_len=cache_len,
+                           prefill_buckets=prefill_buckets,
+                           kv_cache_layout="paged",
+                           kv_page_size=page_size)
+    c0 = profiler.counters().get(COMPILE_COUNTER, 0)
+    eng.warmup()
+    warm_compiles = profiler.counters().get(COMPILE_COUNTER, 0) - c0
+
+    # -- parity: mixed burst decodes the ring's exact greedy tokens ----
+    rng = np.random.RandomState(7)
+    mixed = [list(map(int, rng.randint(3, 500, size=n)))
+             for n in (6, 48, 3, 40, 12, 30, 7, 24)]
+    want = ring.generate(mixed, max_new_tokens=8, temperature=0.0)
+    got = eng.generate(mixed, max_new_tokens=8, temperature=0.0)
+    assert got == want, "paged layout diverged from the ring goldens"
+    assert eng.extra_compiles() == 0, "paged burst must stay compile-bound"
+
+    # -- shared-prefix sweep: hit rate, FLOPs saved, TTFT per ratio ----
+    def bucket_for(n):
+        return next(b for b in prefill_buckets if b >= max(n, 1))
+
+    full = prefill_buckets[-1]
+    sweep = []
+    for share in (0.0, 0.25, 0.5, 0.75, 0.9, 0.95):
+        shared_n = int(share * full) // page_size * page_size
+        prefix = list(map(int, rng.randint(3, 500, size=shared_n)))
+        tenant = f"share{int(share * 100)}"
+        ttfts = []
+        for _ in range(4):  # 1 cold admit populates the index + 3 warm
+            req = prefix + list(map(int, rng.randint(
+                3, 500, size=full - shared_n)))
+            t0 = time.perf_counter()
+            eng.admit(0, req, 0.0, tenant=tenant)
+            ttfts.append(time.perf_counter() - t0)
+            eng.release_slot(0)
+        st = eng.paging_stats()["per_tenant"][tenant]
+        suffix_bucket = bucket_for(full - shared_n)
+        sweep.append({
+            "share": share,
+            "shared_tokens": shared_n,
+            "measured_hit_rate": st["hit_rate"],
+            "suffix_bucket": suffix_bucket,
+            "prefill_flops_saved": round(1.0 - suffix_bucket / full, 4),
+            "ttft_cold_ms": round(1e3 * ttfts[0], 3),
+            "ttft_reused_ms": round(
+                1e3 * sorted(ttfts[1:])[len(ttfts[1:]) // 2], 3),
+        })
+    assert eng.extra_compiles() == 0, (
+        "suffix prefill recompiled; shared_len must be traced")
+    extra = eng.extra_compiles()  # before the cap engine's own warmup
+    index = eng.paging_stats()["prefix_index"]
+
+    # -- slots at equal HBM: the mixed sweep on a 1.6x-smaller pool ----
+    ring_equiv_pages = slots * (cache_len // page_size)
+    pool_pages = int(ring_equiv_pages / 1.6)
+    cap = GenerationEngine(model, slots=slots, cache_len=cache_len,
+                           prefill_buckets=prefill_buckets,
+                           kv_cache_layout="paged",
+                           kv_page_size=page_size,
+                           kv_pool_pages=pool_pages)
+    cap.warmup()
+    got_cap = cap.generate(mixed, max_new_tokens=8, temperature=0.0)
+    assert got_cap == want, "mixed burst diverged on the constrained pool"
+    assert cap.extra_compiles() == 0, (
+        "constrained pool must not change the compiled programs' count")
+    cap_stats = cap.paging_stats()
+    slots_ratio = ring_equiv_pages / pool_pages
+    return {
+        "metric": "paged_kv",
+        "value": round(slots_ratio, 3),
+        "unit": "x_slots_at_equal_hbm",
+        "page_size": page_size,
+        "cache_len": cache_len,
+        "parity_prompts": len(mixed),
+        "shared_prefix_sweep": sweep,
+        "prefix_index": {
+            "lookups": index["lookups"],
+            "hits": index["hits"],
+            "hit_rate": index["hit_rate"],
+            "evictions": index["evictions"],
+        },
+        "slots_at_equal_hbm": {
+            "ring_equiv_pages": ring_equiv_pages,
+            "pool_pages": pool_pages,
+            "peak_pages_used": cap_stats["peak_pages_used"],
+            "cow_copies": cap_stats["cow_copies"],
+            "ratio": round(slots_ratio, 3),
+        },
+        "compiles": {
+            "warmup": warm_compiles,
+            "expected": len(prefill_buckets) + 1,
+            "extra_after_warmup": extra,
+        },
+        "kv_bytes_per_token": eng.kv_bytes_per_token(),
+        "page_nbytes": eng.page_nbytes(),
+    }
+
+
 def bench_disagg_fleet(requests=36, clients=12):
     """Disaggregated prefill/decode fleet vs a unified fleet at EQUAL
     backend count (2 processes each) on a mixed prompt-length sweep.
@@ -2281,6 +2418,9 @@ def main():
     result["decode_throughput"] = bench_decode_throughput()
     # disaggregated prefill/decode 2-process fleet vs unified, TTFT p99
     result["decode_throughput"]["disagg"] = bench_disagg_fleet()
+    # paged KV: shared-prefix sweep (hit rate / FLOPs saved / TTFT),
+    # slots-at-equal-HBM on a constrained pool, ring parity
+    result["paged_kv"] = bench_paged_kv()
     # serving fleet: 1 -> N backend processes behind the router
     result["router_throughput"] = bench_router_throughput()
     # async snapshot capture on the step path vs blocking saves (target <2%)

@@ -372,6 +372,34 @@ define_flag("generation_kv_cache_dtype", "float32",
             "KV cache storage dtype for decoding: float32 | int8 "
             "(int8: per-head dynamic scales, ~4x fewer cache bytes)")
 
+# generation/paging.py + nn/transformer.py PagedStaticCache — physical
+# layout of the decode KV store. "ring" is the historical per-slot
+# contiguous ring; "paged" decomposes the same logical ring into
+# fixed-size pages drawn from a shared pool through per-slot page
+# tables, enabling copy-on-write prefix sharing across requests and
+# capacity as a function of ACTUAL tokens instead of worst-case window.
+# Greedy output is token-identical between the two layouts.
+define_flag("kv_cache_layout", "ring",
+            "decode KV cache layout: ring (per-slot contiguous) | paged "
+            "(shared page pool + per-slot page tables with copy-on-write "
+            "prefix reuse)")
+
+# generation/paging.py — tokens per KV page under the paged layout.
+# Smaller pages share more aggressively (a prefix must fill a whole
+# page to be reusable) but widen the page tables; must divide
+# generation_kv_cache_len.
+define_flag("generation_kv_page_size", 16,
+            "tokens per KV page under kv_cache_layout=paged; must "
+            "divide generation_kv_cache_len evenly")
+
+# generation/paging.py — physical pages in the shared pool. 0 sizes the
+# pool at slots x pages_per_slot (ring-equivalent worst case); smaller
+# values bank on prefix sharing / short sequences to overcommit slots
+# against HBM (the slots-vs-pages capacity recipe in README).
+define_flag("generation_kv_pool_pages", 0,
+            "physical KV pages in the paged pool (0: slots x "
+            "pages_per_slot, the no-overcommit default)")
+
 # generation/engine.py — the sequence-length bucket ladder for prefill.
 # Prompts pad up to the smallest covering bucket, so prefill costs at
 # most len(ladder) compiles ever — the serving batch-bucket discipline,

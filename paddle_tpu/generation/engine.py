@@ -73,6 +73,7 @@ from ..monitor import flight_recorder as _flight
 from ..monitor import tracing as _tracing
 from ..profiler import RecordEvent, counters as _counters
 from . import cache as _cache
+from . import paging as _paging
 from .sampling import sample_logits
 
 __all__ = ["GenerationEngine", "COMPILE_COUNTER"]
@@ -99,8 +100,9 @@ class GenerationEngine:
     def __init__(self, model, *, slots=None, cache_len=None,
                  prefill_buckets=None, eos_id=None, pad_id=None,
                  max_new_tokens=None, temperature=None, top_k=None,
-                 kv_cache_dtype=None, draft_model=None, draft_k=None,
-                 seed=0):
+                 kv_cache_dtype=None, kv_cache_layout=None,
+                 kv_page_size=None, kv_pool_pages=None,
+                 draft_model=None, draft_k=None, seed=0):
         # lazy: serving imports generation's scheduler, so module-level
         # imports the other way would cycle
         from ..serving.batcher import parse_buckets
@@ -196,6 +198,45 @@ class GenerationEngine:
                     "positions")
         self.store_len = self.cache_len + (
             self.draft_k if self.speculative else 0)
+        # KV layout: "ring" is the historical per-slot contiguous store;
+        # "paged" draws fixed-size pages from a shared pool through
+        # per-slot page tables (generation/paging.py) — same logical
+        # ring, so greedy output is token-identical, plus copy-on-write
+        # prefix reuse across requests.
+        self.kv_cache_layout = str(
+            kv_cache_layout if kv_cache_layout is not None
+            else flag("kv_cache_layout"))
+        if self.kv_cache_layout not in ("ring", "paged"):
+            raise InvalidArgumentError(
+                f"kv_cache_layout must be ring | paged, got "
+                f"{self.kv_cache_layout!r}")
+        self.paged = self.kv_cache_layout == "paged"
+        if self.paged and self.speculative:
+            raise InvalidArgumentError(
+                "speculative decoding does not compose with "
+                "kv_cache_layout=paged yet; run the draft engine on the "
+                "ring layout")
+        self.page_size = int(kv_page_size if kv_page_size is not None
+                             else flag("generation_kv_page_size"))
+        if self.paged:
+            if self.page_size < 1 or self.cache_len % self.page_size:
+                raise InvalidArgumentError(
+                    f"generation_kv_page_size {self.page_size} must be "
+                    f">= 1 and divide the cache window {self.cache_len}")
+            self._pages_per_slot = self.cache_len // self.page_size
+            self._pool_pages_cfg = int(
+                kv_pool_pages if kv_pool_pages is not None
+                else flag("generation_kv_pool_pages"))
+            if self._pool_pages_cfg < 0:
+                raise InvalidArgumentError(
+                    f"generation_kv_pool_pages must be >= 0, got "
+                    f"{self._pool_pages_cfg}")
+            if self._pool_pages_cfg \
+                    and self._pool_pages_cfg < self._pages_per_slot:
+                raise InvalidArgumentError(
+                    f"generation_kv_pool_pages {self._pool_pages_cfg} "
+                    f"cannot hold even one slot's window "
+                    f"({self._pages_per_slot} pages)")
         # static capacity admission (FLAGS_memory_budget_check): the
         # slots x cache-len x dtype geometry is budgeted against the
         # device HBM BEFORE the rings allocate — a fleet operator learns
@@ -216,6 +257,10 @@ class GenerationEngine:
         self._spec_rounds = 0
         self._spec_proposed = 0
         self._spec_accepted = 0
+        # prefix sharing is suppressed during warmup (every ladder
+        # bucket must compile its own program; a matched prefix would
+        # collapse later buckets onto already-compiled suffix shapes)
+        self._prefix_enabled = True
         self.reset()
         # eval_step-style snapshot: walk the module tree once, read the
         # live arrays per call (cheap, and parameter updates flow in)
@@ -224,6 +269,8 @@ class GenerationEngine:
         self._prefill_jit = jax.jit(self._prefill_pure)
         self._spec_prefill_jit = jax.jit(self._spec_prefill_pure)
         self._decode_jit = jax.jit(self._decode_pure)
+        self._paged_prefill_jit = jax.jit(self._paged_prefill_pure)
+        self._paged_decode_jit = jax.jit(self._paged_decode_pure)
         self._prefill_export_jit = jax.jit(self._prefill_export_pure)
         self._draft_jit = jax.jit(self._draft_chain_pure)
         self._verify_jit = jax.jit(self._verify_pure)
@@ -284,13 +331,34 @@ class GenerationEngine:
         return self._named_state(self._draft_named)
 
     def reset(self):
-        """Zero every slot (all caches empty, positions 0)."""
+        """Zero every slot (all caches empty, positions 0). A paged
+        engine additionally rebuilds the page pool, page tables, and
+        prefix index from scratch."""
         from ..monitor import registry as _mon
 
         ring_slots = getattr(self, "_ring_slots", self.slots)
-        self._kv = _cache.init_cache(
-            self._num_layers, ring_slots, self._num_heads, self.store_len,
-            self._head_dim, dtype=self.kv_cache_dtype)
+        if self.paged:
+            usable = self._pool_usable(ring_slots)
+            self._kv = _paging.init_paged_cache(
+                self._num_layers, self._num_heads, self._head_dim,
+                self.page_size, usable, ring_slots,
+                self._pages_per_slot, dtype=self.kv_cache_dtype)
+            self._pool = _paging.PagePool(usable, self.page_size)
+            self._index = _paging.PrefixIndex(self._pool)
+            self._table_host = np.full(
+                (ring_slots, self._pages_per_slot), _paging.TRASH_PAGE,
+                np.int32)
+            self._pos_host = np.zeros(ring_slots, np.int64)
+            self._slot_live = [False] * ring_slots
+            self._slot_tenant = ["default"] * ring_slots
+            # per-tenant prefix accounting (prompt vs shared tokens)
+            self._prefix_tenants = {}
+            self._pool_gauges()
+        else:
+            self._kv = _cache.init_cache(
+                self._num_layers, ring_slots, self._num_heads,
+                self.store_len, self._head_dim,
+                dtype=self.kv_cache_dtype)
         if self.speculative:
             # draft ring arrays only — the draft mirrors the target's
             # committed token history exactly, so ONE shared pos vector
@@ -348,14 +416,38 @@ class GenerationEngine:
             total += self._module_nbytes(self.draft_model)
         return total
 
+    def _pool_usable(self, slots=None) -> int:
+        """Usable pages (excluding trash) the paged pool holds for
+        ``slots`` decode slots: the configured override, or slots x
+        pages-per-slot (the ring-equivalent no-overcommit default)."""
+        n = int(slots if slots is not None else self.slots)
+        return self._pool_pages_cfg or n * self._pages_per_slot
+
+    def page_nbytes(self, kv_cache_dtype=None) -> int:
+        """Pool bytes ONE page costs across all layers (values + scales
+        at int8) — the per-page unit of the paged capacity plan."""
+        dtype = str(kv_cache_dtype if kv_cache_dtype is not None
+                    else self.kv_cache_dtype)
+        return _paging.page_nbytes(
+            self._num_layers, self._num_heads, self._head_dim,
+            self.page_size, dtype)
+
     def slot_nbytes(self, kv_cache_dtype=None) -> int:
-        """Ring bytes ONE decode slot costs at this engine's geometry:
-        ``store_len x kv_bytes_per_token`` (values + scales at int8)
-        plus the slot's position word, plus the draft ring's analog when
-        speculative — the per-slot divisor of
+        """Cache bytes ONE decode slot costs at this engine's geometry.
+
+        Ring: ``store_len x kv_bytes_per_token`` (values + scales at
+        int8) plus the slot's position word, plus the draft ring's
+        analog when speculative. Paged: the slot's worst-case
+        pages-in-flight (``pages_per_slot``) x ``page_nbytes`` plus its
+        page-table row and position word — NOT ``store_len x
+        kv_bytes_per_token``, which double-counts the speculative
+        margin a paged slot never allocates. The per-slot divisor of
         :meth:`suggest_decode_slots`."""
         dtype = str(kv_cache_dtype if kv_cache_dtype is not None
                     else self.kv_cache_dtype)
+        if self.paged:
+            return (self._pages_per_slot * self.page_nbytes(dtype)
+                    + self._pages_per_slot * 4 + 4)
         per = self.store_len * _cache.kv_bytes_per_token(
             self._num_layers, self._num_heads, self._head_dim, dtype) + 4
         if self.speculative:
@@ -366,25 +458,38 @@ class GenerationEngine:
 
     def hbm_required_bytes(self, slots=None, kv_cache_dtype=None) -> int:
         """Predicted device bytes the engine's geometry holds resident:
-        weights plus ``slots`` rings — the static plan the capacity
-        admission and :meth:`suggest_decode_slots` budget against
-        (matches :meth:`cache_nbytes` on the real arrays)."""
+        weights plus ``slots`` rings (ring layout), or weights plus the
+        page pool + trash page + page tables (paged layout) — the
+        static plan the capacity admission and
+        :meth:`suggest_decode_slots` budget against. Matches
+        :meth:`cache_nbytes` on the real arrays BYTE-EXACTLY in both
+        layouts (asserted in tests/test_paged_kv.py)."""
         n = int(slots if slots is not None else self.slots)
+        if self.paged:
+            pnb = self.page_nbytes(kv_cache_dtype)
+            pool = (self._pool_pages_cfg
+                    or n * self._pages_per_slot)
+            return (self.param_nbytes() + (pool + 1) * pnb
+                    + n * (self._pages_per_slot * 4 + 4))
         return self.param_nbytes() + n * self.slot_nbytes(kv_cache_dtype)
 
     def suggest_decode_slots(self, hbm_budget_bytes=None,
                              kv_cache_dtype=None) -> int:
         """Decode slots this model fits in ``hbm_budget_bytes`` (default:
         the device HBM from the cost-model peaks table): ``(budget -
-        weights) // slot_nbytes``. ``kv_cache_dtype`` asks the other
-        cache mode's answer (int8 roughly doubles the count) without
-        rebuilding the engine — the serving-capacity recipe in README
-        "Memory planning"."""
+        weights) // slot_nbytes``, with the paged layout additionally
+        reserving the trash page before dividing (its pool grows by
+        ``pages_per_slot`` pages + one table row per slot).
+        ``kv_cache_dtype`` asks the other cache mode's answer (int8
+        roughly doubles the count) without rebuilding the engine — the
+        serving-capacity recipe in README "Memory planning"."""
         if hbm_budget_bytes is None:
             from ..analysis.memory import hbm_budget_bytes as _budget
 
             hbm_budget_bytes = _budget()
         avail = int(hbm_budget_bytes) - self.param_nbytes()
+        if self.paged:
+            avail -= self.page_nbytes(kv_cache_dtype)  # the trash page
         if avail <= 0:
             return 0
         return int(avail // self.slot_nbytes(kv_cache_dtype))
@@ -490,6 +595,32 @@ class GenerationEngine:
         if self.warmed:
             return self
         self.expected_compiles(kind)  # validates the kind loudly
+        # warmup must compile EVERY ladder bucket: with the prefix index
+        # live, bucket N's pad prompt would share bucket N-1's pages and
+        # prefill only a suffix — a smaller, already-compiled shape —
+        # leaving the big bucket to compile on the first live prompt
+        self._prefix_enabled = False
+        try:
+            self._warmup_drive(kind)
+        finally:
+            self._prefix_enabled = True
+        self.reset()  # warmup traffic must not look like live context
+        with self._key_lock:
+            self._spec_rounds = 0
+            self._spec_proposed = 0
+            self._spec_accepted = 0
+        self.watch.arm()
+        self.warmed = True
+        _flight.record_event(
+            "generation_warmup", backend_kind=kind,
+            prefill_buckets=list(self.prefill_buckets),
+            slots=self.slots, cache_len=self.cache_len,
+            kv_cache_layout=self.kv_cache_layout,
+            speculative=self.speculative,
+            programs=self.expected_compiles(kind))
+        return self
+
+    def _warmup_drive(self, kind):
         with RecordEvent("generation::warmup"):
             if kind in ("generate",):
                 for bucket in self.prefill_buckets:
@@ -523,20 +654,6 @@ class GenerationEngine:
                 else:
                     self.step(np.zeros(self.slots, np.int32),
                               np.zeros(self.slots, np.float32))
-        self.reset()  # warmup traffic must not look like live context
-        with self._key_lock:
-            self._spec_rounds = 0
-            self._spec_proposed = 0
-            self._spec_accepted = 0
-        self.watch.arm()
-        self.warmed = True
-        _flight.record_event(
-            "generation_warmup", backend_kind=kind,
-            prefill_buckets=list(self.prefill_buckets),
-            slots=self.slots, cache_len=self.cache_len,
-            speculative=self.speculative,
-            programs=self.expected_compiles(kind))
-        return self
 
     def _fresh_slot_planes(self):
         """Zeroed window-width per-slot planes (a synthetic empty slab
@@ -652,6 +769,59 @@ class GenerationEngine:
             self.model, state, tokens[:, None],
             position_ids=pos_ids, attention_mask=mask, caches=caches)
         kv = _cache.stack_layer_caches(new_caches) + (pos + 1,)
+        key = jax.random.fold_in(self._base_key, ctr)
+        nxt = sample_logits(logits[:, 0], key, temps, self.top_k)
+        return kv, nxt
+
+    def _paged_prefill_pure(self, state, kv, slot, tokens, shared_len,
+                            suffix_len, total_len, temp, ctr):
+        """Unified full/suffix prefill of ONE prompt straight into the
+        page pool. ``tokens [1, P]`` are the prompt's SUFFIX (everything
+        past the ``shared_len`` tokens whose pages the prefix index
+        mapped; ``shared_len == 0`` is a plain full prefill — one
+        program per ladder bucket serves both). The forward runs over
+        the slot's paged cache view directly: reads gather the shared
+        prefix pages through the admitted table row, suffix K/V scatters
+        into the slot's newly allocated pages at logical positions
+        ``shared_len + t``. Pages 0..m-1 are shared and never written
+        (the suffix starts at a page boundary; the bucket cannot wrap —
+        admission guarantees ``shared_len + bucket <= cache_len``).
+        Samples the first generated token from the last REAL suffix
+        position."""
+        p = tokens.shape[1]
+        table, pos = kv[-2], kv[-1]
+        row = table[slot][None]                    # [1, NP]
+        caches = _paging.paged_layer_caches(
+            kv, table=row, pos=shared_len[None])
+        mask = _paging.suffix_prefill_mask(
+            p, self.cache_len, shared_len, suffix_len)
+        pos_ids = jnp.minimum(
+            shared_len + jnp.arange(p, dtype=jnp.int32),
+            self.max_positions - 1)[None]
+        (logits, new_caches), _ = functional_call(
+            self.model, state, tokens,
+            position_ids=pos_ids, attention_mask=mask, caches=caches)
+        kv = _paging.stack_paged_planes(new_caches) + (
+            table, pos.at[slot].set(total_len))
+        tok = self._sample_first(logits, suffix_len, temp, ctr)
+        return kv, tok
+
+    def _paged_decode_pure(self, state, kv, tokens, temps, ctr):
+        """Paged twin of :meth:`_decode_pure`: the identical one-step
+        decode over every slot, with reads/writes routed through the
+        page tables (store == window == ``cache_len``; the paged layout
+        carries no speculative margin). Host-side page management
+        (:meth:`_prepare_decode_writes`) already made every busy slot's
+        write-target page private, so this program never recompiles and
+        never aliases a shared page."""
+        caches = _paging.paged_layer_caches(kv)
+        table, pos = kv[-2], kv[-1]
+        pos_ids = jnp.minimum(pos, self.max_positions - 1)[:, None]
+        mask = _cache.decode_mask(pos, self.cache_len)
+        (logits, new_caches), _ = functional_call(
+            self.model, state, tokens[:, None],
+            position_ids=pos_ids, attention_mask=mask, caches=caches)
+        kv = _paging.stack_paged_planes(new_caches) + (table, pos + 1)
         key = jax.random.fold_in(self._base_key, ctr)
         nxt = sample_logits(logits[:, 0], key, temps, self.top_k)
         return kv, nxt
@@ -772,11 +942,15 @@ class GenerationEngine:
             self._key_step += 1
             return self._key_step
 
-    def admit(self, slot, prompt, temperature=None) -> int:
+    def admit(self, slot, prompt, temperature=None, tenant=None) -> int:
         """Prefill ``prompt`` into ``slot`` and return the first sampled
         token. The slot's previous occupant is simply overwritten — a
-        vacated slot needs no reset pass. Speculative engines prefill
-        the draft ring in the same program."""
+        vacated slot needs no reset pass (ring), or its pages are
+        reclaimed first (paged). Speculative engines prefill the draft
+        ring in the same program. ``tenant`` labels the paged layout's
+        prefix-reuse observability; the ring layout ignores it."""
+        if self.paged:
+            return self._admit_paged(slot, prompt, temperature, tenant)
         padded, n = self._padded_prompt(prompt)
         temp = (self.default_temperature if temperature is None
                 else float(temperature))
@@ -800,6 +974,426 @@ class GenerationEngine:
                     jnp.asarray(ctr, jnp.int32)))
                 self._kv, tok = out
         return int(tok)
+
+    # -- paged layout: host-side page management ------------------------------
+    #
+    # All of this runs BETWEEN compiled steps on the engine's single
+    # dispatch thread: page allocation, refcounts, CoW, and the prefix
+    # index are plain host bookkeeping; the device pytree keeps its
+    # fixed shapes, so no path here can add a compile.
+
+    def _sync_table(self):
+        """Push the host page-table mirror into the device pytree."""
+        self._kv = self._kv[:-2] + (
+            jnp.asarray(self._table_host), self._kv[-1])
+
+    def _copy_page(self, src, dst):
+        """Device-copy one pool page (all layers, values + scales) —
+        the copy half of copy-on-write."""
+        self._kv = tuple(
+            a.at[:, dst].set(a[:, src]) for a in self._kv[:-2]
+        ) + self._kv[-2:]
+
+    def _alloc_pages(self, need):
+        """``need`` private pages off the free list, evicting LRU
+        index-only prefix pages when the list runs dry. Raises
+        :class:`paging.PagePoolExhaustedError` (slots keep their pages;
+        nothing was handed out) when the pool genuinely cannot supply."""
+        need = int(need)
+        if need > self._pool.free_pages():
+            self._index.evict(need - self._pool.free_pages())
+        if need > self._pool.free_pages():
+            raise _paging.PagePoolExhaustedError(
+                f"page pool exhausted: need {need} pages, "
+                f"{self._pool.free_pages()} free and nothing evictable "
+                f"(pool {self._pool.pages} pages x {self.page_size} "
+                "tokens; raise FLAGS_generation_kv_pool_pages or lower "
+                "concurrency)")
+        return [self._pool.alloc() for _ in range(need)]
+
+    def release_slot(self, slot):
+        """Reclaim a vacated slot's pages: drop the slot's reference on
+        every mapped page (pages the prefix index also holds survive as
+        shared prefix cache; private ones return to the free list) and
+        point the table row back at the trash page. No-op on the ring
+        layout — ring slots are simply overwritten."""
+        if not self.paged:
+            return
+        slot = int(slot)
+        row = self._table_host[slot]
+        if not self._slot_live[slot] and not row.any():
+            return
+        for pid in row:
+            if int(pid) != _paging.TRASH_PAGE:
+                self._pool.release(int(pid))
+        self._table_host[slot] = _paging.TRASH_PAGE
+        self._slot_live[slot] = False
+        self._pos_host[slot] = 0
+        self._sync_table()
+        self._pool_gauges()
+
+    def _cap_matched(self, n, m):
+        """Cap a prefix match so the suffix's ladder bucket fits the
+        window without wrapping into the shared pages (the suffix
+        prefill writes ``bucket`` entries starting at ``m * ps``)."""
+        while m:
+            bucket = self.bucket_for(n - m * self.page_size)
+            if m * self.page_size + bucket <= self.cache_len:
+                break
+            m -= 1
+        return m
+
+    def has_capacity(self, prompt_or_length) -> bool:
+        """Would :meth:`admit` find pages for this prompt right now?
+        Counts free + evictable pages against the pages the prompt
+        needs beyond its indexed prefix — the admission gate
+        ``serving/continuous.py`` consults INSTEAD of assuming a vacant
+        slot implies capacity (pool free pages, not fixed slots)."""
+        if not self.paged:
+            return True
+        ps = self.page_size
+        if isinstance(prompt_or_length, int):
+            n, m = int(prompt_or_length), 0
+        else:
+            prompt = list(prompt_or_length)
+            n = len(prompt)
+            m = self._cap_matched(n, len(self._index.known(
+                _paging.chain_hashes(prompt, ps)[:(n - 1) // ps]))) \
+                if self._prefix_enabled else 0
+        need = -(-n // ps) - m
+        return (self._pool.free_pages() + self._index.evictable()
+                >= need)
+
+    def _admit_paged(self, slot, prompt, temperature, tenant):
+        """Paged admission: map the longest indexed prefix (full pages
+        only, capped so the suffix keeps >= 1 real token and its bucket
+        cannot wrap), allocate private pages for the rest, register the
+        prompt's full pages in the index, and dispatch the unified
+        full/suffix prefill program for the suffix's ladder bucket."""
+        slot = int(slot)
+        n = self.validate(prompt, 1)
+        ps = self.page_size
+        self.release_slot(slot)
+        hashes = _paging.chain_hashes(prompt, ps)
+        matched = []
+        if self._prefix_enabled:
+            # cap at floor((n-1)/ps): the suffix keeps >= 1 token, so
+            # there is always a real logit position to sample from
+            matched = self._index.match(hashes[:(n - 1) // ps])
+            matched = matched[:self._cap_matched(n, len(matched))]
+        m = len(matched)
+        shared_len = m * ps
+        suffix = list(prompt)[shared_len:]
+        total_pages = -(-n // ps)
+        # retain BEFORE allocating: _alloc_pages may evict ref==1 index
+        # pages, and the matched pages are exactly that until retained
+        for pid in matched:
+            self._pool.retain(pid)
+        try:
+            new_pages = self._alloc_pages(total_pages - m)
+        except _paging.PagePoolExhaustedError:
+            for pid in matched:
+                self._pool.release(pid)
+            raise
+        row = np.full(self._pages_per_slot, _paging.TRASH_PAGE, np.int32)
+        row[:m] = matched
+        row[m:total_pages] = new_pages
+        self._table_host[slot] = row
+        self._pos_host[slot] = n
+        self._slot_live[slot] = True
+        t = "default" if tenant is None else str(tenant)
+        self._slot_tenant[slot] = t
+        if self._prefix_enabled:
+            self._index.insert(hashes[:n // ps],
+                               [int(p) for p in row[:n // ps]])
+        self._sync_table()
+        self._note_prefix(t, n, shared_len, m)
+        padded = np.full(self.bucket_for(len(suffix)), self.pad_id,
+                         np.int32)
+        padded[:len(suffix)] = np.asarray(suffix, np.int32)
+        temp = (self.default_temperature if temperature is None
+                else float(temperature))
+        ctr = self._next_key_step()
+        with RecordEvent("generation::prefill"):
+            out = self._dispatch("prefill", self._paged_prefill_jit, (
+                self._state(), self._kv, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(padded[None]),
+                jnp.asarray(shared_len, jnp.int32),
+                jnp.asarray(len(suffix), jnp.int32),
+                jnp.asarray(n, jnp.int32),
+                jnp.asarray(temp, jnp.float32),
+                jnp.asarray(ctr, jnp.int32)))
+        self._kv, tok = out
+        return int(tok)
+
+    def _note_prefix(self, tenant, prompt_tokens, shared_tokens,
+                     matched_pages):
+        """Per-tenant prefix-reuse accounting + the labeled gauges and
+        the ``prefix_reuse`` flight event (PR 17 labeled families)."""
+        from ..monitor import registry as _mon
+
+        st = self._prefix_tenants.setdefault(
+            tenant, {"lookups": 0, "hits": 0, "prompt_tokens": 0,
+                     "shared_tokens": 0})
+        st["lookups"] += 1
+        st["prompt_tokens"] += int(prompt_tokens)
+        if matched_pages:
+            st["hits"] += 1
+            st["shared_tokens"] += int(shared_tokens)
+            _flight.record_event(
+                "prefix_reuse", tenant=tenant,
+                matched_tokens=int(shared_tokens),
+                matched_pages=int(matched_pages),
+                prompt_tokens=int(prompt_tokens))
+        _mon.gauge("generation/prefix_hit_rate").labels(
+            tenant=tenant).set(
+            round(st["shared_tokens"] / st["prompt_tokens"], 4))
+        tot_p = sum(s["prompt_tokens"]
+                    for s in self._prefix_tenants.values())
+        tot_s = sum(s["shared_tokens"]
+                    for s in self._prefix_tenants.values())
+        _mon.gauge("generation/prefix_hit_rate").set(
+            round(tot_s / tot_p, 4) if tot_p else 0.0)
+        self._pool_gauges()
+
+    def _pool_gauges(self):
+        """Pool occupancy gauges: global free/shared, plus per-tenant
+        shared-page children (pages a tenant's live slots map at
+        refcount > 1 — its CoW exposure)."""
+        from ..monitor import registry as _mon
+
+        _mon.gauge("generation/pages_free").set(self._pool.free_pages())
+        _mon.gauge("generation/pages_shared").set(
+            self._pool.shared_pages())
+        per = {}
+        for s, live in enumerate(self._slot_live):
+            if not live:
+                continue
+            t = self._slot_tenant[s]
+            per[t] = per.get(t, 0) + sum(
+                1 for pid in self._table_host[s]
+                if int(pid) != _paging.TRASH_PAGE
+                and self._pool.ref[int(pid)] > 1)
+        for t in self._prefix_tenants:
+            _mon.gauge("generation/pages_shared").labels(
+                tenant=t).set(per.get(t, 0))
+        for t, n in per.items():
+            if t not in self._prefix_tenants:
+                _mon.gauge("generation/pages_shared").labels(
+                    tenant=t).set(n)
+
+    def _prepare_decode_writes(self):
+        """Make every busy slot's next ring write safe BEFORE the
+        compiled step runs: the write lands at logical page ``(pos %
+        window) // ps`` — if that table entry is still the trash page
+        (first visit), allocate; if the mapped page is shared (prefix
+        pages after the ring wraps back into them, or pages the index
+        retains), COPY it private first (copy-on-write) so the write
+        cannot corrupt another slot's — or the index's — view."""
+        changed = False
+        for s, live in enumerate(self._slot_live):
+            if not live:
+                continue
+            idx = int(self._pos_host[s]) % self.cache_len
+            lp = idx // self.page_size
+            pid = int(self._table_host[s, lp])
+            if pid == _paging.TRASH_PAGE:
+                (new,) = self._alloc_pages(1)
+                self._table_host[s, lp] = new
+                changed = True
+            elif self._pool.ref[pid] > 1:
+                try:
+                    (new,) = self._alloc_pages(1)
+                except _paging.PagePoolExhaustedError:
+                    # pressure valve: stop caching this chain — forget
+                    # the page's subtree so the index's pin drops. If
+                    # the page is now private to this slot, write in
+                    # place; if another live slot still shares it, the
+                    # forget freed enough refs that a copy page exists.
+                    self._index.forget_page(pid)
+                    if self._pool.ref[pid] == 1:
+                        continue
+                    (new,) = self._alloc_pages(1)
+                self._copy_page(pid, new)
+                self._pool.release(pid)
+                self._table_host[s, lp] = new
+                self._pool.cow_copies += 1
+                changed = True
+        if changed:
+            self._sync_table()
+            self._pool_gauges()
+
+    def paging_stats(self) -> dict:
+        """The /statz paging block: layout + pool occupancy + prefix-
+        index accounting (global and per tenant)."""
+        if not self.paged:
+            return {"layout": self.kv_cache_layout}
+        per = {}
+        for t, st in self._prefix_tenants.items():
+            per[t] = dict(st, hit_rate=round(
+                st["shared_tokens"] / st["prompt_tokens"], 4)
+                if st["prompt_tokens"] else None)
+        return {
+            "layout": self.kv_cache_layout,
+            "page_size": self.page_size,
+            "pages_per_slot": self._pages_per_slot,
+            "pages_total": self._pool.pages,
+            "pages_free": self._pool.free_pages(),
+            "pages_used": self._pool.used_pages(),
+            "pages_shared": self._pool.shared_pages(),
+            "peak_pages_used": self._pool.peak_used,
+            "cow_copies": self._pool.cow_copies,
+            "page_nbytes": self.page_nbytes(),
+            "prefix_index": self._index.stats(),
+            "per_tenant": per,
+        }
+
+    def known_page_hashes(self, hashes):
+        """The prefix of ``hashes`` this engine's index already holds —
+        a prefill tier (or router) asks before shipping a page-granular
+        slab so the wire carries only pages this tier is missing."""
+        if not self.paged:
+            return set()
+        return self._index.known(list(hashes))
+
+    def prefill_export_pages(self, prompt, temperature=None,
+                             known_hashes=()):
+        """Page-granular :meth:`prefill_export`: runs the same bucketed
+        forward, then splits the slab into pages with chain hashes.
+        Returns ``(pages, length, first_token)`` where ``pages`` is a
+        list of ``{"id", "hash", "planes"}`` dicts — full pages carry
+        their chain hash (``hash=None`` for the partial tail), and a
+        page whose hash is in ``known_hashes`` ships header-only
+        (``planes=None``): the decode tier maps it from its own prefix
+        index instead of the wire."""
+        planes, n, tok = self.prefill_export(prompt, temperature)
+        ps = self.page_size
+        per_page = _paging.split_planes(planes, ps)
+        hashes = _paging.chain_hashes(prompt, ps)
+        known = set(known_hashes)
+        pages = []
+        for i in range(-(-n // ps)):
+            h = hashes[i] if i < len(hashes) else None
+            pages.append({
+                "id": i, "hash": h,
+                "planes": None if (h is not None and h in known)
+                else per_page[i]})
+        return pages, n, int(tok)
+
+    def admit_prefilled_pages(self, slot, pages, length, first_token,
+                              page_size=None, tenant=None) -> int:
+        """Land a page-granular handoff in decode slot ``slot``: pages
+        shipped on the wire are installed into freshly allocated pool
+        pages; header-only pages (``planes is None``) must resolve
+        through this engine's own prefix index (the sender asked
+        :meth:`known_page_hashes` first) and are mapped copy-on-write —
+        refcounted exactly like a local prefix hit. Full shipped pages
+        with hashes register in the index, so this decode tier becomes
+        a prefix-cache peer for the whole fleet."""
+        from .handoff import HandoffError
+
+        if not self.paged:
+            raise InvalidArgumentError(
+                "page-granular handoff needs kv_cache_layout=paged on "
+                "the decode tier (ring tiers speak the slab format)")
+        slot = int(slot)
+        length = int(length)
+        ps = self.page_size
+        if page_size is not None and int(page_size) != ps:
+            raise HandoffError(
+                f"page-granular slab page_size {page_size} does not "
+                f"match this engine's {ps}")
+        if not 1 <= length <= self.cache_len:
+            raise InvalidArgumentError(
+                f"handoff length {length} outside [1, {self.cache_len}]")
+        npages = -(-length // ps)
+        if len(pages) != npages:
+            raise HandoffError(
+                f"page-granular slab carries {len(pages)} pages; "
+                f"length {length} at page size {ps} needs {npages}")
+        arity = len(self._kv) - 2
+        # resolve absent pages through the index FIRST — nothing is
+        # allocated or mutated until the whole slab is provably landable
+        hashes = [p.get("hash") for p in pages]
+        full = length // ps
+        chain = []  # the contiguous hashed prefix — chain hashes only
+        for h in hashes[:full]:  # resolve through a prefix walk
+            if h is None:
+                break
+            chain.append(h)
+        plan = []
+        for i, page in enumerate(pages):
+            planes = page.get("planes")
+            if planes is None:
+                plan.append(("map", i))
+            else:
+                if len(planes) != arity:
+                    raise HandoffError(
+                        f"page {i} carries {len(planes)} planes, this "
+                        f"engine's {self.kv_cache_dtype} cache needs "
+                        f"{arity}")
+                for p in planes:
+                    if int(p.shape[2]) != ps:
+                        raise HandoffError(
+                            f"page {i} plane cache axis "
+                            f"{tuple(p.shape)} does not match page "
+                            f"size {ps}")
+                plan.append(("ship", i))
+        mapped = self._index.match(chain)
+        for kind, i in plan:
+            if kind == "map" and i >= len(mapped):
+                raise HandoffError(
+                    f"page {i} shipped header-only but this tier does "
+                    "not hold its hash chain; the sender must ship the "
+                    "payload")
+        self.release_slot(slot)
+        # retain mapped pages BEFORE allocating (allocation may evict
+        # ref==1 index pages), then allocate the shipped set atomically
+        map_ids = [mapped[i] for k, i in plan if k == "map"]
+        for pid in map_ids:
+            self._pool.retain(pid)
+        try:
+            fresh = self._alloc_pages(
+                sum(1 for k, _ in plan if k == "ship"))
+        except _paging.PagePoolExhaustedError:
+            for pid in map_ids:
+                self._pool.release(pid)
+            raise
+        row = np.full(self._pages_per_slot, _paging.TRASH_PAGE, np.int32)
+        ship_ids, ship_planes = [], []
+        it = iter(fresh)
+        for kind, i in plan:
+            if kind == "map":
+                row[i] = mapped[i]
+            else:
+                pid = next(it)
+                row[i] = pid
+                ship_ids.append(pid)
+                ship_planes.append(pages[i]["planes"])
+        if ship_ids:
+            ids = jnp.asarray(np.asarray(ship_ids, np.int32))
+            for j in range(arity):
+                stack = jnp.asarray(np.stack(
+                    [np.asarray(pl[j]) for pl in ship_planes], axis=1))
+                self._kv = self._kv[:j] + (
+                    self._kv[j].at[:, ids].set(stack),
+                ) + self._kv[j + 1:]
+        self._table_host[slot] = row
+        self._pos_host[slot] = length
+        self._slot_live[slot] = True
+        t = "default" if tenant is None else str(tenant)
+        self._slot_tenant[slot] = t
+        if self._prefix_enabled and full and all(
+                h is not None for h in hashes[:full]):
+            self._index.insert(hashes[:full],
+                               [int(p) for p in row[:full]])
+        self._sync_table()
+        self._kv = self._kv[:-1] + (
+            self._kv[-1].at[slot].set(length),)
+        shared = sum(1 for kind, i in plan
+                     if kind == "map" and i < len(mapped))
+        self._note_prefix(t, length, shared * ps, shared)
+        return int(first_token)
 
     def prefill_export(self, prompt, temperature=None):
         """Prefill-tier primitive: run the bucketed forward and return
@@ -846,6 +1440,23 @@ class GenerationEngine:
         if not 1 <= length <= self.cache_len:
             raise InvalidArgumentError(
                 f"handoff length {length} outside [1, {self.cache_len}]")
+        if self.paged:
+            # a v1 (contiguous) slab lands on a paged tier by splitting
+            # into anonymous pages — no hashes, so no cross-request
+            # sharing, but the decode path is uniform
+            arity = len(self._kv) - 2
+            if len(planes) != arity:
+                raise InvalidArgumentError(
+                    f"handoff slab has {len(planes)} planes, this "
+                    f"engine's {self.kv_cache_dtype} cache needs "
+                    f"{arity} (kv_cache_dtype mismatch between tiers?)")
+            per_page = _paging.split_planes(
+                tuple(jnp.asarray(p) for p in planes), self.page_size)
+            npages = -(-length // self.page_size)
+            pages = [{"id": i, "hash": None, "planes": per_page[i]}
+                     for i in range(npages)]
+            return self.admit_prefilled_pages(
+                slot, pages, length, first_token)
         arity = len(self._kv) - 1
         if len(planes) != arity:
             raise InvalidArgumentError(
@@ -877,6 +1488,22 @@ class GenerationEngine:
         host ``[S]`` arrays (vacant slots: anything — their output is
         ignored and their cache entries are overwritten on admission)."""
         ctr = self._next_key_step()
+        if self.paged:
+            # CoW/first-visit page turns happen on the host BEFORE the
+            # compiled step, so the jitted scatter only ever writes
+            # pages private to their slot (or the trash page)
+            self._prepare_decode_writes()
+            with RecordEvent("generation::decode"):
+                out = self._dispatch("decode", self._paged_decode_jit, (
+                    self._state(), self._kv,
+                    jnp.asarray(np.asarray(tokens, np.int32)),
+                    jnp.asarray(np.asarray(temps, np.float32)),
+                    jnp.asarray(ctr, jnp.int32)))
+            self._kv, nxt = out
+            for s, live in enumerate(self._slot_live):
+                if live:
+                    self._pos_host[s] += 1
+            return np.asarray(nxt)
         with RecordEvent("generation::decode"):
             out = self._dispatch("decode", self._decode_jit, (
                 self._state(), self._kv,
@@ -982,6 +1609,7 @@ class GenerationEngine:
                 temps[slot] = temp
                 if finished([tok]):
                     results[idx] = [tok]
+                    self.release_slot(slot)
                 else:
                     active[slot] = (idx, [tok])
                     last[slot] = tok
@@ -1000,6 +1628,7 @@ class GenerationEngine:
                     if finished(tokens):
                         results[idx] = tokens
                         del active[slot]
+                        self.release_slot(slot)
             else:
                 nxt = self.step(last, temps)
                 for slot in list(active):
@@ -1009,4 +1638,5 @@ class GenerationEngine:
                     if finished(tokens):
                         results[idx] = tokens
                         del active[slot]
+                        self.release_slot(slot)
         return results

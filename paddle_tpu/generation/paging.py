@@ -1,0 +1,386 @@
+"""Block-paged KV cache pool + radix prefix index with copy-on-write.
+
+The ring cache (generation/cache.py) gives every decode slot a
+contiguous worst-case-window allocation and prefills every prompt from
+token 0. This module decomposes the SAME logical ring into fixed-size
+pages drawn from one shared pool (``FLAGS_kv_cache_layout=paged``):
+
+- **Page pool** — one pytree of page-major K/V planes per layer
+  (``[L, P, H, ps, D]`` values, ``[L, P, H, ps]`` scales at int8,
+  mirroring the fp32/int8 arity discipline of ``layer_caches``).
+  Physical page 0 is the reserved **trash page**: vacant slots and
+  unallocated logical pages point at it, so the compiled decode step
+  can write every batch row unconditionally — a vacant row's garbage
+  lands in trash instead of a page some other slot owns.
+- **Page tables** — per-slot ``[NP]`` int32 rows mapping logical ring
+  pages to pool pages. The attention read gathers through the table
+  (``nn.PagedStaticCache``); logical index ``pos % (NP*ps)`` splits
+  into page ``// ps`` and offset ``% ps``, so every ring mask and the
+  wraparound contract carry over unchanged and greedy output is
+  token-identical to the ring layout by construction.
+- **Radix prefix index** — a trie keyed on CHAIN hashes of full pages
+  of prompt tokens (page ``i``'s hash commits to pages ``0..i``). A new
+  request maps the longest indexed prefix copy-on-write (refcounted:
+  the pool page is retained per mapper, and a ring-wrap write into a
+  shared page first copies it private) and prefills only its suffix.
+  The index itself holds one refcount per registered page, so prefix
+  pages survive slot release — a decode tier doubles as a fleet-wide
+  prefix cache — and LRU leaf eviction returns index-only pages to the
+  free list under pressure.
+
+All allocation/refcount/CoW bookkeeping here is HOST-side and runs
+between compiled steps; the device arrays stay a fixed-shape pytree, so
+the compile-once discipline (``extra_compiles() == 0``) is untouched.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..errors import InvalidArgumentError
+from ..nn.transformer import PagedStaticCache, QuantizedPagedCache
+from .cache import NEG_INF, kv_bytes_per_token
+
+__all__ = [
+    "TRASH_PAGE", "PagePool", "PrefixIndex", "PagePoolExhaustedError",
+    "page_nbytes", "chain_hashes", "split_planes", "init_paged_cache",
+    "paged_layer_caches", "stack_paged_planes", "suffix_prefill_mask",
+]
+
+#: physical page id reserved as the write sink for vacant slots and
+#: unallocated logical pages; never allocated, never read unmasked
+TRASH_PAGE = 0
+
+
+class PagePoolExhaustedError(InvalidArgumentError):
+    """The pool has no free page and nothing evictable — the admission
+    (or a decode-step wrap/CoW) cannot proceed. Size the pool with
+    ``FLAGS_generation_kv_pool_pages`` or admit less concurrency."""
+
+
+def page_nbytes(num_layers, num_heads, head_dim, page_size,
+                dtype="float32") -> int:
+    """Pool bytes ONE page costs across all layers (values + scales at
+    int8) — the per-page unit of the paged capacity plan."""
+    return int(page_size) * kv_bytes_per_token(
+        num_layers, num_heads, head_dim, dtype)
+
+
+def chain_hashes(tokens, page_size):
+    """Content hashes for every FULL page of ``tokens``: page ``i``'s
+    digest chains the parent's (hash of pages ``0..i-1``), so equal
+    hashes imply equal full prefixes — the radix index key. Partial
+    trailing pages are never hashed (not shareable)."""
+    ps = int(page_size)
+    toks = np.asarray(list(tokens), np.int64)
+    out, parent = [], b""
+    for i in range(len(toks) // ps):
+        d = hashlib.sha256(
+            parent + toks[i * ps:(i + 1) * ps].tobytes()).digest()
+        parent = d
+        out.append(d.hex()[:32])
+    return out
+
+
+def split_planes(planes, page_size):
+    """Slice window-width per-slot planes (``[L, H, C, D]`` values /
+    ``[L, H, C]`` scales, ``C % ps == 0``) into per-page plane tuples
+    along the cache axis — the host-side page view a page-granular
+    handoff ships."""
+    ps = int(page_size)
+    c = int(planes[0].shape[2])
+    if c % ps:
+        raise InvalidArgumentError(
+            f"cache window {c} is not a multiple of the page size {ps}")
+    return [tuple(np.ascontiguousarray(
+        np.asarray(p)[:, :, i * ps:(i + 1) * ps]) for p in planes)
+        for i in range(c // ps)]
+
+
+def init_paged_cache(num_layers, num_heads, head_dim, page_size,
+                     pool_pages, slots, pages_per_slot, dtype="float32"):
+    """Zeroed whole-model paged cache pytree.
+
+    ``dtype="float32"``: ``(k [L, P, H, ps, D], v, table [S, NP], pos
+    [S])``; ``dtype="int8"`` additionally carries the scale pools
+    ``(k, v, k_scale [L, P, H, ps], v_scale, table, pos)``. ``P`` is
+    ``pool_pages + 1`` — the usable pool plus the reserved trash page —
+    and every table entry starts at :data:`TRASH_PAGE`."""
+    shape = (int(num_layers), int(pool_pages) + 1, int(num_heads),
+             int(page_size), int(head_dim))
+    table = jnp.full((int(slots), int(pages_per_slot)), TRASH_PAGE,
+                     jnp.int32)
+    pos = jnp.zeros((int(slots),), jnp.int32)
+    if str(dtype) == "int8":
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+                jnp.zeros(shape[:-1], jnp.float32),
+                jnp.zeros(shape[:-1], jnp.float32), table, pos)
+    return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype), table,
+            pos)
+
+
+def paged_layer_caches(kv, table=None, pos=None):
+    """Per-layer :class:`nn.PagedStaticCache` /
+    :class:`nn.QuantizedPagedCache` views of the stacked pool (the
+    paged analog of ``cache.layer_caches``; arity-dispatched). ``table``
+    / ``pos`` override the pytree's own (a prefill passes the single
+    admitted row)."""
+    kv = tuple(kv)
+    t = kv[-2] if table is None else table
+    p = kv[-1] if pos is None else pos
+    arrays = kv[:-2]
+    cls = PagedStaticCache if len(arrays) == 2 else QuantizedPagedCache
+    return [cls(*(a[i] for a in arrays), t, p)
+            for i in range(arrays[0].shape[0])]
+
+
+def stack_paged_planes(caches):
+    """Re-stack per-layer paged caches returned by the model into the
+    whole-model pool arrays (``(k, v)`` fp32 / ``(k, v, k_scale,
+    v_scale)`` int8) — every layer's cache already holds the FULL
+    updated pool for that layer."""
+    if isinstance(caches[0], QuantizedPagedCache):
+        return (jnp.stack([c.k for c in caches]),
+                jnp.stack([c.v for c in caches]),
+                jnp.stack([c.k_scale for c in caches]),
+                jnp.stack([c.v_scale for c in caches]))
+    return (jnp.stack([c.k for c in caches]),
+            jnp.stack([c.v for c in caches]))
+
+
+def suffix_prefill_mask(bucket, store, shared_len, length,
+                        dtype="float32"):
+    """Additive ``[1, 1, P, store]`` mask for a SUFFIX prefill: the
+    bucket's queries sit at absolute positions ``shared_len + t`` over
+    a cache whose first ``shared_len`` entries are reused prefix pages
+    and whose suffix entries this forward writes. Query ``t`` keeps
+    entry ``j`` iff causal (``j <= shared_len + t``) and real
+    (``j < shared_len + length`` — bucket padding past the true suffix
+    writes garbage that must never be attended). ``shared_len == 0``
+    reduces exactly to ``cache.prefill_mask`` — full and suffix prefill
+    are ONE compiled program per bucket."""
+    t = jnp.arange(int(bucket))[:, None]
+    j = jnp.arange(int(store))[None, :]
+    keep = (j <= shared_len + t) & (j < shared_len + length)
+    return jnp.where(keep, 0.0, NEG_INF).astype(dtype)[None, None]
+
+
+class PagePool:
+    """Host-side allocator over the shared page pool: LIFO free list,
+    per-page refcounts, and the alloc/retain/release/CoW bookkeeping
+    the engine runs between compiled steps. Page ids are POOL indices
+    (1-based; 0 is :data:`TRASH_PAGE`). The device arrays live in the
+    engine's cache pytree — this object never touches them."""
+
+    def __init__(self, pages, page_size):
+        if int(pages) < 1:
+            raise InvalidArgumentError(
+                f"a page pool needs at least 1 usable page, got {pages}")
+        self.pages = int(pages)
+        self.page_size = int(page_size)
+        # ref[0] (trash) stays 0 forever; LIFO free list for locality
+        self.ref = np.zeros(self.pages + 1, np.int64)
+        self._free = list(range(self.pages, 0, -1))
+        self.peak_used = 0
+        self.cow_copies = 0
+
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def used_pages(self) -> int:
+        return self.pages - len(self._free)
+
+    def shared_pages(self) -> int:
+        """Pages mapped by more than one holder (slots and/or the
+        prefix index) — the copy-on-write exposure."""
+        return int(np.sum(self.ref > 1))
+
+    def alloc(self):
+        """One free page at refcount 1, or ``None`` when exhausted
+        (the caller decides whether to evict or refuse)."""
+        if not self._free:
+            return None
+        pid = self._free.pop()
+        self.ref[pid] = 1
+        self.peak_used = max(self.peak_used, self.used_pages())
+        return pid
+
+    def retain(self, pid):
+        """One more holder of ``pid`` (a slot mapping a shared prefix
+        page, or the index registering it)."""
+        if pid == TRASH_PAGE:
+            raise InvalidArgumentError("the trash page cannot be retained")
+        if self.ref[pid] <= 0:
+            raise InvalidArgumentError(
+                f"page {pid} is free; retain() needs a live page")
+        self.ref[pid] += 1
+
+    def release(self, pid) -> bool:
+        """Drop one holder; returns True when the page went back to the
+        free list."""
+        if pid == TRASH_PAGE:
+            return False
+        if self.ref[pid] <= 0:
+            raise InvalidArgumentError(
+                f"page {pid} released below refcount 0 (double free)")
+        self.ref[pid] -= 1
+        if self.ref[pid] == 0:
+            self._free.append(pid)
+            return True
+        return False
+
+
+class _Node:
+    __slots__ = ("hash", "page", "parent", "children", "clock")
+
+    def __init__(self, h, page, parent):
+        self.hash = h
+        self.page = page
+        self.parent = parent
+        self.children = {}
+        self.clock = 0
+
+
+class PrefixIndex:
+    """Radix trie over page chain-hashes -> pool pages.
+
+    Each node is one FULL page of some previously admitted prompt;
+    because hashes chain (:func:`chain_hashes`), a root-to-node path is
+    exactly a shared full-page prefix. The index RETAINS every page it
+    registers, so prefix pages outlive the slot that wrote them (the
+    fleet-prefix-cache behavior); :meth:`evict` drops least-recently-
+    matched leaves whose page no slot maps, returning those pages to
+    the free list when the pool runs dry.
+    """
+
+    def __init__(self, pool):
+        self._pool = pool
+        self._roots = {}
+        self._clock = 0
+        self.lookups = 0
+        self.hits = 0
+        self.pages = 0          # nodes (= pages) registered
+        self.evictions = 0
+
+    def _walk(self, hashes):
+        nodes, children = [], self._roots
+        for h in hashes:
+            node = children.get(h)
+            if node is None:
+                break
+            nodes.append(node)
+            children = node.children
+        return nodes
+
+    def match(self, hashes):
+        """Pool pages of the longest indexed prefix of ``hashes``
+        (possibly empty). Touches the path for LRU and counts the
+        lookup as a hit when at least one page matched."""
+        nodes = self._walk(hashes)
+        self._clock += 1
+        for node in nodes:
+            node.clock = self._clock
+        self.lookups += 1
+        if nodes:
+            self.hits += 1
+        return [node.page for node in nodes]
+
+    def known(self, hashes):
+        """The prefix of ``hashes`` this index holds, as a set — the
+        handoff negotiation primitive (ship only unknown pages)."""
+        return {node.hash for node in self._walk(hashes)}
+
+    def insert(self, hashes, pages):
+        """Register a prompt's full-page chain. Existing nodes are
+        reused (their pages are canonical for that content); each NEW
+        node retains its page — the index's own reference."""
+        children, parent = self._roots, None
+        self._clock += 1
+        for h, page in zip(hashes, pages):
+            node = children.get(h)
+            if node is None:
+                page = int(page)
+                if page == TRASH_PAGE:
+                    raise InvalidArgumentError(
+                        "cannot index the trash page as prefix content")
+                node = _Node(h, page, parent)
+                self._pool.retain(page)
+                children[h] = node
+                self.pages += 1
+            node.clock = self._clock
+            children, parent = node.children, node
+
+    def evictable(self) -> int:
+        """Pages eviction could currently free: leaf nodes whose page
+        has no holder beyond the index itself."""
+        return sum(1 for node in self._iter_nodes()
+                   if not node.children and self._pool.ref[node.page] == 1)
+
+    def _iter_nodes(self):
+        stack = list(self._roots.values())
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    def evict(self, need) -> int:
+        """Drop LRU leaves whose pages only the index holds until
+        ``need`` pages went back to the free list (or nothing evictable
+        remains). Returns the count actually freed."""
+        freed = 0
+        while freed < int(need):
+            victim = None
+            for node in self._iter_nodes():
+                if node.children or self._pool.ref[node.page] != 1:
+                    continue
+                if victim is None or node.clock < victim.clock:
+                    victim = node
+            if victim is None:
+                break
+            siblings = (victim.parent.children if victim.parent is not None
+                        else self._roots)
+            del siblings[victim.hash]
+            self._pool.release(victim.page)
+            self.pages -= 1
+            self.evictions += 1
+            freed += 1
+        return freed
+
+    def forget_page(self, page) -> int:
+        """Drop ``page`` (and its whole subtree — descendants are
+        unreachable without it) from the index, releasing the index's
+        reference on every forgotten page. The memory-pressure valve:
+        when a slot's ring wraps into a page the index pins and the
+        pool cannot supply a CoW copy, forgetting the chain lets the
+        slot write in place. Returns the number of nodes dropped."""
+        page = int(page)
+        victim = next((n for n in self._iter_nodes() if n.page == page),
+                      None)
+        if victim is None:
+            return 0
+        siblings = (victim.parent.children if victim.parent is not None
+                    else self._roots)
+        del siblings[victim.hash]
+        dropped = 0
+        stack = [victim]
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            self._pool.release(node.page)
+            self.pages -= 1
+            self.evictions += 1
+            dropped += 1
+        return dropped
+
+    def stats(self) -> dict:
+        return {
+            "pages": self.pages,
+            "lookups": self.lookups,
+            "hits": self.hits,
+            "hit_rate": round(self.hits / self.lookups, 4)
+            if self.lookups else None,
+            "evictions": self.evictions,
+        }

@@ -133,6 +133,53 @@ class QuantizedStaticCache(NamedTuple):
     pos: Any
 
 
+class PagedStaticCache(NamedTuple):
+    """:class:`StaticCache` semantics over a PAGE-POOL layout.
+
+    ``k``/``v`` are ``[P, H, ps, D]`` — the whole shared pool of ``P``
+    physical pages (``ps`` tokens each) for ONE layer, not one slot's
+    ring. ``table`` is ``[B, NP]`` int32: row ``b`` maps that slot's
+    ``NP`` logical ring pages to physical pool pages, and ``pos`` is the
+    shared ``[B]`` position vector. The LOGICAL cache is the exact same
+    ring the contiguous cache implements — entry index ``pos % (NP*ps)``
+    splits into logical page ``idx // ps`` and offset ``idx % ps``, so
+    every mask (decode/prefill/verify) and the wraparound contract carry
+    over unchanged, and greedy output is token-identical to the ring
+    layout by construction.
+
+    Writes scatter through the table (a functional ``.at[phys, :, off,
+    :].set``); reads gather ``k[table]`` back into the contiguous
+    ``[B, H, NP*ps, D]`` window the score matmul expects. Page
+    ALLOCATION is host-side bookkeeping between steps
+    (:mod:`paddle_tpu.generation.paging`): physical page 0 is reserved
+    as the trash page — vacant slots and unallocated logical pages point
+    at it, absorbing writes that the ring layout would make into a
+    vacant slot's own storage. The pool owner guarantees every page a
+    busy slot is about to write is PRIVATE (refcount 1); shared prefix
+    pages are remapped copy-on-write before the step.
+    """
+
+    k: Any
+    v: Any
+    table: Any
+    pos: Any
+
+
+class QuantizedPagedCache(NamedTuple):
+    """:class:`PagedStaticCache` at int8 storage: int8 ``k``/``v``
+    ``[P, H, ps, D]`` plus f32 per-head dynamic scale pools
+    ``k_scale``/``v_scale`` ``[P, H, ps]`` — :class:`QuantizedStaticCache`'s
+    quantize-on-write / dequantize-on-read contract through the same
+    page-table indirection."""
+
+    k: Any
+    v: Any
+    k_scale: Any
+    v_scale: Any
+    table: Any
+    pos: Any
+
+
 #: int8 grid half-width for KV-cache quantization
 KV_QUANT_BNT = 127.0
 #: scale floor: an all-zero head-vector must not dequantize as NaN
@@ -207,13 +254,16 @@ class MultiHeadAttention(Layer):
         q = self._shape(self.q_proj(query))
         k = self._shape(self.k_proj(key))
         v = self._shape(self.v_proj(value))
-        if isinstance(cache, (StaticCache, QuantizedStaticCache)):
+        if isinstance(cache, (StaticCache, QuantizedStaticCache,
+                              PagedStaticCache, QuantizedPagedCache)):
             # incremental path: write the new K/V into the ring cache by
             # functional index update, then attend over the FULL static
             # window — shapes never change across steps, so a jitted
             # decode step compiles exactly once (the caller's mask hides
             # not-yet-written entries). The quantized cache writes int8
-            # + per-head scales and hands back the dequantized window.
+            # + per-head scales and hands back the dequantized window;
+            # the paged caches route the same logical ring indices
+            # through a per-slot page table into a shared pool.
             k, v, new_cache = self._update_static_cache(cache, k, v)
         elif cache is not None:
             pk, pv = cache
@@ -313,6 +363,8 @@ class MultiHeadAttention(Layer):
         """
         if isinstance(cache, QuantizedStaticCache):
             return self._update_quantized_cache(cache, k, v)
+        if isinstance(cache, (PagedStaticCache, QuantizedPagedCache)):
+            return self._update_paged_cache(cache, k, v)
         kc, vc, pos = cache
         kn = k._array if isinstance(k, Tensor) else jnp.asarray(k)
         vn = v._array if isinstance(v, Tensor) else jnp.asarray(v)
@@ -372,6 +424,85 @@ class MultiHeadAttention(Layer):
         vf = dequantize_kv(vc, vs, out_dtype)
         return (Tensor._from_array(kf), Tensor._from_array(vf),
                 QuantizedStaticCache(kc, vc, ks, vs, pos))
+
+    @staticmethod
+    def _paged_indices(table, pos, t, store, ps):
+        """Physical (page, offset) coordinates for a ``t``-token write
+        starting at each row's ``pos`` — the logical ring index
+        ``(pos + j) % store`` split into the table lookup."""
+        if t == 1:
+            idx = jnp.mod(pos, store)
+            rows = jnp.arange(table.shape[0])
+            return table[rows, idx // ps], jnp.mod(idx, ps)
+        idx = jnp.mod(pos[:, None] + jnp.arange(t)[None, :], store)
+        rows = jnp.arange(table.shape[0])[:, None]
+        return table[rows, idx // ps], jnp.mod(idx, ps)
+
+    def _update_paged_cache(self, cache, k, v):
+        """Paged twin of :meth:`_update_static_cache`: the identical
+        logical ring write/read, with the page table translating logical
+        pages to shared-pool pages. The write scatters into the pool
+        (the pool owner pre-guarantees written pages are private — CoW
+        happened host-side before this step); the read gathers each
+        row's ``NP`` pages back into the contiguous ``[B, H, NP*ps, D]``
+        window so the attention math — and hence the numerics — is
+        byte-identical to the ring layout's."""
+        quant = isinstance(cache, QuantizedPagedCache)
+        if quant:
+            kc, vc, ks, vs, table, pos = cache
+        else:
+            kc, vc, table, pos = cache
+        kn = k._array if isinstance(k, Tensor) else jnp.asarray(k)
+        vn = v._array if isinstance(v, Tensor) else jnp.asarray(v)
+        out_dtype = kn.dtype
+        ps = kc.shape[2]
+        b, np_ = table.shape
+        store = np_ * ps
+        t = kn.shape[2]
+        phys, off = self._paged_indices(table, pos, t, store, ps)
+        if quant:
+            kq, ksc = quantize_kv(kn)
+            vq, vsc = quantize_kv(vn)
+            if t == 1:
+                kc = kc.at[phys, :, off, :].set(kq[:, :, 0, :])
+                vc = vc.at[phys, :, off, :].set(vq[:, :, 0, :])
+                ks = ks.at[phys, :, off].set(ksc[:, :, 0])
+                vs = vs.at[phys, :, off].set(vsc[:, :, 0])
+            else:
+                kc = kc.at[phys, :, off, :].set(jnp.moveaxis(kq, 2, 1))
+                vc = vc.at[phys, :, off, :].set(jnp.moveaxis(vq, 2, 1))
+                ks = ks.at[phys, :, off].set(jnp.moveaxis(ksc, 2, 1))
+                vs = vs.at[phys, :, off].set(jnp.moveaxis(vsc, 2, 1))
+        else:
+            kn = kn.astype(kc.dtype)
+            vn = vn.astype(vc.dtype)
+            if t == 1:
+                kc = kc.at[phys, :, off, :].set(kn[:, :, 0, :])
+                vc = vc.at[phys, :, off, :].set(vn[:, :, 0, :])
+            else:
+                kc = kc.at[phys, :, off, :].set(jnp.moveaxis(kn, 2, 1))
+                vc = vc.at[phys, :, off, :].set(jnp.moveaxis(vn, 2, 1))
+        # gather the per-row window: [B, NP, H, ps, D] -> [B, H, NP*ps, D]
+        h, d = kc.shape[1], kc.shape[3]
+
+        def window(pool):
+            g = pool[table]
+            return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(
+                b, h, store, d)
+
+        if quant:
+            def swindow(spool):
+                g = spool[table]  # [B, NP, H, ps]
+                return jnp.transpose(g, (0, 2, 1, 3)).reshape(b, h, store)
+
+            kw = dequantize_kv(window(kc), swindow(ks), out_dtype)
+            vw = dequantize_kv(window(vc), swindow(vs), out_dtype)
+            new = QuantizedPagedCache(kc, vc, ks, vs, table, pos)
+        else:
+            kw = window(kc).astype(out_dtype)
+            vw = window(vc).astype(out_dtype)
+            new = PagedStaticCache(kc, vc, table, pos)
+        return Tensor._from_array(kw), Tensor._from_array(vw), new
 
 
 class TransformerEncoderLayer(Layer):

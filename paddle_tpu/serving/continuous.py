@@ -35,6 +35,7 @@ from collections import deque
 
 from ..errors import InvalidArgumentError
 from ..flags import flag
+from ..generation.handoff import PageSlab
 from ..monitor import counter, gauge, histogram
 from ..monitor import flight_recorder as _flight
 from ..monitor import tracing as _tracing
@@ -259,6 +260,44 @@ class ContinuousBatcher:
             handoff=(planes, length, int(first_token)), tenant=tenant)
         return self._enqueue(req)
 
+    def submit_prefilled_pages(self, slab: PageSlab, max_new_tokens=None,
+                               temperature=None, deadline_ms=None,
+                               on_token=None, tenant=None,
+                               prompt=None) -> GenerationRequest:
+        """Enqueue a PAGE-GRANULAR handoff (``handoff.PageSlab``): the
+        prefill tier shipped only the pages this decode tier's prefix
+        index does not already hold; admission maps known pages
+        copy-on-write and installs the shipped ones into freshly
+        allocated pool pages. Requires ``kv_cache_layout=paged``."""
+        if not getattr(self.engine, "paged", False):
+            raise InvalidArgumentError(
+                "page-granular handoff needs kv_cache_layout=paged on "
+                "the decode tier")
+        length = int(slab.length)
+        if not 1 <= length <= self.engine.cache_len:
+            raise InvalidArgumentError(
+                f"handoff prompt length {length} outside "
+                f"[1, {self.engine.cache_len}]")
+        max_new = (self.engine.default_max_new_tokens
+                   if max_new_tokens is None else int(max_new_tokens))
+        if max_new < 1:
+            raise InvalidArgumentError(
+                f"max_new_tokens must be >= 1, got {max_new}")
+        if length + max_new > self.engine.max_positions:
+            raise InvalidArgumentError(
+                f"prompt ({length}) + max_new_tokens ({max_new}) "
+                f"exceeds max_position_embeddings "
+                f"{self.engine.max_positions}")
+        now = self._clock()
+        deadline = (now + float(deadline_ms) / 1e3
+                    if deadline_ms is not None and float(deadline_ms) > 0
+                    else None)
+        req = GenerationRequest(
+            prompt, max_new, temperature, deadline, now,
+            on_token=on_token, prompt_len=length, handoff=slab,
+            tenant=tenant)
+        return self._enqueue(req)
+
     def generate(self, prompt, max_new_tokens=None, temperature=None,
                  timeout=None) -> list:
         """Synchronous convenience: submit + wait."""
@@ -348,6 +387,16 @@ class ContinuousBatcher:
                              if r is None), None)
                 if free is None:
                     return
+                # paged layout: a vacant slot is NOT capacity — the
+                # page pool is. Leave the head queued until enough
+                # free or evictable pages exist (slots release pages
+                # as sequences finish); ring layout always passes.
+                head = self._q[0]
+                if not engine.has_capacity(
+                        head.prompt if head.handoff is None
+                        and head.prompt is not None
+                        else head.prompt_len):
+                    return
                 req = self._q.popleft()
                 self._m_depth.set(len(self._q))
             midbatch = self.live_slots > 0
@@ -377,14 +426,22 @@ class ContinuousBatcher:
                     fill=round(len(req.prompt) / bucket, 4))
             try:
                 with _tracing.use_span(asp):
-                    if req.handoff is not None:
+                    if isinstance(req.handoff, PageSlab):
+                        slab = req.handoff
+                        tok = engine.admit_prefilled_pages(
+                            free, slab.pages, slab.length,
+                            slab.first_token,
+                            page_size=slab.page_size,
+                            tenant=req.tenant)
+                    elif req.handoff is not None:
                         planes, length, first = req.handoff
                         tok = engine.admit_prefilled(
                             free, planes, length, first,
                             prompt=req.prompt)
                     else:
                         tok = engine.admit(free, req.prompt,
-                                           req.temperature)
+                                           req.temperature,
+                                           tenant=req.tenant)
             except Exception as e:  # noqa: BLE001 — the loop must survive
                 asp.set_error(f"{type(e).__name__}: {e}")
                 _tracing.record_fanin(asp, [req.trace])
@@ -416,6 +473,7 @@ class ContinuousBatcher:
             self._deliver(req, tok)
             reason = self._finished_reason(req)
             if reason is not None:
+                engine.release_slot(free)
                 self._complete(req, reason)
                 continue
             self._slots[free] = req
@@ -450,6 +508,7 @@ class ContinuousBatcher:
             except Exception as e:  # noqa: BLE001 — fail THESE, keep serving
                 for s in busy:
                     req, self._slots[s] = self._slots[s], None
+                    engine.release_slot(s)
                     self._m_errors.inc()
                     _tracing.record_interval(
                         "serving::decode", req.trace,
@@ -472,6 +531,7 @@ class ContinuousBatcher:
                 req = self._slots[s]
                 if req is None or req.finished:  # stop(drain=False) race
                     self._slots[s] = None
+                    engine.release_slot(s)
                     continue
                 reason = None
                 if engine.speculative:
@@ -489,6 +549,7 @@ class ContinuousBatcher:
                     reason = self._finished_reason(req)
                 if reason is not None:
                     self._slots[s] = None
+                    engine.release_slot(s)
                     self._complete(req, reason)
             # per-token latency, per STREAM (what a client waits between
             # tokens): the plain path observes the step time unchanged;
@@ -555,6 +616,7 @@ class ContinuousBatcher:
         for s, req in enumerate(self._slots):
             if req is not None:
                 self._slots[s] = None
+                self.engine.release_slot(s)
                 if not req.finished:
                     dropped.append(req)
         for req in dropped:

@@ -700,6 +700,12 @@ class _GenerationHandler(_BaseHandler):
         raw = self._read_body()
         if raw is None:
             return
+        if path == "/prefix_known":
+            # prefix-cache peer negotiation: a prefill tier (via the
+            # router) asks which page chain-hashes this backend's index
+            # already holds, then ships only the rest header-only
+            self._prefix_known(raw)
+            return
         if path != _KIND_ROUTES[self._srv.kind]:
             self._reply(404, {
                 "error": f"unknown path {path!r} (this backend's kind "
@@ -714,6 +720,20 @@ class _GenerationHandler(_BaseHandler):
         else:
             with self._trace_request("serving::generate_kv"):
                 self._generate_kv(raw)
+
+    def _prefix_known(self, raw):
+        """``POST /prefix_known`` ``{"hashes": [...]}``: the subset (as
+        a prefix chain) this backend's page index holds. Ring layouts
+        answer an empty set — every page must ship."""
+        try:
+            body = json.loads(raw or b"{}")
+            hashes = [str(h) for h in (body.get("hashes") or [])]
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": f"malformed body: {e}"})
+            return
+        known = self._srv.engine.known_page_hashes(hashes)
+        self._reply(200, {"known": sorted(known),
+                          "layout": self._srv.engine.kv_cache_layout})
 
     @staticmethod
     def _parse_gen_body(raw) -> dict:
@@ -801,14 +821,33 @@ class _GenerationHandler(_BaseHandler):
         original request's generation parameters — and the prompt
         itself, which a speculative decode tier needs — ride in the
         slab header, so the router can forward bytes without
-        re-parsing anything."""
-        from ..generation.handoff import HANDOFF_CONTENT_TYPE, pack_kv_slab
+        re-parsing anything.
+
+        A paged prefill tier answers PAGE-GRANULAR (``PTKP``) when the
+        body asks with ``"page_format": true``; ``"known_hashes"`` (the
+        decode tier's ``known_page_hashes`` answer, forwarded by the
+        router) lets it ship header-only entries for pages the far side
+        already holds — the prefix-cache wire saving."""
+        from ..generation.handoff import (
+            HANDOFF_CONTENT_TYPE,
+            HANDOFF_PAGED_CONTENT_TYPE,
+            pack_kv_pages,
+            pack_kv_slab,
+        )
 
         srv = self._srv
         if not self._check_ready(srv):
             return
         try:
             p = self._parse_gen_body(raw)
+            body = json.loads(raw or b"{}")
+            page_format = bool(body.get("page_format", False))
+            known_hashes = [str(h) for h in
+                            (body.get("known_hashes") or [])]
+            if page_format and not srv.engine.paged:
+                raise InvalidArgumentError(
+                    "page_format needs kv_cache_layout=paged on the "
+                    "prefill tier")
             srv.engine.validate(
                 p["prompt"],
                 p["max_new_tokens"]
@@ -817,35 +856,66 @@ class _GenerationHandler(_BaseHandler):
         except (ValueError, TypeError, InvalidArgumentError) as e:
             self._reply(400, {"error": str(e)})
             return
-        _tracing.annotate(prompt_tokens=len(p["prompt"]), prefill=True)
+        _tracing.annotate(prompt_tokens=len(p["prompt"]), prefill=True,
+                          page_format=page_format)
+        meta = {
+            "params": {k: p[k] for k in
+                       ("prompt", "max_new_tokens", "temperature",
+                        "deadline_ms", "stream", "tenant")},
+            "cache": srv.cache_geometry(),
+        }
         try:
-            planes, length, first = srv.run_prefill(
-                p["prompt"], p["temperature"])
+            if page_format:
+                pages, length, first = srv.run_prefill_pages(
+                    p["prompt"], p["temperature"],
+                    known_hashes=known_hashes)
+                blob = pack_kv_pages(pages, length, first,
+                                     srv.engine.page_size, meta=meta)
+                ctype = HANDOFF_PAGED_CONTENT_TYPE
+            else:
+                planes, length, first = srv.run_prefill(
+                    p["prompt"], p["temperature"])
+                blob = pack_kv_slab(planes, length, first, meta=meta)
+                ctype = HANDOFF_CONTENT_TYPE
         except ServingClosedError as e:
             self._reply(503, {"error": str(e)})
             return
         except Exception as e:  # noqa: BLE001 — a failed forward must answer
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
             return
-        blob = pack_kv_slab(planes, length, first, meta={
-            "params": {k: p[k] for k in
-                       ("prompt", "max_new_tokens", "temperature",
-                        "deadline_ms", "stream", "tenant")},
-            "cache": srv.cache_geometry(),
-        })
-        self._reply_raw(200, blob, HANDOFF_CONTENT_TYPE)
+        self._reply_raw(200, blob, ctype)
 
     def _generate_kv(self, raw):
         """Decode-tier leg: land a handed-off KV slab in a decode slot
         and continue the generation — the slab's riding parameters
-        reconstruct the original request (including streaming)."""
-        from ..generation.handoff import HandoffError, unpack_kv_slab
+        reconstruct the original request (including streaming). Both
+        wire formats land here, told apart by magic: ``PTKV``
+        (contiguous slab) and ``PTKP`` (page-granular, paged tiers
+        only)."""
+        from ..generation.handoff import (
+            HandoffError,
+            unpack_kv_pages,
+            unpack_kv_slab,
+        )
 
         srv = self._srv
         if not self._check_ready(srv):
             return
+        paged_wire = raw[:4] == b"PTKP"
         try:
-            planes, length, first, meta = unpack_kv_slab(raw)
+            if paged_wire:
+                if not srv.engine.paged:
+                    raise HandoffError(
+                        "page-granular slab needs kv_cache_layout=paged "
+                        "on this decode tier (ring tiers speak PTKV)")
+                slab = unpack_kv_pages(raw)
+                length, meta = slab.length, slab.meta
+                if slab.page_size != srv.engine.page_size:
+                    raise HandoffError(
+                        f"KV page slab page_size {slab.page_size} does "
+                        f"not match this tier's {srv.engine.page_size}")
+            else:
+                planes, length, first, meta = unpack_kv_slab(raw)
             mine = srv.cache_geometry()
             theirs = meta.get("cache") or {}
             bad = {k: (theirs.get(k), mine[k]) for k in mine
@@ -867,13 +937,21 @@ class _GenerationHandler(_BaseHandler):
         p = dict(meta.get("params") or {})
         stream = bool(p.get("stream", False))
         _tracing.annotate(prompt_tokens=length, handoff=True,
-                          stream=stream)
-        submit = lambda **kw: srv.scheduler.submit_prefilled(  # noqa: E731
-            planes, length, first,
-            max_new_tokens=p.get("max_new_tokens"),
-            temperature=p.get("temperature"),
-            deadline_ms=p.get("deadline_ms"),
-            prompt=p.get("prompt"), tenant=p.get("tenant"), **kw)
+                          stream=stream, page_granular=paged_wire)
+        if paged_wire:
+            submit = lambda **kw: srv.scheduler.submit_prefilled_pages(  # noqa: E731,E501
+                slab,
+                max_new_tokens=p.get("max_new_tokens"),
+                temperature=p.get("temperature"),
+                deadline_ms=p.get("deadline_ms"),
+                prompt=p.get("prompt"), tenant=p.get("tenant"), **kw)
+        else:
+            submit = lambda **kw: srv.scheduler.submit_prefilled(  # noqa: E731,E501
+                planes, length, first,
+                max_new_tokens=p.get("max_new_tokens"),
+                temperature=p.get("temperature"),
+                deadline_ms=p.get("deadline_ms"),
+                prompt=p.get("prompt"), tenant=p.get("tenant"), **kw)
         if stream:
             self._generate_stream(srv, submit)
             return
@@ -1105,6 +1183,31 @@ class GenerationServer:
                 else:
                     self._prefill_waiting -= 1
 
+    def run_prefill_pages(self, prompt, temperature=None,
+                          known_hashes=()):
+        """Page-granular :meth:`run_prefill`: same bounded-concurrency
+        forward, answered as content-hashed pages with the ones in
+        ``known_hashes`` shipped header-only."""
+        if self.draining:
+            raise ServingClosedError("prefill backend draining")
+        with self._prefill_count_lock:
+            self._prefill_waiting += 1
+        acquired = False
+        try:
+            with self._prefill_sem:
+                with self._prefill_count_lock:
+                    self._prefill_waiting -= 1
+                    self._prefill_active += 1
+                    acquired = True
+                return self.engine.prefill_export_pages(
+                    prompt, temperature, known_hashes=known_hashes)
+        finally:
+            with self._prefill_count_lock:
+                if acquired:
+                    self._prefill_active -= 1
+                else:
+                    self._prefill_waiting -= 1
+
     def _suggested_slots(self):
         """Decode slots the device HBM budget would fit at this
         geometry, or None when the budget is unknown (statz field)."""
@@ -1153,6 +1256,7 @@ class GenerationServer:
             "slots": self.engine.slots,
             "slots_busy": self.scheduler.live_slots,
             "cache_len": self.engine.cache_len,
+            "kv_cache_layout": self.engine.kv_cache_layout,
             "prefill_buckets": list(self.engine.prefill_buckets),
             "queue_depth": self.scheduler.queue_depth(),
             "queue_capacity": self.scheduler.queue_capacity,
@@ -1227,6 +1331,10 @@ class GenerationServer:
             # round decide how many full-model dispatches each token
             # costs (acceptance_rate * k + 1 tokens per verify)
             "speculative": self.engine.spec_stats(),
+            # paged-KV economics: pool occupancy, CoW traffic, and the
+            # prefix index's hit accounting, global + per tenant
+            # (layout "ring" reports just the layout name)
+            "paging": self.engine.paging_stats(),
             "latency": {
                 "token": quantiles("serving/gen_token_ms"),
                 "ttft": quantiles("serving/gen_ttft_ms"),

@@ -56,6 +56,11 @@
 #             stamped-scope coverage + time-accuracy envelope on the
 #             BERT/ResNet/GPT smokes, measured fused-conv win,
 #             /profilez end to end, idle stamping < 1% of dispatch)
+#           + paged smoke (paged KV: ring-vs-paged greedy parity at
+#             bounded compiles, 90%-shared-prefix burst with the
+#             prefill-FLOPs/TTFT win, >= 1.3x slots at equal HBM on a
+#             constrained pool, strict memplan refusing an over-budget
+#             pool before allocation)
 #           + bench trend (two newest BENCH_r*.json, >20% headline
 #             regressions warned)
 set -euo pipefail
@@ -200,6 +205,15 @@ case "$MODE" in
     # theory), /profilez served end to end, and idle stamping under 1%
     # of the steady-state dispatch period
     JAX_PLATFORMS=cpu python tools/opprof_smoke.py
+    # paged smoke: paged KV subsystem — ring-vs-paged greedy parity on
+    # a mixed 8-prompt burst at exactly ladder+1 compiles, a
+    # 90%-shared-prefix burst admitting through the radix index with
+    # the prefill-FLOPs saving and a measured TTFT drop, the same
+    # mixed short/long workload running token-identically on a pool
+    # 1.6x smaller than the ring reservation (>= 1.3x slots at equal
+    # HBM), and strict memplan refusing an over-budget pool at engine
+    # construction, before any device allocation
+    JAX_PLATFORMS=cpu python tools/paged_smoke.py
     # bench trend: two newest BENCH_r*.json compared, >20% headline
     # regressions warned (non-fatal: CPU-runner noise)
     python tools/bench_trend.py

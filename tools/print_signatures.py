@@ -62,6 +62,10 @@ PINNED_METHODS = [
     ("paddle_tpu.static", "Program", "verify"),
     ("paddle_tpu.static", "Program", "plan_memory"),
     ("paddle_tpu.generation", "GenerationEngine", "suggest_decode_slots"),
+    # the paged-KV surface: page-granular handoff + /statz paging block
+    ("paddle_tpu.generation", "GenerationEngine", "prefill_export_pages"),
+    ("paddle_tpu.generation", "GenerationEngine", "admit_prefilled_pages"),
+    ("paddle_tpu.generation", "GenerationEngine", "paging_stats"),
     # the labeled-family API: child metrics per label set
     ("paddle_tpu.monitor", "Counter", "labels"),
     ("paddle_tpu.monitor", "Gauge", "labels"),
